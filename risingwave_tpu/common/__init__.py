@@ -1,0 +1,24 @@
+from .types import DataType, Field, Schema, StringDictionary, GLOBAL_DICT, schema
+from .chunk import (
+    Column,
+    StreamChunk,
+    StreamChunkBuilder,
+    empty_chunk,
+    op_sign,
+    OP_INSERT,
+    OP_DELETE,
+    OP_UPDATE_DELETE,
+    OP_UPDATE_INSERT,
+    DEFAULT_CHUNK_CAPACITY,
+)
+from .vnode import VNODE_COUNT, compute_vnodes, compute_vnodes_numpy, crc32_columns
+from .epoch import EpochPair, next_epoch, INVALID_EPOCH
+
+__all__ = [
+    "DataType", "Field", "Schema", "StringDictionary", "GLOBAL_DICT", "schema",
+    "Column", "StreamChunk", "StreamChunkBuilder", "empty_chunk", "op_sign",
+    "OP_INSERT", "OP_DELETE", "OP_UPDATE_DELETE", "OP_UPDATE_INSERT",
+    "DEFAULT_CHUNK_CAPACITY",
+    "VNODE_COUNT", "compute_vnodes", "compute_vnodes_numpy", "crc32_columns",
+    "EpochPair", "next_epoch", "INVALID_EPOCH",
+]

@@ -1,0 +1,220 @@
+"""Columnar chunks — the data quantum flowing between executors.
+
+Re-design of the reference's DataChunk/StreamChunk
+(src/common/src/array/data_chunk.rs:66, array/stream_chunk.rs:44-92) for XLA:
+a chunk is a *fixed-capacity* struct-of-arrays pytree. Row count is dynamic
+only through the visibility mask — shapes are static so every executor step
+compiles once. The reference already carries a visibility bitmap on every
+chunk; here it is load-bearing for padding as well.
+
+Ops follow reference `Op` (stream_chunk.rs:44-49):
+  INSERT=0  DELETE=1  UPDATE_DELETE=2  UPDATE_INSERT=3
+`op_sign` maps insert-like ops to +1 and delete-like to -1 — the sign of a
+row's contribution to any linear aggregate, which is how changelog semantics
+stay branch-free on device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .types import DataType, Schema
+
+# Op encoding (int8 on device)
+OP_INSERT = 0
+OP_DELETE = 1
+OP_UPDATE_DELETE = 2
+OP_UPDATE_INSERT = 3
+
+DEFAULT_CHUNK_CAPACITY = 4096
+
+
+def op_sign(ops: jnp.ndarray) -> jnp.ndarray:
+    """+1 for Insert/UpdateInsert, -1 for Delete/UpdateDelete."""
+    is_insert = (ops == OP_INSERT) | (ops == OP_UPDATE_INSERT)
+    return jnp.where(is_insert, jnp.int32(1), jnp.int32(-1))
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Column:
+    """One column: fixed-width data + optional validity (None = all valid)."""
+
+    data: jnp.ndarray
+    valid: Optional[jnp.ndarray] = None  # bool mask, True = non-null
+
+    def tree_flatten(self):
+        return (self.data, self.valid), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    def valid_mask(self) -> jnp.ndarray:
+        if self.valid is None:
+            return jnp.ones(self.data.shape[0], dtype=bool)
+        return self.valid
+
+    def take(self, idx: jnp.ndarray) -> "Column":
+        return Column(
+            jnp.take(self.data, idx, axis=0),
+            None if self.valid is None else jnp.take(self.valid, idx, axis=0),
+        )
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class StreamChunk:
+    """ops + columns + visibility. A DataChunk is a StreamChunk with all-INSERT
+    ops (the reference keeps two types; one suffices here — batch executors
+    simply ignore `ops`)."""
+
+    columns: tuple[Column, ...]
+    ops: jnp.ndarray       # int8 [CAP]
+    vis: jnp.ndarray       # bool [CAP]
+    schema: Schema         # static aux
+
+    def tree_flatten(self):
+        return (self.columns, self.ops, self.vis), self.schema
+
+    @classmethod
+    def tree_unflatten(cls, schema, children):
+        columns, ops, vis = children
+        return cls(tuple(columns), ops, vis, schema)
+
+    # -- shape ---------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.ops.shape[0]
+
+    def cardinality(self) -> jnp.ndarray:
+        """Number of visible rows (device scalar)."""
+        return jnp.sum(self.vis.astype(jnp.int32))
+
+    def num_rows_host(self) -> int:
+        return int(np.asarray(self.cardinality()))
+
+    # -- transforms ----------------------------------------------------
+    def with_vis(self, vis: jnp.ndarray) -> "StreamChunk":
+        return StreamChunk(self.columns, self.ops, vis, self.schema)
+
+    def mask(self, keep: jnp.ndarray) -> "StreamChunk":
+        return self.with_vis(self.vis & keep)
+
+    def project(self, indices: Sequence[int]) -> "StreamChunk":
+        return StreamChunk(
+            tuple(self.columns[i] for i in indices),
+            self.ops, self.vis, self.schema.select(indices),
+        )
+
+    def take(self, idx: jnp.ndarray, vis: jnp.ndarray) -> "StreamChunk":
+        """Row gather (used by compaction / dispatch routing)."""
+        return StreamChunk(
+            tuple(c.take(idx) for c in self.columns),
+            jnp.take(self.ops, idx, axis=0), vis, self.schema,
+        )
+
+    def compact(self) -> "StreamChunk":
+        """Move visible rows to the front (stable). Keeps capacity."""
+        cap = self.capacity
+        order = jnp.argsort(~self.vis, stable=True)
+        n = self.cardinality()
+        new_vis = jnp.arange(cap) < n
+        return self.take(order, new_vis)
+
+    # -- host I/O ------------------------------------------------------
+    @staticmethod
+    def from_numpy(
+        schema: Schema,
+        arrays: Sequence[np.ndarray],
+        ops: Optional[np.ndarray] = None,
+        capacity: Optional[int] = None,
+        valids: Optional[Sequence[Optional[np.ndarray]]] = None,
+    ) -> "StreamChunk":
+        n = len(arrays[0]) if arrays else 0
+        cap = capacity or max(DEFAULT_CHUNK_CAPACITY, n)
+        assert n <= cap, f"{n} rows > capacity {cap}"
+        cols = []
+        for i, (arr, f) in enumerate(zip(arrays, schema)):
+            arr = np.asarray(arr, dtype=f.data_type.np_dtype)
+            pad = np.zeros(cap, dtype=f.data_type.np_dtype)
+            pad[:n] = arr
+            valid = None
+            if valids is not None and valids[i] is not None:
+                v = np.zeros(cap, dtype=bool)
+                v[:n] = valids[i]
+                valid = jnp.asarray(v)
+            cols.append(Column(jnp.asarray(pad), valid))
+        ops_arr = np.zeros(cap, dtype=np.int8)
+        if ops is not None:
+            ops_arr[:n] = np.asarray(ops, dtype=np.int8)
+        vis = np.zeros(cap, dtype=bool)
+        vis[:n] = True
+        return StreamChunk(tuple(cols), jnp.asarray(ops_arr), jnp.asarray(vis), schema)
+
+    def to_numpy(self) -> tuple[list[np.ndarray], np.ndarray]:
+        """Visible rows only -> (columns, ops). Device->host sync."""
+        vis = np.asarray(self.vis)
+        cols = [np.asarray(c.data)[vis] for c in self.columns]
+        ops = np.asarray(self.ops)[vis]
+        return cols, ops
+
+    def to_rows(self) -> list[tuple]:
+        """Visible rows as python tuples (op, values...). For tests/sinks."""
+        cols, ops = self.to_numpy()
+        out = []
+        for r in range(len(ops)):
+            out.append((int(ops[r]), tuple(c[r].item() for c in cols)))
+        return out
+
+
+def empty_chunk(schema: Schema, capacity: int = DEFAULT_CHUNK_CAPACITY) -> StreamChunk:
+    return StreamChunk.from_numpy(schema, [np.zeros(0, f.data_type.np_dtype) for f in schema], capacity=capacity)
+
+
+class StreamChunkBuilder:
+    """Host-side row accumulator emitting fixed-capacity chunks
+    (reference: StreamChunkBuilder, array/stream_chunk_builder.rs).
+    Update pairs are kept within a single chunk."""
+
+    def __init__(self, schema: Schema, capacity: int = DEFAULT_CHUNK_CAPACITY):
+        self.schema = schema
+        self.capacity = capacity
+        self._rows: list[tuple[int, tuple]] = []
+
+    def __len__(self):
+        return len(self._rows)
+
+    def append_row(self, op: int, values: tuple) -> Optional[StreamChunk]:
+        self._rows.append((op, values))
+        if len(self._rows) >= self.capacity:
+            # Never split an UpdateDelete/UpdateInsert pair across chunks —
+            # downstream op-fixup kernels rely on pair adjacency within one
+            # chunk (the reference builder reserves a slot the same way).
+            held = None
+            if len(self._rows) > 1 and self._rows[-1][0] == OP_UPDATE_DELETE:
+                held = self._rows.pop()
+            chunk = self.take()
+            if held is not None:
+                self._rows.append(held)
+            return chunk
+        return None
+
+    def take(self) -> Optional[StreamChunk]:
+        if not self._rows:
+            return None
+        ops = np.asarray([r[0] for r in self._rows], dtype=np.int8)
+        arrays = []
+        for i, f in enumerate(self.schema):
+            arrays.append(np.asarray([r[1][i] for r in self._rows], dtype=f.data_type.np_dtype))
+        self._rows = []
+        return StreamChunk.from_numpy(self.schema, arrays, ops=ops, capacity=self.capacity)

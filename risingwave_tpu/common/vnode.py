@@ -1,0 +1,85 @@
+"""Consistent-hash virtual nodes.
+
+Reference: src/common/src/hash/consistent_hash/vnode.rs:34-157 — 256 vnodes,
+`vnode = crc32(dist_key) % 256`, computed vectorized per chunk
+(`VirtualNode::compute_chunk`). Here the crc32 runs *on device* as a
+byte-table-lookup kernel over the key columns' little-endian bytes, so routing
+never leaves HBM. Data-distribution decisions (vnode -> shard) all key off
+this single function.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+VNODE_BITS = 8
+VNODE_COUNT = 1 << VNODE_BITS  # 256
+
+
+@lru_cache(maxsize=1)
+def _crc32_table_np() -> np.ndarray:
+    poly = np.uint32(0xEDB88320)
+    table = np.zeros(256, dtype=np.uint32)
+    for i in range(256):
+        c = np.uint32(i)
+        for _ in range(8):
+            c = np.where(c & 1, (c >> np.uint32(1)) ^ poly, c >> np.uint32(1))
+        table[i] = c
+    return table
+
+
+def crc32_columns(columns: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Vectorized crc32 over the little-endian bytes of fixed-width columns.
+
+    columns: arrays of identical leading shape [N]; each element contributes
+    its dtype's width in bytes, column-major in argument order (a stable,
+    injective-enough serialization standing in for the reference's
+    value-encoding bytes).
+    Returns uint32 [N].
+    """
+    table = jnp.asarray(_crc32_table_np())
+    crc = jnp.full(columns[0].shape[0], 0xFFFFFFFF, dtype=jnp.uint32)
+    for col in columns:
+        nbytes = col.dtype.itemsize
+        # reinterpret to unsigned of same width, then peel bytes LE
+        u = col.view(jnp.dtype(f"uint{8 * nbytes}")) if col.dtype != jnp.bool_ else col.astype(jnp.uint8)
+        u = u.astype(jnp.uint64)
+        for b in range(nbytes):
+            byte = ((u >> jnp.uint64(8 * b)) & jnp.uint64(0xFF)).astype(jnp.uint32)
+            idx = (crc ^ byte) & jnp.uint32(0xFF)
+            crc = (crc >> jnp.uint32(8)) ^ jnp.take(table, idx.astype(jnp.int32))
+    return crc ^ jnp.uint32(0xFFFFFFFF)
+
+
+def compute_vnodes(key_columns: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """vnode per row = crc32(key columns) % 256  (int32 [N]).
+
+    Matches reference semantics at vnode.rs:126 (`compute_chunk`): one hash
+    over the distribution-key columns, modulo VNODE_COUNT.
+    """
+    return (crc32_columns(key_columns) & jnp.uint32(VNODE_COUNT - 1)).astype(jnp.int32)
+
+
+def crc32_numpy(columns: Sequence[np.ndarray]) -> np.ndarray:
+    """Host mirror of crc32_columns (golden tests, meta-side placement)."""
+    table = _crc32_table_np()
+    crc = np.full(len(columns[0]), 0xFFFFFFFF, dtype=np.uint32)
+    for col in columns:
+        col = np.asarray(col)
+        if col.dtype == np.bool_:
+            col = col.astype(np.uint8)
+        nbytes = col.dtype.itemsize
+        u = col.view(f"uint{8 * nbytes}").astype(np.uint64)
+        for b in range(nbytes):
+            byte = ((u >> np.uint64(8 * b)) & np.uint64(0xFF)).astype(np.uint32)
+            idx = (crc ^ byte) & np.uint32(0xFF)
+            crc = (crc >> np.uint32(8)) ^ table[idx]
+    return crc ^ np.uint32(0xFFFFFFFF)
+
+
+def compute_vnodes_numpy(key_columns: Sequence[np.ndarray]) -> np.ndarray:
+    return (crc32_numpy(key_columns) & np.uint32(VNODE_COUNT - 1)).astype(np.int32)

@@ -1,0 +1,3 @@
+from .nexmark import (
+    NexmarkGenerator, NexmarkConfig, BID_SCHEMA, PERSON_SCHEMA, AUCTION_SCHEMA,
+)

@@ -1,0 +1,261 @@
+"""Nexmark event generator — device-native datagen source.
+
+Reference: src/connector/src/source/nexmark/ (wraps the public `nexmark`
+crate); workloads defined by ci/scripts/sql/nexmark/q*.sql. This is a
+re-implementation of the *public Nexmark benchmark generator model* (person/
+auction/bid event interleaving 1:3:46 per 50 events, hot-key skew ratios
+from the spec) as a pure function `event_index -> row`, vectorized in jnp so
+a whole chunk is generated on device per call — the source never bottlenecks
+the TPU executors it feeds.
+
+Randomness is a counter-based splitmix64 of the event id: deterministic,
+seekable (exactly-once source recovery = remember the next event index,
+reference source offsets in state_table_handler.rs), and identical across
+hosts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.chunk import StreamChunk, Column
+from ..common.types import DataType, GLOBAL_DICT, Schema, schema
+
+# Event interleaving per 50 events (Nexmark spec)
+PERSON_PROPORTION = 1
+AUCTION_PROPORTION = 3
+BID_PROPORTION = 46
+TOTAL_PROPORTION = 50
+
+HOT_AUCTION_RATIO = 100
+HOT_BIDDER_RATIO = 100
+HOT_SELLER_RATIO = 4
+
+FIRST_PERSON_ID = 1000
+FIRST_AUCTION_ID = 1000
+FIRST_CATEGORY_ID = 10
+
+BID_SCHEMA = schema(
+    ("auction", DataType.INT64),
+    ("bidder", DataType.INT64),
+    ("price", DataType.INT64),
+    ("channel", DataType.VARCHAR),
+    ("url", DataType.VARCHAR),
+    ("date_time", DataType.TIMESTAMP),
+    ("extra", DataType.VARCHAR),
+)
+
+PERSON_SCHEMA = schema(
+    ("id", DataType.INT64),
+    ("name", DataType.VARCHAR),
+    ("email_address", DataType.VARCHAR),
+    ("credit_card", DataType.VARCHAR),
+    ("city", DataType.VARCHAR),
+    ("state", DataType.VARCHAR),
+    ("date_time", DataType.TIMESTAMP),
+    ("extra", DataType.VARCHAR),
+)
+
+AUCTION_SCHEMA = schema(
+    ("id", DataType.INT64),
+    ("item_name", DataType.VARCHAR),
+    ("description", DataType.VARCHAR),
+    ("initial_bid", DataType.INT64),
+    ("reserve", DataType.INT64),
+    ("date_time", DataType.TIMESTAMP),
+    ("expires", DataType.TIMESTAMP),
+    ("seller", DataType.INT64),
+    ("category", DataType.INT64),
+    ("extra", DataType.VARCHAR),
+)
+
+_CHANNELS = ["apple", "google", "baidu", "facebook"]
+_STATES = ["AZ", "CA", "ID", "OR", "WA", "WY"]
+_CITIES = ["Phoenix", "Los Angeles", "San Francisco", "Boise", "Portland",
+           "Bend", "Redmond", "Seattle", "Kent", "Cheyenne"]
+
+# Dict-encoded vocabularies: every VARCHAR column draws ids from a
+# contiguous range [base, base+size) registered in GLOBAL_DICT, so device
+# ids always decode to real strings.
+_VOCABS: dict[str, tuple[int, int]] = {}
+
+
+def _register_vocab(name: str, strings: list[str]) -> tuple[int, int]:
+    if name not in _VOCABS:
+        ids = [GLOBAL_DICT.get_or_insert(s) for s in strings]
+        base = ids[0]
+        assert ids == list(range(base, base + len(ids))), \
+            f"vocab {name} not contiguous in GLOBAL_DICT"
+        _VOCABS[name] = (base, len(ids))
+    return _VOCABS[name]
+
+
+def _ensure_vocabs() -> dict[str, tuple[int, int]]:
+    _register_vocab("channel", _CHANNELS)
+    _register_vocab("state", _STATES)
+    _register_vocab("city", _CITIES)
+    _register_vocab("name", [f"person_{i}" for i in range(1000)])
+    _register_vocab("email", [f"user_{i}@example.com" for i in range(1000)])
+    _register_vocab("card", [f"{i:04d} {i:04d} {i:04d} {i:04d}" for i in range(1000)])
+    _register_vocab("url", [f"https://b.example.com/item/{i}" for i in range(1000)])
+    _register_vocab("item", [f"item_{i}" for i in range(1000)])
+    _register_vocab("desc", [f"description_{i}" for i in range(100)])
+    _register_vocab("extra", [f"extra_{i}" for i in range(100)])
+    return dict(_VOCABS)
+
+
+def _vocab_pick(vocab: tuple[int, int], eid: jnp.ndarray, salt: int) -> jnp.ndarray:
+    base, size = vocab
+    return (base + _rand(eid, salt, size)).astype(jnp.int32)
+
+
+def _splitmix64(x: jnp.ndarray) -> jnp.ndarray:
+    """Counter-based hash, uint64 -> uint64 (public splitmix64 constants)."""
+    x = x.astype(jnp.uint64)
+    x = (x + jnp.uint64(0x9E3779B97F4A7C15))
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> jnp.uint64(31))
+
+
+def _rand(eid: jnp.ndarray, salt: int, mod: int) -> jnp.ndarray:
+    """Deterministic uniform int64 in [0, mod)."""
+    h = _splitmix64(eid.astype(jnp.uint64) * jnp.uint64(2654435761) + jnp.uint64(salt))
+    return (h % jnp.uint64(mod)).astype(jnp.int64)
+
+
+@dataclass(frozen=True)
+class NexmarkConfig:
+    base_time_us: int = 1_500_000_000_000_000  # event-time origin (us)
+    inter_event_us: int = 10                   # logical event spacing
+    num_active_people: int = 1000
+    in_flight_auctions: int = 100
+
+
+def _ids_so_far(global_id):
+    """Counts of persons/auctions emitted up to global event id (exclusive)."""
+    group = global_id // TOTAL_PROPORTION
+    off = global_id % TOTAL_PROPORTION
+    n_persons = group * PERSON_PROPORTION + jnp.minimum(off, PERSON_PROPORTION)
+    n_auctions = group * AUCTION_PROPORTION + jnp.clip(
+        off - PERSON_PROPORTION, 0, AUCTION_PROPORTION)
+    return n_persons, n_auctions
+
+
+def _event_time(global_id, cfg: NexmarkConfig):
+    return cfg.base_time_us + global_id * cfg.inter_event_us
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3))
+def gen_bid_columns(start_index: jnp.ndarray, n: int, cfg: NexmarkConfig,
+                    vocabs: tuple = ()):
+    """Bid events k = start_index .. start_index+n-1 (bid-local indices)."""
+    V = dict(vocabs)
+    k = start_index + jnp.arange(n, dtype=jnp.int64)
+    group = k // BID_PROPORTION
+    off = k % BID_PROPORTION
+    global_id = group * TOTAL_PROPORTION + PERSON_PROPORTION + AUCTION_PROPORTION + off
+    n_persons, n_auctions = _ids_so_far(global_id)
+
+    # auction: hot (1 per HOT_AUCTION_RATIO chance of cold) -> recent hot id
+    hot = _rand(global_id, 1, HOT_AUCTION_RATIO) > 0
+    hot_auction = ((n_auctions - 1) // HOT_AUCTION_RATIO) * HOT_AUCTION_RATIO
+    cold_auction = n_auctions - 1 - _rand(global_id, 2, cfg.in_flight_auctions)
+    auction = FIRST_AUCTION_ID + jnp.where(hot, hot_auction, jnp.maximum(cold_auction, 0))
+
+    hot_b = _rand(global_id, 3, HOT_BIDDER_RATIO) > 0
+    hot_bidder = ((n_persons - 1) // HOT_BIDDER_RATIO) * HOT_BIDDER_RATIO + 1
+    cold_bidder = n_persons - 1 - _rand(global_id, 4, cfg.num_active_people)
+    bidder = FIRST_PERSON_ID + jnp.where(hot_b, hot_bidder, jnp.maximum(cold_bidder, 0))
+
+    # price: roughly log-uniform in [100, 10^7] (spec's price model shape)
+    lg = _rand(global_id, 5, 5)  # decade
+    mant = _rand(global_id, 6, 900) + 100
+    price = mant * (10 ** lg).astype(jnp.int64)
+
+    channel = _vocab_pick(V["channel"], global_id, 7)
+    url = _vocab_pick(V["url"], global_id, 8)
+    date_time = _event_time(global_id, cfg)
+    extra = _vocab_pick(V["extra"], global_id, 9)
+    return (auction, bidder, price, channel, url, date_time, extra)
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3))
+def gen_person_columns(start_index: jnp.ndarray, n: int, cfg: NexmarkConfig,
+                       vocabs: tuple = ()):
+    V = dict(vocabs)
+    k = start_index + jnp.arange(n, dtype=jnp.int64)
+    global_id = k * TOTAL_PROPORTION  # persons sit at offset 0 of each group
+    pid = FIRST_PERSON_ID + k
+    name_base, name_size = V["name"]
+    name = (name_base + (pid % name_size)).astype(jnp.int32)
+    email = _vocab_pick(V["email"], global_id, 11)
+    card = _vocab_pick(V["card"], global_id, 12)
+    city = _vocab_pick(V["city"], global_id, 13)
+    state = _vocab_pick(V["state"], global_id, 14)
+    date_time = _event_time(global_id, cfg)
+    extra = _vocab_pick(V["extra"], global_id, 15)
+    return (pid, name, email, card, city, state, date_time, extra)
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3))
+def gen_auction_columns(start_index: jnp.ndarray, n: int, cfg: NexmarkConfig,
+                        vocabs: tuple = ()):
+    V = dict(vocabs)
+    k = start_index + jnp.arange(n, dtype=jnp.int64)
+    group = k // AUCTION_PROPORTION
+    off = k % AUCTION_PROPORTION
+    global_id = group * TOTAL_PROPORTION + PERSON_PROPORTION + off
+    n_persons, _ = _ids_so_far(global_id)
+    aid = FIRST_AUCTION_ID + k
+    item = _vocab_pick(V["item"], global_id, 21)
+    desc = _vocab_pick(V["desc"], global_id, 22)
+    initial_bid = _rand(global_id, 23, 1000) * 100 + 100
+    reserve = initial_bid + _rand(global_id, 24, 1000) * 100
+    date_time = _event_time(global_id, cfg)
+    expires = date_time + (_rand(global_id, 25, 100) + 1) * 1_000_000
+    hot = _rand(global_id, 26, HOT_SELLER_RATIO) > 0
+    hot_seller = ((n_persons - 1) // HOT_SELLER_RATIO) * HOT_SELLER_RATIO
+    cold_seller = n_persons - 1 - _rand(global_id, 27, cfg.num_active_people)
+    seller = FIRST_PERSON_ID + jnp.where(hot, hot_seller, jnp.maximum(cold_seller, 0))
+    category = FIRST_CATEGORY_ID + _rand(global_id, 28, 5)
+    return (aid, item, desc, initial_bid, reserve, date_time, expires,
+            seller, category, _vocab_pick(V["extra"], global_id, 29))
+
+
+_TABLES = {
+    "bid": (BID_SCHEMA, gen_bid_columns),
+    "person": (PERSON_SCHEMA, gen_person_columns),
+    "auction": (AUCTION_SCHEMA, gen_auction_columns),
+}
+
+
+class NexmarkGenerator:
+    """Split reader for one Nexmark table (reference SplitReader,
+    connector/src/source/base.rs). Offset = next event index of this table —
+    the exactly-once source state."""
+
+    def __init__(self, table: str, chunk_size: int = 4096,
+                 cfg: NexmarkConfig = NexmarkConfig(), start_offset: int = 0):
+        self.table = table
+        self.schema, self._gen = _TABLES[table]
+        self.chunk_size = chunk_size
+        self.cfg = cfg
+        self.offset = start_offset
+        self._vocabs = tuple(sorted(_ensure_vocabs().items()))
+        self._vis = jnp.ones(chunk_size, dtype=bool)
+        self._ops = jnp.zeros(chunk_size, dtype=jnp.int8)
+
+    def seek(self, offset: int) -> None:
+        self.offset = offset
+
+    def next_chunk(self) -> StreamChunk:
+        cols = self._gen(jnp.int64(self.offset), self.chunk_size, self.cfg, self._vocabs)
+        self.offset += self.chunk_size
+        columns = tuple(Column(c) for c in cols)
+        return StreamChunk(columns, self._ops, self._vis, self.schema)

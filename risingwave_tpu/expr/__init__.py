@@ -1,0 +1,9 @@
+from .ir import Expr, InputRef, Literal, FuncCall, call, col, lit
+from .agg import AggCall, AggKind, AggSpec, count_star, agg_max, agg_min, agg_sum
+from .functions import registered_functions
+
+__all__ = [
+    "Expr", "InputRef", "Literal", "FuncCall", "call", "col", "lit",
+    "AggCall", "AggKind", "AggSpec", "count_star", "agg_max", "agg_min",
+    "agg_sum", "registered_functions",
+]

@@ -1,0 +1,133 @@
+"""Aggregate functions as device reduction specs.
+
+Reference: `AggregateFunction{update(state, StreamChunk), get_result}`
+(src/expr/core/src/aggregate/mod.rs:34-55) with retractable builds for
+streaming. The TPU re-design splits an aggregate into three pure pieces that
+compose with segment-reduction and hash-table scatter:
+
+  partial(values, signs, seg_ids, num_segments) -> per-segment partial states
+  combine(state, partial) -> state               (associative merge)
+  emit(state) -> output value
+
+Linear aggs (count/sum) are fully retractable — a Delete row contributes with
+sign -1, exactly the reference's retractable build. min/max are retractable
+only with materialized input state (reference `minput`,
+executor/aggregation/minput.rs); on append-only inputs (Nexmark sources) the
+cheap combine form is valid and is what `append_only=True` selects. The
+materialized-input path for retractable min/max lives in the hash-agg
+executor, not here.
+
+`avg` is lowered by the planner to sum/count + a projection divide (the
+reference does the same in the frontend).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..common.types import DataType
+
+
+class AggKind(enum.Enum):
+    COUNT = "count"
+    SUM = "sum"
+    MIN = "min"
+    MAX = "max"
+
+
+@dataclass(frozen=True)
+class AggCall:
+    kind: AggKind
+    arg: Optional[int]          # input column index (None for count(*))
+    ret_type: DataType
+    append_only: bool = False   # input stream has no deletes
+
+    def spec(self) -> "AggSpec":
+        return make_spec(self)
+
+
+_I64_MIN = jnp.iinfo(jnp.int64).min
+_I64_MAX = jnp.iinfo(jnp.int64).max
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    call: AggCall
+    state_dtype: object
+    init: object  # identity element
+
+    def init_state(self, shape) -> jnp.ndarray:
+        return jnp.full(shape, self.init, dtype=self.state_dtype)
+
+    # values: [N] input column data (garbage where sign==0)
+    # signs:  [N] int32 in {-1, 0, +1} (0 = masked/invisible/null)
+    # seg_ids:[N] int32 segment per row; num_segments static
+    def partial(self, values, signs, seg_ids, num_segments) -> jnp.ndarray:
+        k = self.call.kind
+        if k is AggKind.COUNT:
+            return jax.ops.segment_sum(signs.astype(jnp.int64), seg_ids, num_segments)
+        if k is AggKind.SUM:
+            v = values.astype(self.state_dtype) * signs.astype(self.state_dtype)
+            return jax.ops.segment_sum(v, seg_ids, num_segments)
+        if k is AggKind.MIN:
+            v = jnp.where(signs > 0, values.astype(self.state_dtype), self.init)
+            return jax.ops.segment_min(v, seg_ids, num_segments)
+        if k is AggKind.MAX:
+            v = jnp.where(signs > 0, values.astype(self.state_dtype), self.init)
+            return jax.ops.segment_max(v, seg_ids, num_segments)
+        raise NotImplementedError(k)
+
+    def combine(self, state, partial) -> jnp.ndarray:
+        k = self.call.kind
+        if k in (AggKind.COUNT, AggKind.SUM):
+            return state + partial
+        if k is AggKind.MIN:
+            return jnp.minimum(state, partial)
+        if k is AggKind.MAX:
+            return jnp.maximum(state, partial)
+        raise NotImplementedError(k)
+
+    def emit(self, state) -> jnp.ndarray:
+        return state.astype(self.call.ret_type.jnp_dtype)
+
+
+def make_spec(call: AggCall) -> AggSpec:
+    k = call.kind
+    if k is AggKind.COUNT:
+        return AggSpec(call, jnp.int64, 0)
+    if k is AggKind.SUM:
+        dt = jnp.float64 if call.ret_type.is_float else jnp.int64
+        return AggSpec(call, dt, 0 if dt == jnp.int64 else 0.0)
+    if k in (AggKind.MIN, AggKind.MAX):
+        if not call.append_only:
+            # retractable min/max needs the materialized-input state path
+            # (handled by the executor); the combine-form spec is still used
+            # for within-chunk partials of insert rows.
+            pass
+        if call.ret_type.is_float:
+            dt, ident = jnp.float64, (jnp.inf if k is AggKind.MIN else -jnp.inf)
+        else:
+            dt, ident = jnp.int64, (_I64_MAX if k is AggKind.MIN else _I64_MIN)
+        return AggSpec(call, dt, ident)
+    raise NotImplementedError(k)
+
+
+def count_star(append_only: bool = False) -> AggCall:
+    return AggCall(AggKind.COUNT, None, DataType.INT64, append_only)
+
+
+def agg_max(col: int, ret_type: DataType = DataType.INT64, append_only: bool = False) -> AggCall:
+    return AggCall(AggKind.MAX, col, ret_type, append_only)
+
+
+def agg_min(col: int, ret_type: DataType = DataType.INT64, append_only: bool = False) -> AggCall:
+    return AggCall(AggKind.MIN, col, ret_type, append_only)
+
+
+def agg_sum(col: int, ret_type: DataType = DataType.INT64, append_only: bool = False) -> AggCall:
+    return AggCall(AggKind.SUM, col, ret_type, append_only)

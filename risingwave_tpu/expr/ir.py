@@ -1,0 +1,132 @@
+"""Expression IR — the tree the planner emits and the device evaluates.
+
+Re-design of the reference's expression engine (src/expr/core/src/expr/mod.rs:
+66-94: `Expression::eval(&DataChunk) -> ArrayRef`): an `Expr` tree evaluates
+vectorized over a chunk's columns with jnp ops, so a whole executor step —
+expressions included — traces into one XLA computation. There is no separate
+"compile" step: tracing under `jax.jit` *is* the lowering (the reference's
+build-from-proto + dyn-dispatch eval becomes trace-time recursion that
+disappears at runtime).
+
+Null semantics (reference `Datum = Option<ScalarImpl>`): every value carries
+an optional validity mask; strict functions propagate nulls elementwise
+(mod.rs:167-182 strict/non-strict split). Non-strict evaluation maps errors to
+NULL per-row instead of failing the chunk — on device, error conditions
+(div-by-zero, overflow-free semantics of jnp) are masked the same way.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..common.chunk import Column
+from ..common.types import DataType, GLOBAL_DICT
+
+
+class Expr:
+    """Base expression node. `ret_type` is static; `eval` is traced."""
+
+    ret_type: DataType
+
+    def eval(self, columns: Sequence[Column]) -> Column:
+        raise NotImplementedError
+
+    # convenience builders ------------------------------------------------
+    def __add__(self, o): return call("add", self, _lit(o))
+    def __sub__(self, o): return call("subtract", self, _lit(o))
+    def __mul__(self, o): return call("multiply", self, _lit(o))
+    def __ge__(self, o): return call("greater_than_or_equal", self, _lit(o))
+    def __gt__(self, o): return call("greater_than", self, _lit(o))
+    def __le__(self, o): return call("less_than_or_equal", self, _lit(o))
+    def __lt__(self, o): return call("less_than", self, _lit(o))
+    def eq(self, o): return call("equal", self, _lit(o))
+
+
+@dataclass
+class InputRef(Expr):
+    """Column reference (reference: expr/expr_input_ref.rs)."""
+
+    index: int
+    ret_type: DataType = DataType.INT64
+
+    def eval(self, columns):
+        return columns[self.index]
+
+    def __repr__(self):
+        return f"${self.index}"
+
+
+@dataclass
+class Literal(Expr):
+    """Constant (reference: expr/expr_literal.rs). A string literal is
+    dict-encoded at plan time."""
+
+    value: Any
+    ret_type: DataType = DataType.INT64
+
+    def eval(self, columns):
+        cap = columns[0].capacity if columns else 1
+        if self.value is None:
+            data = jnp.zeros(cap, dtype=self.ret_type.jnp_dtype)
+            return Column(data, jnp.zeros(cap, dtype=bool))
+        v = self.value
+        if isinstance(v, str):
+            v = GLOBAL_DICT.get_or_insert(v)
+        data = jnp.full(cap, v, dtype=self.ret_type.jnp_dtype)
+        return Column(data, None)
+
+    def __repr__(self):
+        return f"lit({self.value})"
+
+
+@dataclass
+class FuncCall(Expr):
+    """Scalar function application; impl looked up in the registry at trace
+    time (reference: the `#[function]` sig registry, src/expr/core/src/sig/)."""
+
+    name: str
+    args: tuple
+    ret_type: DataType
+
+    def eval(self, columns):
+        from .functions import lookup
+        arg_cols = [a.eval(columns) for a in self.args]
+        return lookup(self.name)(self, arg_cols)
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.args))})"
+
+
+def _lit(v) -> Expr:
+    if isinstance(v, Expr):
+        return v
+    if isinstance(v, bool):
+        return Literal(v, DataType.BOOLEAN)
+    if isinstance(v, int):
+        return Literal(v, DataType.INT64)
+    if isinstance(v, float):
+        return Literal(v, DataType.FLOAT64)
+    if isinstance(v, str):
+        return Literal(v, DataType.VARCHAR)
+    raise TypeError(f"cannot lift {v!r} to a literal")
+
+
+def call(name: str, *args) -> FuncCall:
+    """Build a FuncCall with inferred return type."""
+    from .functions import infer_ret_type
+    args = tuple(_lit(a) for a in args)
+    return FuncCall(name, args, infer_ret_type(name, args))
+
+
+def col(index: int, dtype: DataType = DataType.INT64) -> InputRef:
+    return InputRef(index, dtype)
+
+
+def lit(value, dtype: Optional[DataType] = None) -> Literal:
+    e = _lit(value)
+    if dtype is not None:
+        e.ret_type = dtype
+    return e

@@ -1,0 +1,1 @@
+from .barrier_manager import BarrierCoordinator

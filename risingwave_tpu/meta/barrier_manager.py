@@ -1,0 +1,116 @@
+"""Barrier coordinator — the system heartbeat (meta-lite).
+
+Reference: meta's GlobalBarrierManager (src/meta/src/barrier/mod.rs:481,634,
+669,779) + the CN-side LocalBarrierManager (src/stream/src/task/
+barrier_manager.rs) collapsed into one in-process coordinator: paces barrier
+injection (`barrier_interval_ms`, system_param/mod.rs:77), pushes barriers
+into every source's dedicated channel, waits until every actor reports
+collection, then syncs the state store (the Hummock `commit_epoch` step) and
+completes the epoch IN ORDER. Barrier latency (inject -> fully synced) is the
+headline latency metric (grafana meta_barrier_latency).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..common.epoch import EpochPair, next_epoch, INVALID_EPOCH
+from ..state.store import StateStore
+from ..stream.message import Barrier, BarrierKind, Mutation
+
+
+@dataclass
+class EpochState:
+    barrier: Barrier
+    remaining: set[int]
+    done: asyncio.Event = field(default_factory=asyncio.Event)
+
+
+class BarrierCoordinator:
+    def __init__(self, store: StateStore, interval_ms: int = 1000,
+                 checkpoint_frequency: int = 1):
+        self.store = store
+        self.interval_ms = interval_ms
+        self.checkpoint_frequency = checkpoint_frequency
+        self.source_queues: list[asyncio.Queue] = []
+        self.actor_ids: set[int] = set()
+        self._epochs: dict[int, EpochState] = {}
+        # Seed from the store's committed epoch: post-restart epochs must be
+        # strictly greater than anything a previous incarnation committed
+        # (reference: recovery resumes at the last committed Hummock epoch).
+        self._prev_epoch = store.committed_epoch()
+        self._barrier_count = 0
+        self.latencies_ns: list[int] = []
+        self.committed_epochs: list[int] = []
+        self._stopped = False
+
+    # -------------------------------------------------------- registration
+    def register_source(self, queue: asyncio.Queue) -> None:
+        self.source_queues.append(queue)
+
+    def register_actor(self, actor_id: int) -> None:
+        self.actor_ids.add(actor_id)
+
+    # ----------------------------------------------------------- collection
+    def collect(self, actor_id: int, barrier: Barrier) -> None:
+        st = self._epochs.get(barrier.epoch.curr)
+        if st is None:
+            return
+        st.remaining.discard(actor_id)
+        if not st.remaining:
+            st.done.set()
+
+    # ------------------------------------------------------------ injection
+    async def inject_barrier(self, mutation: Optional[Mutation] = None,
+                             kind: Optional[BarrierKind] = None) -> Barrier:
+        curr = next_epoch(self._prev_epoch)
+        epoch = EpochPair(curr, self._prev_epoch)
+        if kind is None:
+            self._barrier_count += 1
+            is_ckpt = (self._barrier_count % self.checkpoint_frequency) == 0
+            kind = BarrierKind.CHECKPOINT if is_ckpt else BarrierKind.BARRIER
+        barrier = Barrier(epoch, kind, mutation, (), time.monotonic_ns())
+        self._epochs[curr] = EpochState(barrier, set(self.actor_ids))
+        self._prev_epoch = curr
+        for q in self.source_queues:
+            await q.put(barrier)
+        return barrier
+
+    async def wait_collected(self, barrier: Barrier) -> None:
+        st = self._epochs[barrier.epoch.curr]
+        await st.done.wait()
+        # complete IN ORDER (reference mod.rs:779): this epoch seals epoch.prev
+        if barrier.kind is BarrierKind.CHECKPOINT and barrier.epoch.prev != INVALID_EPOCH:
+            self.store.sync(barrier.epoch.prev)
+            self.committed_epochs.append(barrier.epoch.prev)
+        self.latencies_ns.append(time.monotonic_ns() - barrier.inject_time_ns)
+        del self._epochs[barrier.epoch.curr]
+
+    async def run_rounds(self, n: int, interval_s: Optional[float] = None) -> None:
+        """Inject n barriers (first is Initial), waiting for each to complete.
+        interval_s=None => as fast as collection allows (bench mode);
+        otherwise paced like the reference's 1s default."""
+        b = await self.inject_barrier(kind=BarrierKind.INITIAL)
+        await self.wait_collected(b)
+        for _ in range(n):
+            if interval_s:
+                await asyncio.sleep(interval_s)
+            b = await self.inject_barrier()
+            await self.wait_collected(b)
+
+    async def stop_all(self, actor_ids: Optional[set[int]] = None) -> None:
+        from ..stream.message import StopMutation
+        ids = frozenset(actor_ids if actor_ids is not None else self.actor_ids)
+        b = await self.inject_barrier(mutation=StopMutation(ids))
+        await self.wait_collected(b)
+
+    # -------------------------------------------------------------- metrics
+    def barrier_latency_percentile(self, p: float) -> float:
+        if not self.latencies_ns:
+            return 0.0
+        xs = sorted(self.latencies_ns)
+        i = min(len(xs) - 1, int(p * len(xs)))
+        return xs[i] / 1e9

@@ -1,0 +1,3 @@
+from .store import StateStore, MemoryStateStore, WriteBatch, encode_table_key
+from .state_table import StateTable, StateTableError
+from .serde import RowSerde, encode_memcomparable, decode_memcomparable

@@ -1,0 +1,93 @@
+"""State store interfaces + in-memory implementation.
+
+Reference: `StateStore`/`LocalStateStore` traits (src/storage/src/store.rs:
+172-257) — epoch-versioned KV with table-scoped reads, per-epoch `sync` for
+checkpoint durability. Keys follow the reference layout
+`table_id ++ vnode ++ memcomparable(pk)` (hummock_sdk/src/key.rs) so range
+scans per vnode are contiguous.
+
+`MemoryStateStore` is the reference's `MemoryStateStore`
+(src/storage/src/memory.rs): a sorted map, epochs tracked for sync semantics
+but everything stays in RAM. The durable LSM variant is state/hummock.py.
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+
+def encode_table_key(table_id: int, vnode: int, pk_bytes: bytes) -> bytes:
+    return table_id.to_bytes(4, "big") + vnode.to_bytes(1, "big") + pk_bytes
+
+
+@dataclass
+class WriteBatch:
+    table_id: int
+    epoch: int
+    # key -> value (None = tombstone/delete)
+    puts: dict[bytes, Optional[bytes]]
+
+
+class StateStore:
+    """Epoch-versioned KV. Writes are staged per epoch and become readable
+    immediately to the writer (mem-table semantics handled by StateTable);
+    `sync(epoch)` makes everything up to `epoch` durable."""
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def iter_range(self, start: bytes, end: bytes) -> Iterator[tuple[bytes, bytes]]:
+        raise NotImplementedError
+
+    def ingest_batch(self, batch: WriteBatch) -> None:
+        raise NotImplementedError
+
+    def sync(self, epoch: int) -> dict:
+        """Flush everything sealed up to `epoch` durable; returns sync info
+        (sst ids etc.) for the checkpoint manifest."""
+        raise NotImplementedError
+
+    def committed_epoch(self) -> int:
+        raise NotImplementedError
+
+
+class MemoryStateStore(StateStore):
+    def __init__(self):
+        self._keys: list[bytes] = []       # sorted
+        self._vals: dict[bytes, bytes] = {}
+        self._committed_epoch = 0
+        self._pending_epochs: set[int] = set()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._vals.get(key)
+
+    def iter_range(self, start: bytes, end: bytes):
+        i = bisect.bisect_left(self._keys, start)
+        while i < len(self._keys) and self._keys[i] < end:
+            k = self._keys[i]
+            yield k, self._vals[k]
+            i += 1
+
+    def ingest_batch(self, batch: WriteBatch) -> None:
+        self._pending_epochs.add(batch.epoch)
+        for k, v in batch.puts.items():
+            if v is None:
+                if k in self._vals:
+                    del self._vals[k]
+                    i = bisect.bisect_left(self._keys, k)
+                    if i < len(self._keys) and self._keys[i] == k:
+                        self._keys.pop(i)
+            else:
+                if k not in self._vals:
+                    bisect.insort(self._keys, k)
+                self._vals[k] = v
+
+    def sync(self, epoch: int) -> dict:
+        self._pending_epochs = {e for e in self._pending_epochs if e > epoch}
+        self._committed_epoch = max(self._committed_epoch, epoch)
+        return {"uncommitted_ssts": []}
+
+    def committed_epoch(self) -> int:
+        return self._committed_epoch

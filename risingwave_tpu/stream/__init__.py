@@ -1,0 +1,15 @@
+from .message import (
+    Barrier, BarrierKind, Watermark, Message,
+    StopMutation, PauseMutation, ResumeMutation, ThrottleMutation,
+    AddMutation, UpdateMutation,
+)
+from .executor import Executor, StatelessUnaryExecutor
+from .project import ProjectExecutor, FilterExecutor
+from .row_id import RowIdGenExecutor
+from .materialize import MaterializeExecutor, ConflictBehavior
+from .source import SourceExecutor
+from .actor import Actor
+from .exchange import (
+    Channel, SimpleDispatcher, BroadcastDispatcher, HashDispatcher,
+    ChannelInput, MergeExecutor,
+)

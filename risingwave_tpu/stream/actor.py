@@ -1,0 +1,53 @@
+"""Actor — the schedulable unit driving one executor chain.
+
+Reference: src/stream/src/executor/actor.rs:138-247 — an infinite loop pulling
+the chain's final stream, fanning out through the dispatcher, reporting every
+barrier to the local barrier manager (`collect`), exiting on a Stop mutation.
+Here actors are asyncio tasks; device work inside executors runs async to the
+host loop (XLA dispatch is non-blocking until results are fetched).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Protocol
+
+from ..common.chunk import StreamChunk
+from .exchange import Dispatcher
+from .executor import Executor
+from .message import Barrier
+
+
+class BarrierCollector(Protocol):
+    def collect(self, actor_id: int, barrier: Barrier) -> None: ...
+
+
+class Actor:
+    def __init__(self, actor_id: int, consumer: Executor,
+                 dispatcher: Optional[Dispatcher],
+                 collector: Optional[BarrierCollector]):
+        self.actor_id = actor_id
+        self.consumer = consumer
+        self.dispatcher = dispatcher
+        self.collector = collector
+        self.rows_processed = 0
+
+    async def run(self) -> None:
+        async for msg in self.consumer.execute():
+            if isinstance(msg, StreamChunk):
+                if self.dispatcher is not None:
+                    await self.dispatcher.dispatch(msg)
+            elif isinstance(msg, Barrier):
+                barrier = msg.with_passed(self.actor_id)
+                if self.dispatcher is not None:
+                    await self.dispatcher.dispatch(barrier)
+                if self.collector is not None:
+                    self.collector.collect(self.actor_id, barrier)
+                if barrier.is_stop(self.actor_id):
+                    return
+            else:
+                if self.dispatcher is not None:
+                    await self.dispatcher.dispatch(msg)
+
+    def spawn(self) -> asyncio.Task:
+        return asyncio.create_task(self.run(), name=f"actor-{self.actor_id}")

@@ -1,0 +1,185 @@
+"""Exchange: channels, dispatchers, merge — the intra-host communication
+backend.
+
+Reference: dispatch at src/stream/src/executor/dispatch.rs (Hash/Broadcast/
+Simple/RoundRobin), fan-in alignment at merge.rs:109,267-342, bounded permit
+channels at exchange/permit.rs. In the TPU design the *mesh-internal* shuffle
+is an XLA all_to_all (parallel/exchange.py); these host channels connect
+actors within a process and stand where the reference's permit channels +
+gRPC exchange stood (between fragments, and host<->host over DCN).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.chunk import (
+    StreamChunk, OP_DELETE, OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT,
+)
+from ..common.vnode import VNODE_COUNT, compute_vnodes
+from .executor import Executor
+from .message import Barrier, Message, Watermark
+
+
+class Channel:
+    """Bounded mpsc channel (permit.rs analogue)."""
+
+    def __init__(self, capacity: int = 16):
+        self.queue: asyncio.Queue[Message] = asyncio.Queue(maxsize=capacity)
+
+    async def send(self, msg: Message) -> None:
+        await self.queue.put(msg)
+
+    async def recv(self) -> Message:
+        return await self.queue.get()
+
+
+# ------------------------------------------------------------- dispatchers
+
+class Dispatcher:
+    async def dispatch(self, msg: Message) -> None:
+        raise NotImplementedError
+
+
+class SimpleDispatcher(Dispatcher):
+    def __init__(self, output: Channel):
+        self.output = output
+
+    async def dispatch(self, msg: Message) -> None:
+        await self.output.send(msg)
+
+
+class BroadcastDispatcher(Dispatcher):
+    def __init__(self, outputs: Sequence[Channel]):
+        self.outputs = list(outputs)
+
+    async def dispatch(self, msg: Message) -> None:
+        for o in self.outputs:
+            await o.send(msg)
+
+
+class HashDispatcher(Dispatcher):
+    """vnode-routed fan-out (dispatch.rs:679,737-790): vnode per row from the
+    dist-key columns, visibility per output = (vnode_to_output[vnode] == o).
+    Update pairs whose halves land on different outputs degrade to
+    Delete/Insert (op fixup, :751-790). Chunks keep full capacity — each
+    output sees the same arrays with a different mask (zero-copy fan-out)."""
+
+    def __init__(self, outputs: Sequence[Channel], dist_key_indices: Sequence[int],
+                 vnode_to_output: np.ndarray):
+        assert len(vnode_to_output) == VNODE_COUNT
+        self.outputs = list(outputs)
+        self.dist_key_indices = tuple(dist_key_indices)
+        self.vnode_to_output = jnp.asarray(vnode_to_output, dtype=jnp.int32)
+        self._route = jax.jit(self._route_impl)
+
+    def _route_impl(self, chunk: StreamChunk):
+        keys = [chunk.columns[i].data for i in self.dist_key_indices]
+        vnodes = compute_vnodes(keys)
+        out_idx = jnp.take(self.vnode_to_output, vnodes)
+        results = []
+        ops = chunk.ops
+        is_ud = ops == OP_UPDATE_DELETE
+        is_ui = ops == OP_UPDATE_INSERT
+        partner_prev = jnp.roll(out_idx, 1)   # UI's partner UD output
+        partner_next = jnp.roll(out_idx, -1)  # UD's partner UI output
+        pair_split = (is_ui & (out_idx != partner_prev)) | (is_ud & (out_idx != partner_next))
+        fixed_ops = jnp.where(pair_split & is_ui, OP_INSERT, ops)
+        fixed_ops = jnp.where(pair_split & is_ud, OP_DELETE, fixed_ops).astype(ops.dtype)
+        for o in range(len(self.outputs)):
+            vis = chunk.vis & (out_idx == o)
+            results.append(StreamChunk(chunk.columns, fixed_ops, vis, chunk.schema))
+        return tuple(results)
+
+    async def dispatch(self, msg: Message) -> None:
+        if isinstance(msg, StreamChunk):
+            for o, ch in zip(self.outputs, self._route(msg)):
+                await o.send(ch)
+        else:
+            for o in self.outputs:
+                await o.send(msg)
+
+
+# ------------------------------------------------------------------ merge
+
+class ChannelInput(Executor):
+    """Executor adapter over a channel (ReceiverExecutor, receiver.rs)."""
+
+    def __init__(self, channel: Channel, schema):
+        self.channel = channel
+        self.schema = schema
+        self.identity = "ChannelInput"
+
+    async def execute(self):
+        while True:
+            msg = await self.channel.recv()
+            yield msg
+            if isinstance(msg, Barrier):
+                from .message import StopMutation
+                if isinstance(msg.mutation, StopMutation):
+                    return
+
+
+class MergeExecutor(Executor):
+    """Fan-in with barrier alignment (merge.rs:267-342): an upstream that
+    yields a barrier is blocked until every upstream yields that barrier,
+    then ONE barrier is emitted. Watermarks are min-combined per column."""
+
+    def __init__(self, channels: Sequence[Channel], schema):
+        self.channels = list(channels)
+        self.schema = schema
+        self.identity = f"Merge({len(self.channels)})"
+
+    async def execute(self):
+        n = len(self.channels)
+        getters: dict[int, asyncio.Task] = {
+            i: asyncio.create_task(c.recv()) for i, c in enumerate(self.channels)}
+        pending_barrier: dict[int, Barrier] = {}
+        watermarks: dict[int, dict[int, Watermark]] = {i: {} for i in range(n)}
+        emitted_wm: dict[int, object] = {}
+        try:
+            while True:
+                waiting = [t for i, t in getters.items() if i not in pending_barrier]
+                if not waiting:
+                    barrier = next(iter(pending_barrier.values()))
+                    stop = False
+                    from .message import StopMutation
+                    if isinstance(barrier.mutation, StopMutation):
+                        stop = True
+                    yield barrier
+                    pending_barrier.clear()
+                    if stop:
+                        return
+                    for i, c in enumerate(self.channels):
+                        getters[i] = asyncio.create_task(c.recv())
+                    continue
+                done, _ = await asyncio.wait(waiting, return_when=asyncio.FIRST_COMPLETED)
+                for t in done:
+                    i = next(k for k, v in getters.items() if v is t)
+                    msg = t.result()
+                    if isinstance(msg, Barrier):
+                        pending_barrier[i] = msg
+                    elif isinstance(msg, Watermark):
+                        watermarks[i][msg.col_idx] = msg
+                        wm = self._combined_watermark(msg.col_idx, watermarks)
+                        if wm is not None and emitted_wm.get(msg.col_idx) != wm.val:
+                            emitted_wm[msg.col_idx] = wm.val
+                            yield wm
+                        getters[i] = asyncio.create_task(self.channels[i].recv())
+                    else:
+                        yield msg
+                        getters[i] = asyncio.create_task(self.channels[i].recv())
+        finally:
+            for t in getters.values():
+                t.cancel()
+
+    def _combined_watermark(self, col_idx: int, watermarks) -> Optional[Watermark]:
+        vals = [w[col_idx] for w in watermarks.values() if col_idx in w]
+        if len(vals) < len(self.channels):
+            return None
+        return min(vals, key=lambda w: w.val)

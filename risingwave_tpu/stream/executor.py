@@ -1,0 +1,61 @@
+"""Executor base — async message-stream transforms.
+
+Reference: the `Executor` trait (src/stream/src/executor/mod.rs:157-216):
+an executor is a single-consumer stream of Message{Chunk,Barrier,Watermark}
+with a schema and identity; executors wrap their inputs, barriers flow
+through every executor in order. Here an executor is an async generator
+(`execute()`); the device work inside stateful executors is a pure jitted
+step function — the async host layer never holds the GIL against XLA.
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, Optional, Sequence
+
+from ..common.chunk import StreamChunk
+from ..common.types import Schema
+from .message import Barrier, Message, Watermark
+
+
+class Executor:
+    schema: Schema
+    identity: str = "Executor"
+    pk_indices: tuple[int, ...] = ()
+
+    def execute(self) -> AsyncIterator[Message]:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return self.identity
+
+
+class StatelessUnaryExecutor(Executor):
+    """Common shape: map chunks, forward barriers/watermarks."""
+
+    def __init__(self, input: Executor):
+        self.input = input
+        self.schema = input.schema
+        self.pk_indices = input.pk_indices
+
+    def map_chunk(self, chunk: StreamChunk) -> Optional[StreamChunk]:
+        raise NotImplementedError
+
+    def map_watermark(self, wm: Watermark) -> Optional[Watermark]:
+        return wm
+
+    def on_barrier(self, barrier: Barrier) -> None:
+        pass
+
+    async def execute(self):
+        async for msg in self.input.execute():
+            if isinstance(msg, StreamChunk):
+                out = self.map_chunk(msg)
+                if out is not None:
+                    yield out
+            elif isinstance(msg, Barrier):
+                self.on_barrier(msg)
+                yield msg
+            else:
+                wm = self.map_watermark(msg)
+                if wm is not None:
+                    yield wm

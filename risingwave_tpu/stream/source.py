@@ -1,0 +1,115 @@
+"""Source executor.
+
+Reference: src/stream/src/executor/source/source_executor.rs — the stream is
+a select over (dedicated barrier channel, connector chunks); barriers always
+win, Pause/Resume/Throttle mutations gate the connector side, and the split
+offsets are committed to a state table at each checkpoint barrier
+(state_table_handler.rs) so recovery reseeks the connector.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Protocol
+
+from ..common.chunk import StreamChunk
+from ..common.types import Schema
+from ..state.state_table import StateTable
+from .executor import Executor
+from .message import Barrier, BarrierKind, ThrottleMutation
+
+
+class Connector(Protocol):
+    schema: Schema
+    offset: int
+
+    def next_chunk(self) -> StreamChunk: ...
+    def seek(self, offset: int) -> None: ...
+
+
+class SourceExecutor(Executor):
+    def __init__(self, source_id: int, connector: Connector,
+                 barrier_queue: "asyncio.Queue[Barrier]",
+                 state_table: Optional[StateTable] = None,
+                 rate_limit_rows_per_barrier: Optional[int] = None):
+        self.source_id = source_id
+        self.connector = connector
+        self.schema = connector.schema
+        self.barrier_queue = barrier_queue
+        self.state_table = state_table
+        self.rate_limit = rate_limit_rows_per_barrier
+        self.identity = f"Source({source_id})"
+        self.paused = False
+
+    def _recover_offset(self) -> None:
+        if self.state_table is None:
+            return
+        row = self.state_table.get_row((self.source_id,))
+        if row is not None:
+            self.connector.seek(row[1])
+
+    def _commit_offset(self, barrier: Barrier) -> None:
+        if self.state_table is None:
+            return
+        # upsert (source_id, next_offset); offset rides the same epoch commit
+        # as operator state => exactly-once resume.
+        self.state_table.write_chunk_rows([(0, (self.source_id, self.connector.offset))])
+        self.state_table.commit(barrier.epoch.curr)
+
+    async def execute(self):
+        # First message is always the Initial barrier (reference: actors are
+        # built, then the Add/Initial barrier arrives before any data).
+        barrier = await self.barrier_queue.get()
+        if self.state_table is not None:
+            self.state_table.init_epoch(barrier.epoch.curr)
+        if barrier.kind is BarrierKind.INITIAL:
+            self._recover_offset()
+        self.paused = barrier.is_pause()
+        yield barrier
+
+        sent_this_interval = 0
+        while True:
+            if self.paused:
+                barrier = await self.barrier_queue.get()
+            else:
+                try:
+                    barrier = self.barrier_queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    barrier = None
+            if barrier is not None:
+                self._apply_mutation(barrier)
+                self._commit_offset(barrier)
+                sent_this_interval = 0
+                yield barrier
+                if barrier.is_stop(self.source_id):
+                    return
+                continue
+            if self.rate_limit is not None and sent_this_interval >= self.rate_limit:
+                # throttled: wait for the next barrier
+                barrier = await self.barrier_queue.get()
+                self._apply_mutation(barrier)
+                self._commit_offset(barrier)
+                sent_this_interval = 0
+                yield barrier
+                if barrier.is_stop(self.source_id):
+                    return
+                continue
+            chunk = self.connector.next_chunk()
+            if self.rate_limit is not None:
+                # visible rows, not padded capacity (device sync is fine here:
+                # throttled sources are not the hot path)
+                sent_this_interval += chunk.num_rows_host()
+            yield chunk
+            # let barriers/other actors in
+            await asyncio.sleep(0)
+
+    def _apply_mutation(self, barrier: Barrier) -> None:
+        if barrier.is_pause():
+            self.paused = True
+        from .message import ResumeMutation
+        if isinstance(barrier.mutation, ResumeMutation):
+            self.paused = False
+        if isinstance(barrier.mutation, ThrottleMutation):
+            for actor_id, limit in barrier.mutation.limits:
+                if actor_id == self.source_id:
+                    self.rate_limit = limit

@@ -1,0 +1,28 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax initializes.
+
+Multi-chip hardware is unavailable in CI; sharding semantics are validated on
+`--xla_force_host_platform_device_count=8` (the reference's analogue is the
+single-process madsim cluster, SURVEY.md §4)."""
+
+import asyncio
+import inspect
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    """Run `async def` tests under asyncio (pytest-asyncio is not in the
+    image; this is the 10-line equivalent)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {n: pyfuncitem.funcargs[n] for n in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(fn(**kwargs))
+        return True
+    return None

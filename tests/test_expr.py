@@ -1,0 +1,81 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from risingwave_tpu.common import DataType, schema, StreamChunk
+from risingwave_tpu.expr import call, col, lit, count_star, agg_sum, agg_max
+from risingwave_tpu.common.chunk import Column
+
+
+def _cols(**arrs):
+    return [Column(jnp.asarray(a)) for a in arrs.values()]
+
+
+def test_arith_and_cmp():
+    cols = _cols(a=np.array([1, 2, 3], np.int64), b=np.array([10, 20, 30], np.int64))
+    e = (col(0) * 100) + col(1)
+    out = e.eval(cols)
+    np.testing.assert_array_equal(np.asarray(out.data), [110, 220, 330])
+    assert out.valid is None
+    c = col(1) > 15
+    np.testing.assert_array_equal(np.asarray(c.eval(cols).data), [False, True, True])
+
+
+def test_divide_by_zero_is_null():
+    cols = _cols(a=np.array([10, 10], np.int64), b=np.array([2, 0], np.int64))
+    out = call("divide", col(0), col(1)).eval(cols)
+    np.testing.assert_array_equal(np.asarray(out.valid), [True, False])
+    assert np.asarray(out.data)[0] == 5
+
+
+def test_null_propagation_strict():
+    a = Column(jnp.asarray(np.array([1, 2], np.int64)), jnp.asarray([True, False]))
+    b = Column(jnp.asarray(np.array([5, 5], np.int64)))
+    out = call("add", col(0), col(1)).eval([a, b])
+    np.testing.assert_array_equal(np.asarray(out.valid), [True, False])
+
+
+def test_kleene_and():
+    t = Column(jnp.asarray([True, True, False]), jnp.asarray([True, False, True]))
+    f = Column(jnp.asarray([False, False, False]), None)
+    out = call("and", col(0), col(1)).eval([t, f])
+    # anything AND false = false (valid), even null AND false
+    np.testing.assert_array_equal(np.asarray(out.valid), [True, True, True])
+    np.testing.assert_array_equal(np.asarray(out.data), [False, False, False])
+
+
+def test_case_and_coalesce():
+    cols = _cols(a=np.array([1, 5, 9], np.int64))
+    e = call("case", col(0) > 6, lit(100), col(0) > 3, lit(50), lit(0))
+    np.testing.assert_array_equal(np.asarray(e.eval(cols).data), [0, 50, 100])
+
+
+def test_tumble():
+    ts = _cols(t=np.array([12, 19, 20], np.int64))
+    e = call("tumble_start", col(0, DataType.TIMESTAMP), lit(10, DataType.INTERVAL))
+    np.testing.assert_array_equal(np.asarray(e.eval(ts).data), [10, 10, 20])
+    assert e.ret_type == DataType.TIMESTAMP
+
+
+def test_expr_jits():
+    e = (col(0) * 3) + 1
+    f = jax.jit(lambda arrs: e.eval([Column(arrs)]).data)
+    np.testing.assert_array_equal(np.asarray(f(jnp.arange(4, dtype=jnp.int64))), [1, 4, 7, 10])
+
+
+def test_agg_specs():
+    sums = agg_sum(0, DataType.INT64).spec()
+    vals = jnp.asarray(np.array([1, 2, 3, 4], np.int64))
+    signs = jnp.asarray(np.array([1, 1, -1, 0], np.int32))
+    segs = jnp.asarray(np.array([0, 1, 0, 1], np.int32))
+    p = sums.partial(vals, signs, segs, 2)
+    np.testing.assert_array_equal(np.asarray(p), [-2, 2])
+    cnt = count_star().spec()
+    p = cnt.partial(vals, signs, segs, 2)
+    np.testing.assert_array_equal(np.asarray(p), [0, 1])
+    mx = agg_max(0, DataType.INT64, append_only=True).spec()
+    p = mx.partial(vals, jnp.asarray([1, 1, 1, 0], jnp.int32), segs, 2)
+    np.testing.assert_array_equal(np.asarray(p), [3, 2])
+    st = mx.init_state((2,))
+    st = mx.combine(st, p)
+    np.testing.assert_array_equal(np.asarray(mx.emit(st)), [3, 2])

@@ -1,0 +1,94 @@
+import numpy as np
+import pytest
+
+from risingwave_tpu.common import (
+    DataType, schema, StreamChunkBuilder,
+    OP_INSERT, OP_DELETE, OP_UPDATE_DELETE, OP_UPDATE_INSERT,
+)
+from risingwave_tpu.state import MemoryStateStore, StateTable, StateTableError
+
+
+def make_table(store, tid=7):
+    return StateTable(store, tid, schema(("k", DataType.INT64), ("v", DataType.INT64)),
+                      pk_indices=[0])
+
+
+def test_basic_crud_and_commit():
+    store = MemoryStateStore()
+    t = make_table(store)
+    t.init_epoch(100)
+    t.insert((1, 10))
+    t.insert((2, 20))
+    assert t.get_row((1,)) == (1, 10)   # read own writes pre-commit
+    t.commit(200)
+    assert t.get_row((1,)) == (1, 10)
+    t.delete((1, 10))
+    assert t.get_row((1,)) is None      # mem-table delete shadows store
+    t.commit(300)
+    assert t.get_row((1,)) is None
+    assert [r for _, r in t.iter_all()] == [(2, 20)]
+
+
+def test_update_then_delete_across_epochs_leaves_no_stale_row():
+    """Regression: an in-epoch put+delete must still tombstone a prior-epoch
+    version of the key (delete used to just cancel the put)."""
+    store = MemoryStateStore()
+    t = make_table(store)
+    t.init_epoch(100)
+    t.insert((7, 100))
+    t.commit(200)
+    # epoch 2: update (7,100)->(7,200) then delete (7,200)
+    t.write_chunk_rows([(OP_UPDATE_DELETE, (7, 100)), (OP_UPDATE_INSERT, (7, 200))])
+    t.delete((7, 200))
+    t.commit(300)
+    assert t.get_row((7,)) is None
+    assert list(t.iter_all()) == []
+
+
+def test_double_insert_raises():
+    store = MemoryStateStore()
+    t = make_table(store)
+    t.init_epoch(100)
+    t.insert((1, 10))
+    with pytest.raises(StateTableError):
+        t.insert((1, 11))
+
+
+def test_write_chunk_rows_batch_vnodes_match_single():
+    store = MemoryStateStore()
+    t = make_table(store)
+    t.init_epoch(1)
+    rows = [(OP_INSERT, (i, i * 10)) for i in range(50)]
+    t.write_chunk_rows(rows)
+    t.commit(2)
+    t2 = make_table(store)
+    for i in range(50):
+        assert t2.get_row((i,)) == (i, i * 10)
+
+
+def test_pk_ordering_iter():
+    store = MemoryStateStore()
+    t = StateTable(store, 9, schema(("g", DataType.INT64), ("x", DataType.INT64)),
+                   pk_indices=[0, 1], dist_key_indices=[0])
+    t.init_epoch(1)
+    for x in [5, -3, 9, 0]:
+        t.insert((42, x))
+    t.commit(2)
+    got = [r for _, r in t.iter_all()]
+    assert got == [(42, -3), (42, 0), (42, 5), (42, 9)]  # memcomparable order
+
+
+def test_builder_never_splits_update_pair():
+    sch = schema(("a", DataType.INT64),)
+    b = StreamChunkBuilder(sch, capacity=4)
+    chunks = []
+    # rows: I, I, I, UD|UI  -> the UD would land on the last slot
+    for op, v in [(OP_INSERT, 1), (OP_INSERT, 2), (OP_INSERT, 3),
+                  (OP_UPDATE_DELETE, 4), (OP_UPDATE_INSERT, 5)]:
+        ch = b.append_row(op, (v,))
+        if ch is not None:
+            chunks.append(ch)
+    tail = b.take()
+    assert len(chunks) == 1 and chunks[0].num_rows_host() == 3
+    ops = [op for op, _ in tail.to_rows()]
+    assert ops == [OP_UPDATE_DELETE, OP_UPDATE_INSERT]  # pair stayed together
