@@ -43,6 +43,7 @@ class BarrierCoordinator:
         # (reference: recovery resumes at the last committed Hummock epoch).
         self._prev_epoch = store.committed_epoch()
         self._barrier_count = 0
+        self._started = False
         self.latencies_ns: list[int] = []
         self.committed_epochs: list[int] = []
         self._stopped = False
@@ -90,11 +91,16 @@ class BarrierCoordinator:
         del self._epochs[barrier.epoch.curr]
 
     async def run_rounds(self, n: int, interval_s: Optional[float] = None) -> None:
-        """Inject n barriers (first is Initial), waiting for each to complete.
+        """Inject n barriers, waiting for each to complete. The very first
+        barrier of this coordinator's life is Initial (reference: the Add/
+        Initial barrier precedes all data); later calls continue the normal
+        cadence — a mid-stream Initial would skip syncing the previous epoch.
         interval_s=None => as fast as collection allows (bench mode);
         otherwise paced like the reference's 1s default."""
-        b = await self.inject_barrier(kind=BarrierKind.INITIAL)
-        await self.wait_collected(b)
+        if not self._started:
+            self._started = True
+            b = await self.inject_barrier(kind=BarrierKind.INITIAL)
+            await self.wait_collected(b)
         for _ in range(n):
             if interval_s:
                 await asyncio.sleep(interval_s)

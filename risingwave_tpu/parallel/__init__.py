@@ -1,0 +1,15 @@
+"""Multi-device parallelism: mesh placement + in-mesh collective exchange.
+
+Reference mapping (SURVEY.md §2.3): data-parallel actors with vnode bitmaps
+become mesh shards; HashDispatcher+Merge inside a mesh becomes
+`lax.all_to_all` (exchange.py); global/singleton aggs become `psum`;
+rescheduling is a routing-table + state reshard update.
+"""
+
+from .mesh import VNODE_AXIS, make_mesh, shard_vnode_bitmaps, vnode_to_shard
+from .exchange import bucket_by_dest, shuffle_by_vnode, shuffle_rows
+
+__all__ = [
+    "VNODE_AXIS", "make_mesh", "shard_vnode_bitmaps", "vnode_to_shard",
+    "bucket_by_dest", "shuffle_by_vnode", "shuffle_rows",
+]

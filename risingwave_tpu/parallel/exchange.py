@@ -1,0 +1,77 @@
+"""In-mesh shuffle: HashDispatcher + Merge as one XLA all_to_all.
+
+Reference: the hash exchange (src/stream/src/executor/dispatch.rs:679 routes
+rows by vnode to downstream actors over channels/gRPC; merge.rs:109 fans in).
+Inside a TPU mesh that whole path collapses to a single collective: each
+shard buckets its local rows by destination shard (vnode routing table),
+then `lax.all_to_all` swaps buckets over ICI. No host hop, no serialization,
+no per-row control flow — the shuffle is one fused device op per chunk.
+
+All functions here run INSIDE shard_map (they use axis collectives); shapes
+are per-shard. Rows are (columns..., vis) with fixed capacity; destination
+overflow beyond `cap_out` rows per (src,dst) pair is counted and surfaced so
+callers size capacities (the host pipeline applies backpressure long before
+overflow in practice — chunk capacity bounds per-dest rows).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..common.vnode import compute_vnodes
+
+
+def bucket_by_dest(columns: Sequence[jnp.ndarray], vis: jnp.ndarray,
+                   dest: jnp.ndarray, n_dest: int, cap_out: int):
+    """Scatter local rows into per-destination send buffers.
+
+    columns: [N] arrays; vis: bool [N]; dest: int32 [N] in [0, n_dest).
+    Returns (send_cols: list of [n_dest, cap_out], send_vis: [n_dest, cap_out],
+    n_dropped: int32 scalar).
+    """
+    onehot = (dest[:, None] == jnp.arange(n_dest, dtype=dest.dtype)[None, :]) & vis[:, None]
+    pos = (jnp.cumsum(onehot, axis=0) - onehot).astype(jnp.int32)  # rank within dest
+    pos_of_row = jnp.sum(pos * onehot, axis=1)
+    ok = vis & (pos_of_row < cap_out)
+    n_dropped = jnp.sum(vis & ~ok, dtype=jnp.int32)
+    flat = jnp.where(ok, dest * cap_out + pos_of_row, n_dest * cap_out)
+    send_cols = []
+    for col in columns:
+        buf = jnp.zeros(n_dest * cap_out + 1, dtype=col.dtype)
+        send_cols.append(buf.at[flat].set(col, mode="drop")[:-1].reshape(n_dest, cap_out))
+    vbuf = jnp.zeros(n_dest * cap_out + 1, dtype=bool)
+    send_vis = vbuf.at[flat].set(ok, mode="drop")[:-1].reshape(n_dest, cap_out)
+    return send_cols, send_vis, n_dropped
+
+
+def shuffle_rows(columns: Sequence[jnp.ndarray], vis: jnp.ndarray,
+                 dest: jnp.ndarray, axis_name: str, n_shards: int,
+                 cap_out: int):
+    """Route rows to their destination shard (call inside shard_map).
+
+    Returns (recv_cols: list of [n_shards*cap_out], recv_vis, n_dropped):
+    the rows this shard owns, gathered from every source shard.
+    """
+    send_cols, send_vis, n_dropped = bucket_by_dest(columns, vis, dest, n_shards, cap_out)
+    recv_cols = [
+        jax.lax.all_to_all(c, axis_name, split_axis=0, concat_axis=0,
+                           tiled=True).reshape(n_shards * cap_out)
+        for c in send_cols
+    ]
+    recv_vis = jax.lax.all_to_all(send_vis, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=True).reshape(n_shards * cap_out)
+    return recv_cols, recv_vis, n_dropped
+
+
+def shuffle_by_vnode(columns: Sequence[jnp.ndarray], vis: jnp.ndarray,
+                     key_columns: Sequence[jnp.ndarray],
+                     vnode_to_shard_table: jnp.ndarray,
+                     axis_name: str, n_shards: int, cap_out: int):
+    """The full HashDispatcher semantics: vnode = crc32(dist_key) % 256
+    (vnode.rs:126), shard = routing_table[vnode], then all_to_all."""
+    vnodes = compute_vnodes(key_columns)
+    dest = jnp.take(vnode_to_shard_table, vnodes)
+    return shuffle_rows(columns, vis, dest, axis_name, n_shards, cap_out)

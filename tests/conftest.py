@@ -8,10 +8,18 @@ import asyncio
 import inspect
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the image presets JAX_PLATFORMS=axon (the real chip) and its
+# sitecustomize updates jax.config at interpreter startup, so env vars alone
+# don't win — update jax.config too. Tests must be deterministic and
+# multi-device, so they always run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
