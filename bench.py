@@ -93,9 +93,80 @@ async def bench_q1(rounds: int = 20, chunk_size: int = 32768) -> dict:
     }
 
 
+async def bench_q5(rounds: int = 8, chunk_size: int = 65536,
+                   interval_s: float = 0.5) -> dict:
+    """q5 core: HOP(2s,10s) + count(*) GROUP BY (auction, window_start) —
+    the first stateful device pipeline (BASELINE config 2)."""
+    from risingwave_tpu.connectors import NexmarkGenerator
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig
+    from risingwave_tpu.expr.agg import count_star
+    from risingwave_tpu.meta import BarrierCoordinator
+    from risingwave_tpu.state import MemoryStateStore
+    from risingwave_tpu.stream import (
+        Actor, HashAggExecutor, HopWindowExecutor, SourceExecutor,
+    )
+    from risingwave_tpu.common.chunk import StreamChunk
+    from risingwave_tpu.stream.executor import Executor
+
+    store = MemoryStateStore()
+    barrier_q = asyncio.Queue()
+    # event time advances so windows roll while state stays bounded
+    gen = NexmarkGenerator("bid", chunk_size=chunk_size,
+                           cfg=NexmarkConfig(inter_event_us=2))
+    src = SourceExecutor(1, gen, barrier_q, emit_watermarks=True)
+    hop = HopWindowExecutor(src, time_col=5, window_slide_us=2_000_000,
+                            window_size_us=10_000_000)
+    # q5 churns ~65k (auction, window) groups per 1M bids; capacity is sized
+    # for churn between purge rebuilds, watermark cleaning bounds the live set
+    agg = HashAggExecutor(hop, group_key_indices=[0, hop.window_start_idx],
+                          agg_calls=[count_star(append_only=True)],
+                          capacity=1 << 21,
+                          cleaning_watermark_col=hop.window_start_idx)
+
+    class DeviceSink(Executor):
+        def __init__(self, input):
+            self.input = input
+            self.schema = input.schema
+            self.last = None
+
+        async def execute(self):
+            async for msg in self.input.execute():
+                if isinstance(msg, StreamChunk):
+                    self.last = msg.columns[-1].data
+                yield msg
+
+    sink = DeviceSink(agg)
+    coord = BarrierCoordinator(store)
+    coord.register_source(barrier_q)
+    coord.register_actor(1)
+    task = Actor(1, sink, None, coord).spawn()
+
+    await coord.run_rounds(2)  # warmup: compile apply + flush
+    start_offset = gen.offset
+    t0 = time.perf_counter()
+    # barriers paced like the reference's cadence; chunks stream between them
+    await coord.run_rounds(rounds, interval_s=interval_s)
+    if sink.last is not None:
+        sink.last.block_until_ready()
+    dt = time.perf_counter() - t0
+    await coord.stop_all({1})
+    await task
+    rows = gen.offset - start_offset
+    return {
+        "query": "q5",
+        "rows": rows,
+        "seconds": dt,
+        "rows_per_sec": rows / dt,
+        "barrier_p50_s": coord.barrier_latency_percentile(0.5),
+    }
+
+
+QUERIES = {"q1": bench_q1, "q5": bench_q5}
+
+
 def main() -> None:
-    query = sys.argv[1] if len(sys.argv) > 1 else "q1"
-    r = asyncio.run({"q1": bench_q1}[query]())
+    query = sys.argv[1] if len(sys.argv) > 1 else "q5"
+    r = asyncio.run(QUERIES[query]())
     value = r["rows_per_sec"]
     print(json.dumps({
         "metric": f"nexmark_{r['query']}_rows_per_sec_per_chip",

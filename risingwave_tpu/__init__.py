@@ -32,4 +32,18 @@ import jax
 # import, before any tracing happens.
 jax.config.update("jax_enable_x64", True)
 
+# Persistent compilation cache: executor kernels (hash-table while_loops,
+# flush compaction) compile in 5-45s through the remote-TPU tunnel; caching
+# makes every process after the first start warm.
+import os as _os
+
+_cache_dir = _os.environ.get("RWTPU_COMPILE_CACHE",
+                             _os.path.expanduser("~/.cache/rwtpu_xla"))
+try:
+    _os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass  # cache is an optimization, never a requirement
+
 __version__ = "0.1.0"

@@ -259,3 +259,26 @@ class NexmarkGenerator:
         self.offset += self.chunk_size
         columns = tuple(Column(c) for c in cols)
         return StreamChunk(columns, self._ops, self._vis, self.schema)
+
+    @property
+    def watermark_col(self) -> int:
+        """Index of date_time in this table's schema."""
+        return {"bid": 5, "person": 6, "auction": 5}[self.table]
+
+    def current_watermark(self) -> int:
+        """Event-time watermark after the last emitted chunk, computed on the
+        HOST from pure offset arithmetic (the generator's event time is
+        deterministic in the event id) — no device readback on the hot path.
+        Nexmark event time is monotone in the id, so this is exact."""
+        if self.offset == 0:
+            return self.cfg.base_time_us
+        k = self.offset - 1
+        if self.table == "bid":
+            group, off = divmod(k, BID_PROPORTION)
+            gid = group * TOTAL_PROPORTION + PERSON_PROPORTION + AUCTION_PROPORTION + off
+        elif self.table == "person":
+            gid = k * TOTAL_PROPORTION
+        else:
+            group, off = divmod(k, AUCTION_PROPORTION)
+            gid = group * TOTAL_PROPORTION + PERSON_PROPORTION + off
+        return self.cfg.base_time_us + gid * self.cfg.inter_event_us

@@ -1,0 +1,5 @@
+"""Device kernels shared across executors: hashing, open-addressing tables."""
+
+from .hash_table import HashTable, lookup, lookup_or_insert, needs_rebuild
+
+__all__ = ["HashTable", "lookup", "lookup_or_insert", "needs_rebuild"]

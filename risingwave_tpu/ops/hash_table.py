@@ -1,0 +1,175 @@
+"""Device-resident open-addressing hash table — the state backbone of
+HashAgg and HashJoin.
+
+Reference analogue: the executors' group/join hash maps (`JoinHashMap`,
+src/stream/src/executor/managed_state/join/mod.rs; `AggGroup` cache keyed by
+`HashKey`, hash_agg.rs:50-56). On TPU the map is a struct-of-arrays in HBM:
+fixed-capacity key columns + occupancy, probed with linear open addressing.
+The whole insert-or-lookup for a chunk is ONE compiled while_loop — no
+per-row host control flow.
+
+Parallel-insert race (two new keys landing on the same empty slot in the
+same probe round) resolves by scatter-min of row ids: the winner claims the
+slot, same-key losers match it on the next round, different-key losers
+advance. Rows advance past occupied non-matching slots (linear probing).
+
+Deletion policy: slots are never freed (freeing breaks probe chains).
+Groups that empty out stay as zombies; the owner monitors live/zombie load
+via `needs_rebuild` and rebuilds (optionally growing) by re-inserting live
+entries — that is also the capacity-doubling growth path flagged in
+SURVEY.md §7 hard-parts (a).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..common.vnode import crc32_columns
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class HashTable:
+    """keys: per-key-column [C] arrays; occupied: bool [C]."""
+
+    keys: tuple[jnp.ndarray, ...]
+    occupied: jnp.ndarray
+
+    def tree_flatten(self):
+        return (self.keys, self.occupied), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        keys, occupied = children
+        return cls(tuple(keys), occupied)
+
+    @property
+    def capacity(self) -> int:
+        return self.occupied.shape[0]
+
+    @staticmethod
+    def empty(capacity: int, key_dtypes: Sequence) -> "HashTable":
+        return HashTable(
+            tuple(jnp.zeros(capacity, dtype=dt) for dt in key_dtypes),
+            jnp.zeros(capacity, dtype=bool),
+        )
+
+
+def _hash_to_slot(key_cols: Sequence[jnp.ndarray], capacity: int) -> jnp.ndarray:
+    # crc32 of the key bytes (same family as vnode hashing) -> starting slot
+    return (crc32_columns(key_cols) % jnp.uint32(capacity)).astype(jnp.int32)
+
+
+def lookup_or_insert(table: HashTable, key_cols: Sequence[jnp.ndarray],
+                     active: jnp.ndarray, max_probes: int = 0):
+    """Find or claim a slot for every active row.
+
+    key_cols: [N] arrays matching table.keys dtypes; active: bool [N]
+    (invisible rows resolve immediately to slot -1).
+
+    Returns (table', slots int32 [N] (-1 for inactive/unresolved),
+    n_unresolved int32 scalar). n_unresolved > 0 means the table is too
+    full / probe-bound — the caller must rebuild larger and retry.
+    """
+    C = table.capacity
+    N = key_cols[0].shape[0]
+    if max_probes == 0:
+        max_probes = C  # full linear scan worst case
+    row_ids = jnp.arange(N, dtype=jnp.int32)
+    start = _hash_to_slot(key_cols, C)
+
+    def keys_match_at(slot_keys, key_cols):
+        m = jnp.ones(N, dtype=bool)
+        for tk, k in zip(slot_keys, key_cols):
+            m &= tk == k
+        return m
+
+    def cond(st):
+        _, _, resolved, _, it = st
+        return jnp.any(~resolved) & (it < max_probes)
+
+    def body(st):
+        keys, occupied, resolved, slot, it = st
+        occ = occupied[slot]
+        slot_keys = tuple(tk[slot] for tk in keys)
+        match = occ & keys_match_at(slot_keys, key_cols)
+        found = ~resolved & match
+        empty = ~resolved & ~occ
+        # claim contest: min row id per contested slot wins
+        claim = jnp.full(C, N, dtype=jnp.int32)
+        claim = claim.at[jnp.where(empty, slot, C)].min(row_ids, mode="drop")
+        winner = empty & (claim[slot] == row_ids)
+        w_idx = jnp.where(winner, slot, C)
+        keys = tuple(tk.at[w_idx].set(k, mode="drop")
+                     for tk, k in zip(keys, key_cols))
+        occupied = occupied.at[w_idx].set(True, mode="drop")
+        resolved2 = resolved | found | winner
+        # advance only on occupied-mismatch; losers of a claim retry the
+        # same slot (it now holds the winner's key — may be theirs)
+        advance = ~resolved2 & occ & ~match
+        slot = jnp.where(advance, (slot + 1) % C, slot)
+        return keys, occupied, resolved2, slot, it + 1
+
+    init = (table.keys, table.occupied, ~active, start, jnp.int32(0))
+    keys, occupied, resolved, slot, _ = jax.lax.while_loop(cond, body, init)
+    n_unresolved = jnp.sum(~resolved, dtype=jnp.int32)
+    slots = jnp.where(resolved & active, slot, -1)
+    return HashTable(keys, occupied), slots, n_unresolved
+
+
+def lookup(table: HashTable, key_cols: Sequence[jnp.ndarray],
+           active: jnp.ndarray, max_probes: int = 0):
+    """Read-only probe: slot of each active row's key, -1 if absent.
+
+    Probing stops at the first never-occupied slot in the chain (slots are
+    never freed, so an empty slot terminates the chain definitively).
+    """
+    C = table.capacity
+    N = key_cols[0].shape[0]
+    if max_probes == 0:
+        max_probes = C
+    start = _hash_to_slot(key_cols, C)
+
+    def cond(st):
+        searching, _, it = st
+        return jnp.any(searching) & (it < max_probes)
+
+    def body(st):
+        searching, slot, it = st
+        occ = table.occupied[slot]
+        matched = jnp.ones(N, dtype=bool)
+        for tk, k in zip(table.keys, key_cols):
+            matched &= tk[slot] == k
+        hit = searching & occ & matched
+        miss_end = searching & ~occ          # chain ended: not present
+        advance = searching & occ & ~matched
+        searching2 = searching & ~hit & ~miss_end
+        slot2 = jnp.where(advance, (slot + 1) % C, slot)
+        # resolved rows keep their slot on hit; a miss parks at -1
+        return searching2, jnp.where(miss_end, -1, slot2), it + 1
+
+    searching, slot, _ = jax.lax.while_loop(
+        cond, body, (active, start.astype(jnp.int32), jnp.int32(0)))
+    # rows still searching after max_probes: treat as absent
+    return jnp.where(active & ~searching, slot, -1)
+
+
+def load(table: HashTable) -> jnp.ndarray:
+    """Occupied fraction (live + zombie) — rebuild trigger input."""
+    return jnp.mean(table.occupied.astype(jnp.float32))
+
+
+def needs_rebuild(n_occupied: int, n_live: int, capacity: int,
+                  hi: float = 0.7) -> tuple[bool, int]:
+    """Host-side policy: rebuild when load > hi. Grow 2x only if the LIVE
+    set itself crowds the table; a zombie-heavy table rebuilds at the same
+    capacity (purge)."""
+    if n_occupied <= hi * capacity:
+        return False, capacity
+    if n_live > 0.5 * hi * capacity:
+        return True, capacity * 2
+    return True, capacity

@@ -13,3 +13,5 @@ from .exchange import (
     Channel, SimpleDispatcher, BroadcastDispatcher, HashDispatcher,
     ChannelInput, MergeExecutor,
 )
+from .hash_agg import HashAggExecutor
+from .hop_window import HopWindowExecutor

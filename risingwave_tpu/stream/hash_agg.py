@@ -1,0 +1,435 @@
+"""HashAgg executor — grouped streaming aggregation with device state.
+
+Reference: src/stream/src/executor/hash_agg.rs — groups keyed by `HashKey`
+live in a managed cache; chunks are applied group-wise (`apply_chunk`:349);
+at each barrier the executor diffs old vs new agg values and emits change
+rows (`flush_data`:436), then commits its state tables.
+
+TPU re-design: the group map is a `HashTable` in HBM plus parallel state
+arrays [C] (one per agg call) and a row-count array. Applying a chunk is one
+jitted step: slot assignment (open addressing) -> segment-reduce partials by
+slot -> combine into states, marking touched slots dirty. The barrier flush
+is a second jitted step that compacts dirty slots to the front and lays out
+UpdateDelete/UpdateInsert pairs (Insert for born groups, Delete for died
+ones) exactly like the reference's changelog contract. Zombie slots (groups
+at row_count 0) keep their keys so probe chains stay intact; the executor
+rebuilds/grows the table when load crosses the threshold.
+
+min/max require append-only input here (the reference's retractable min/max
+uses materialized input state, aggregation/minput.rs — that variant lives in
+the planner's fallback path, not this executor yet).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.chunk import (
+    Column, StreamChunk, OP_DELETE, OP_INSERT, OP_UPDATE_DELETE,
+    OP_UPDATE_INSERT, op_sign,
+)
+from ..common.types import Field, Schema
+from ..expr.agg import AggCall, AggKind
+from ..ops.hash_table import HashTable, lookup_or_insert, needs_rebuild
+from ..state.state_table import StateTable
+from .executor import Executor
+from .message import Barrier, BarrierKind, Watermark
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class AggState:
+    """Device state of one HashAgg instance (all arrays share capacity C)."""
+
+    table: HashTable
+    agg_states: tuple[jnp.ndarray, ...]   # one [C] per agg call
+    row_count: jnp.ndarray                # int64 [C] — group liveness
+    dirty: jnp.ndarray                    # bool [C] — touched since flush
+    prev_exists: jnp.ndarray              # bool [C] — group was in output
+    prev_emit: tuple[jnp.ndarray, ...]    # last emitted value per agg [C]
+
+    def tree_flatten(self):
+        return ((self.table, self.agg_states, self.row_count, self.dirty,
+                 self.prev_exists, self.prev_emit), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        table, agg_states, row_count, dirty, prev_exists, prev_emit = children
+        return cls(table, tuple(agg_states), row_count, dirty,
+                   prev_exists, tuple(prev_emit))
+
+
+class HashAggExecutor(Executor):
+    def __init__(self, input: Executor, group_key_indices: Sequence[int],
+                 agg_calls: Sequence[AggCall], capacity: int = 1 << 16,
+                 state_table: Optional[StateTable] = None,
+                 group_key_names: Optional[Sequence[str]] = None,
+                 cleaning_watermark_col: Optional[int] = None):
+        self.input = input
+        self.group_key_indices = tuple(group_key_indices)
+        self.agg_calls = tuple(agg_calls)
+        self.specs = tuple(c.spec() for c in agg_calls)
+        for c in agg_calls:
+            if c.kind in (AggKind.MIN, AggKind.MAX) and not c.append_only:
+                raise NotImplementedError(
+                    "retractable min/max needs materialized-input state")
+        in_schema = input.schema
+        gk_names = list(group_key_names or
+                        [in_schema[i].name for i in self.group_key_indices])
+        self.schema = Schema(tuple(
+            [Field(n, in_schema[i].data_type)
+             for n, i in zip(gk_names, self.group_key_indices)]
+            + [Field(f"agg{j}", c.ret_type) for j, c in enumerate(agg_calls)]))
+        self.pk_indices = tuple(range(len(self.group_key_indices)))
+        self.capacity = capacity
+        self.state_table = state_table
+        # Watermark state cleaning (reference: StateTable::update_watermark
+        # state_table.rs:1029 -> Hummock table watermarks): groups whose
+        # watermark-column key falls below the watermark can never be touched
+        # again, so their state is zeroed on device at the barrier. The slot
+        # stays occupied (probe chains intact) until a rebuild purges it.
+        # `cleaning_watermark_col` is an INPUT column index and must be one
+        # of the group keys.
+        self.cleaning_watermark_key: Optional[int] = (
+            None if cleaning_watermark_col is None
+            else self.group_key_indices.index(cleaning_watermark_col))
+        self._pending_clean_wm: Optional[int] = None
+        self.identity = f"HashAgg(keys={self.group_key_indices})"
+        self._key_dtypes = tuple(
+            in_schema[i].data_type.jnp_dtype for i in self.group_key_indices)
+        self.state = self._empty_state(capacity)
+        self._apply = jax.jit(self._apply_impl)
+        self._flush = jax.jit(self._flush_impl)
+        self._live_zombie = jax.jit(self._live_zombie_impl)
+        self._evict = jax.jit(self._evict_impl)
+        self._rehash = jax.jit(self._rehash_impl, static_argnums=1)
+        # load/overflow watchdog (see _drain_telemetry)
+        self.rebuilds = 0
+        self._occ_known = 0
+        self._telemetry: deque = deque()
+        self._applied_since_flush = False
+
+    # ------------------------------------------------------------ state
+    def _empty_state(self, capacity: int) -> AggState:
+        table = HashTable.empty(capacity, self._key_dtypes)
+        return AggState(
+            table=table,
+            agg_states=tuple(s.init_state((capacity,)) for s in self.specs),
+            row_count=jnp.zeros(capacity, dtype=jnp.int64),
+            dirty=jnp.zeros(capacity, dtype=bool),
+            prev_exists=jnp.zeros(capacity, dtype=bool),
+            prev_emit=tuple(
+                jnp.zeros(capacity, dtype=c.ret_type.jnp_dtype)
+                for c in self.agg_calls),
+        )
+
+    # ------------------------------------------------------- chunk apply
+    def _apply_impl(self, state: AggState, chunk: StreamChunk):
+        key_cols = [chunk.columns[i].data for i in self.group_key_indices]
+        table, slots, n_unresolved = lookup_or_insert(
+            state.table, key_cols, chunk.vis)
+        C = table.capacity
+        ok = slots >= 0
+        # segment id per row; trash segment C for masked rows
+        seg = jnp.where(ok, slots, C)
+        signs = jnp.where(ok, op_sign(chunk.ops), 0)
+        row_count = state.row_count + jax.ops.segment_sum(
+            signs.astype(jnp.int64), seg, C + 1)[:C]
+        new_states = []
+        for spec, call, st in zip(self.specs, self.agg_calls, state.agg_states):
+            if call.arg is None:
+                values = jnp.zeros(chunk.capacity, dtype=st.dtype)
+                row_signs = signs
+            else:
+                col = chunk.columns[call.arg]
+                values = col.data
+                # NULL inputs don't contribute (reference strict agg semantics)
+                row_signs = jnp.where(col.valid_mask(), signs, 0)
+            part = spec.partial(values, row_signs, seg, C + 1)[:C]
+            new_states.append(spec.combine(st, part))
+        dirty = state.dirty.at[seg].set(True, mode="drop")
+        new_state = AggState(table, tuple(new_states), row_count, dirty,
+                             state.prev_exists, state.prev_emit)
+        # occupancy rides along so the host can watch table load without a
+        # blocking readback (fetched via copy_to_host_async)
+        occ = jnp.sum(table.occupied.astype(jnp.int32))
+        return new_state, n_unresolved, occ
+
+    # ---------------------------------------------------------- flush
+    def _flush_impl(self, state: AggState):
+        """Emit the barrier diff as one chunk of capacity 2*C with
+        interleaved UD/UI pairs; returns (state', chunk arrays...).
+
+        Compaction is a cumsum-scatter (O(C) scan), not a sort: dirty slot
+        with rank j lands at output positions 2j (old value) / 2j+1 (new)."""
+        C = state.table.capacity
+        exists_now = state.row_count > 0
+        dirty = state.dirty
+        rank = jnp.cumsum(dirty.astype(jnp.int32)) - 1   # rank among dirty
+        slot_ids = jnp.arange(C, dtype=jnp.int32)
+        # scatter: d_slot[j] = slot of j-th dirty entry (garbage past n_dirty)
+        d_slot = jnp.zeros(C, dtype=jnp.int32).at[
+            jnp.where(dirty, rank, C)].set(slot_ids, mode="drop")
+        n_dirty = jnp.sum(dirty.astype(jnp.int32))
+        existed = state.prev_exists[d_slot]
+        exists = exists_now[d_slot]
+        is_dirty = slot_ids < n_dirty
+
+        # output row j at positions 2j (old) and 2j+1 (new)
+        vis_old = is_dirty & existed            # UD or Delete
+        vis_new = is_dirty & exists             # UI or Insert
+        ops_old = jnp.where(exists, OP_UPDATE_DELETE, OP_DELETE)
+        ops_new = jnp.where(existed, OP_UPDATE_INSERT, OP_INSERT)
+
+        def interleave(a, b):
+            return jnp.stack([a, b], axis=1).reshape(2 * C)
+
+        out_ops = interleave(ops_old, ops_new).astype(jnp.int8)
+        out_vis = interleave(vis_old, vis_new)
+        out_cols = []
+        for tk in state.table.keys:
+            v = tk[d_slot]
+            out_cols.append(interleave(v, v))
+        new_emit = []
+        for spec, st, pe in zip(self.specs, state.agg_states, state.prev_emit):
+            cur = spec.emit(st)
+            new_emit.append(cur)
+            out_cols.append(interleave(pe[d_slot], cur[d_slot]))
+
+        prev_exists = exists_now
+        prev_emit = tuple(new_emit)
+        state2 = AggState(state.table, state.agg_states, state.row_count,
+                          jnp.zeros(C, dtype=bool), prev_exists, prev_emit)
+        return state2, tuple(out_cols), out_ops, out_vis
+
+    def _live_zombie_impl(self, state: AggState):
+        occ = jnp.sum(state.table.occupied.astype(jnp.int32))
+        live = jnp.sum((state.row_count > 0).astype(jnp.int32))
+        return occ, live
+
+    def _evict_impl(self, state: AggState, watermark) -> AggState:
+        """Zero out groups below the state-cleaning watermark. Slots remain
+        occupied zombies (chain-safe); rebuilds reclaim them later."""
+        j = self.cleaning_watermark_key
+        evict = state.table.occupied & (state.table.keys[j] < watermark)
+        keep = ~evict
+        return AggState(
+            table=state.table,
+            agg_states=tuple(
+                jnp.where(keep, s, spec.init)
+                for s, spec in zip(state.agg_states, self.specs)),
+            row_count=jnp.where(keep, state.row_count, 0),
+            dirty=state.dirty & keep,
+            prev_exists=state.prev_exists & keep,
+            prev_emit=tuple(jnp.where(keep, p, 0) for p in state.prev_emit),
+        )
+
+    def _rehash_impl(self, state: AggState, new_capacity: int) -> AggState:
+        """Device-side rebuild: re-insert surviving groups into a fresh
+        table of `new_capacity` slots. Pure XLA — no host roundtrip; only a
+        capacity CHANGE triggers a recompile (distinct static shape)."""
+        keep = state.table.occupied & (
+            (state.row_count > 0) | (state.dirty & state.prev_exists))
+        fresh = HashTable.empty(new_capacity, self._key_dtypes)
+        # compact surviving entries to the front so insertion order is dense
+        C = state.table.capacity
+        rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        sel = jnp.zeros(C, dtype=jnp.int32).at[
+            jnp.where(keep, rank, C)].set(jnp.arange(C, dtype=jnp.int32),
+                                          mode="drop")
+        n_keep = jnp.sum(keep.astype(jnp.int32))
+        active = jnp.arange(C) < n_keep
+        key_cols = [tk[sel] for tk in state.table.keys]
+        table, slots, n_un = lookup_or_insert(fresh, key_cols, active)
+        # n_un must be 0 by construction (new_capacity >= live set)
+        tgt = jnp.where(active, slots, new_capacity)
+        empty = self._empty_state(new_capacity)
+        return AggState(
+            table=table,
+            agg_states=tuple(
+                es.at[tgt].set(os[sel], mode="drop")
+                for es, os in zip(empty.agg_states, state.agg_states)),
+            row_count=empty.row_count.at[tgt].set(state.row_count[sel], mode="drop"),
+            dirty=empty.dirty.at[tgt].set(state.dirty[sel], mode="drop"),
+            prev_exists=empty.prev_exists.at[tgt].set(state.prev_exists[sel], mode="drop"),
+            prev_emit=tuple(
+                ep.at[tgt].set(op[sel], mode="drop")
+                for ep, op in zip(empty.prev_emit, state.prev_emit)),
+        )
+
+    # --------------------------------------------------------- rebuild
+    def _rebuild(self, new_capacity: int) -> int:
+        """Purge zombies / grow via the device-side rehash.
+        Returns the rebuilt occupancy (one readback — rebuilds are rare)."""
+        self._drain_telemetry(block=True)
+        self.state = self._rehash(self.state, new_capacity)
+        self.capacity = new_capacity
+        self.rebuilds += 1
+        occ, _ = self._live_zombie(self.state)
+        return int(occ)
+
+    def _drain_telemetry(self, block: bool = False) -> None:
+        """Consume async-fetched (n_unresolved, occupied) scalars from past
+        applies. Device->host readbacks through the TPU tunnel cost ~100ms
+        when they block, so applies push these with copy_to_host_async and
+        the host pops only entries whose transfer already landed
+        (`is_ready`) — the steady-state loop never blocks on the device.
+
+        Overflow therefore surfaces ~one RTT after the offending chunk:
+        fail-stop before the NEXT checkpoint commits, and exactly-once
+        recovery replays from the last committed epoch (the same contract
+        as any executor failure, SURVEY.md §3.5). Capacity provisioning +
+        barrier-time growth make this a last-resort watchdog."""
+        while self._telemetry:
+            n_un, occ = self._telemetry[0]
+            if not block and not (n_un.is_ready() and occ.is_ready()):
+                break
+            self._telemetry.popleft()
+            n_un = int(np.asarray(n_un))
+            if n_un:
+                raise RuntimeError(
+                    f"hash-agg table overflow mid-epoch ({n_un} rows, "
+                    f"capacity {self.capacity}); recovery must replay the "
+                    f"epoch with a larger table")
+            self._occ_known = int(np.asarray(occ))
+
+    def _maybe_rebuild_at_barrier(self) -> None:
+        """Barrier-time growth: the table is examined between epochs, when
+        lagged occupancy knowledge is safe to act on. Crossing the high
+        watermark purges zombies (dead windows/groups) or doubles capacity;
+        both re-jit the apply step, which is why it never happens mid-epoch."""
+        self._drain_telemetry()
+        if self._occ_known <= 0.7 * self.capacity:
+            return
+        self._drain_telemetry(block=True)
+        occ, live = self._live_zombie(self.state)
+        rebuild, cap = needs_rebuild(int(occ), int(live), self.capacity)
+        if rebuild:
+            self._occ_known = self._rebuild(cap)
+
+    # ------------------------------------------------------- persistence
+    def _persist(self, barrier: Barrier) -> None:
+        if self.state_table is None:
+            return
+        if not self._applied_since_flush:
+            self.state_table.commit(barrier.epoch.curr)
+            return
+        cols, ops, vis = self._flush_persist_view()
+        # rows: group key + agg outputs + hidden row_count
+        n = int(np.asarray(vis.sum()))
+        if n:
+            cols_np = [np.asarray(c)[np.asarray(vis)] for c in cols]
+            ops_np = np.asarray(ops)[np.asarray(vis)]
+            rows = []
+            for r in range(n):
+                rows.append((int(ops_np[r]), tuple(c[r].item() for c in cols_np)))
+            self.state_table.write_chunk_rows(rows)
+        self.state_table.commit(barrier.epoch.curr)
+
+    def _flush_persist_view(self):
+        """The state rows that changed this epoch (computed pre-flush)."""
+        # persisted row = keys ++ raw agg states ++ row_count; same
+        # cumsum-compaction as the flush step.
+        st = self.state
+        C = st.table.capacity
+        exists_now = st.row_count > 0
+        rank = jnp.cumsum(st.dirty.astype(jnp.int32)) - 1
+        slot_ids = jnp.arange(C, dtype=jnp.int32)
+        d_slot = jnp.zeros(C, dtype=jnp.int32).at[
+            jnp.where(st.dirty, rank, C)].set(slot_ids, mode="drop")
+        n_dirty = jnp.sum(st.dirty.astype(jnp.int32))
+        is_dirty = slot_ids < n_dirty
+        exists = exists_now[d_slot]
+        existed = st.prev_exists[d_slot]
+        vis = is_dirty & (exists | existed)
+        ops = jnp.where(exists, OP_INSERT, OP_DELETE).astype(jnp.int8)
+        cols = [tk[d_slot] for tk in st.table.keys]
+        cols += [s[d_slot] for s in st.agg_states]
+        cols.append(st.row_count[d_slot])
+        return cols, ops, vis
+
+    def recover(self, barrier_epoch: int) -> None:
+        """Rebuild device state from the state table (recovery path)."""
+        if self.state_table is None:
+            return
+        rows = [r for _, r in self.state_table.iter_all()]
+        if not rows:
+            return
+        nk = len(self.group_key_indices)
+        key_cols = [
+            jnp.asarray(np.asarray([r[j] for r in rows],
+                                   dtype=np.dtype(self._key_dtypes[j])))
+            for j in range(nk)]
+        active = jnp.ones(len(rows), dtype=bool)
+        table, slots, n_un = lookup_or_insert(
+            HashTable.empty(self.capacity, self._key_dtypes), key_cols, active)
+        assert int(n_un) == 0
+        st = self._empty_state(self.capacity)
+        agg_states = []
+        for j, spec in enumerate(self.specs):
+            vals = jnp.asarray(np.asarray([r[nk + j] for r in rows]))
+            agg_states.append(
+                st.agg_states[j].at[slots].set(vals.astype(st.agg_states[j].dtype)))
+        counts = jnp.asarray(np.asarray([r[nk + len(self.specs)] for r in rows],
+                                        dtype=np.int64))
+        emits = tuple(
+            st.prev_emit[j].at[slots].set(
+                spec.emit(agg_states[j])[slots])
+            for j, spec in enumerate(self.specs))
+        self.state = AggState(
+            table=table,
+            agg_states=tuple(agg_states),
+            row_count=st.row_count.at[slots].set(counts),
+            dirty=jnp.zeros(self.capacity, dtype=bool),
+            prev_exists=st.prev_exists.at[slots].set(True),
+            prev_emit=emits,
+        )
+        self._occ_known = len(rows)
+
+    # ----------------------------------------------------------- stream
+    async def execute(self):
+        first = True
+        async for msg in self.input.execute():
+            if isinstance(msg, StreamChunk):
+                self._drain_telemetry()
+                self.state, n_unresolved, occ = self._apply(self.state, msg)
+                n_unresolved.copy_to_host_async()
+                occ.copy_to_host_async()
+                self._telemetry.append((n_unresolved, occ))
+                self._applied_since_flush = True
+            elif isinstance(msg, Barrier):
+                if first or msg.kind is BarrierKind.INITIAL:
+                    first = False
+                    if self.state_table is not None:
+                        self.state_table.init_epoch(msg.epoch.curr)
+                        self.recover(msg.epoch.curr)
+                    yield msg
+                    continue
+                self._persist(msg)
+                if self._applied_since_flush:
+                    self._applied_since_flush = False
+                    self.state, cols, ops, vis = self._flush(self.state)
+                    yield StreamChunk(
+                        tuple(Column(c) for c in cols), ops, vis, self.schema)
+                    if (self.cleaning_watermark_key is not None
+                            and self._pending_clean_wm is not None):
+                        self.state = self._evict(self.state, self._pending_clean_wm)
+                        self._pending_clean_wm = None
+                    self._maybe_rebuild_at_barrier()
+                yield msg
+            else:
+                # watermarks on group-key columns pass through re-indexed;
+                # others are consumed (reference: watermark inference)
+                wm: Watermark = msg
+                if wm.col_idx in self.group_key_indices:
+                    pos = self.group_key_indices.index(wm.col_idx)
+                    if pos == self.cleaning_watermark_key:
+                        self._pending_clean_wm = wm.val
+                    yield wm.with_idx(pos)

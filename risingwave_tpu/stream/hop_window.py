@@ -1,0 +1,76 @@
+"""HopWindow executor — stateless sliding-window expansion.
+
+Reference: src/stream/src/executor/hop_window.rs:386 — each input row is
+emitted once per window it falls into (window_size / window_slide copies)
+with computed window_start / window_end columns appended; pure map, no
+state. Here each copy is its own output chunk (same static capacity as the
+input — XLA-friendly), emitted back-to-back: copy k shifts the aligned
+window start back by k slides.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..common.chunk import Column, StreamChunk
+from ..common.types import DataType, Field, Schema
+from .executor import Executor, StatelessUnaryExecutor
+from .message import Watermark
+
+
+class HopWindowExecutor(StatelessUnaryExecutor):
+    def __init__(self, input: Executor, time_col: int,
+                 window_slide_us: int, window_size_us: int,
+                 output_indices: Sequence[int] | None = None):
+        super().__init__(input)
+        assert window_size_us > 0 and window_slide_us > 0
+        self.time_col = time_col
+        self.slide = window_slide_us
+        self.size = window_size_us
+        self.n_windows = math.ceil(window_size_us / window_slide_us)
+        in_fields = list(input.schema)
+        self.schema = Schema(tuple(
+            in_fields + [Field("window_start", DataType.TIMESTAMP),
+                         Field("window_end", DataType.TIMESTAMP)]))
+        self.window_start_idx = len(in_fields)
+        self.window_end_idx = len(in_fields) + 1
+        self.identity = (f"HopWindow(col={time_col}, slide={window_slide_us}us, "
+                         f"size={window_size_us}us)")
+        self._step = jax.jit(self._step_impl, static_argnums=1)
+
+    def _step_impl(self, chunk: StreamChunk, k: int) -> StreamChunk:
+        ts = chunk.columns[self.time_col].data
+        # aligned window containing ts, shifted back k slides. floor-div
+        # handles negative timestamps correctly (pre-epoch event time).
+        ws = (jnp.floor_divide(ts, self.slide) - k) * self.slide
+        we = ws + self.size
+        # row in window iff ws <= ts < we; ws <= ts always holds, the upper
+        # bound can fail when slide does not divide size
+        vis = chunk.vis & (ts < we)
+        cols = chunk.columns + (Column(ws), Column(we))
+        return StreamChunk(cols, chunk.ops, vis, self.schema)
+
+    async def execute(self):
+        async for msg in self.input.execute():
+            if isinstance(msg, StreamChunk):
+                for k in range(self.n_windows):
+                    yield self._step(msg, k)
+            elif isinstance(msg, Watermark):
+                wm = self.map_watermark(msg)
+                if wm is not None:
+                    yield wm
+            else:
+                yield msg
+
+    def map_watermark(self, wm: Watermark):
+        if wm.col_idx == self.time_col:
+            # a watermark on event time implies one on window_start lagged
+            # by the full window size (reference derives the same bound)
+            ws = (wm.val // self.slide - (self.n_windows - 1)) * self.slide
+            return Watermark(self.window_start_idx, DataType.TIMESTAMP, ws)
+        return wm

@@ -31,7 +31,8 @@ class SourceExecutor(Executor):
     def __init__(self, source_id: int, connector: Connector,
                  barrier_queue: "asyncio.Queue[Barrier]",
                  state_table: Optional[StateTable] = None,
-                 rate_limit_rows_per_barrier: Optional[int] = None):
+                 rate_limit_rows_per_barrier: Optional[int] = None,
+                 emit_watermarks: bool = False):
         self.source_id = source_id
         self.connector = connector
         self.schema = connector.schema
@@ -40,6 +41,11 @@ class SourceExecutor(Executor):
         self.rate_limit = rate_limit_rows_per_barrier
         self.identity = f"Source({source_id})"
         self.paused = False
+        # Connector-declared watermarks (reference: WATERMARK FOR clause on
+        # sources + WatermarkFilterExecutor). The connector computes them on
+        # host (no device readback); the source emits after each chunk.
+        self.emit_watermarks = emit_watermarks and hasattr(connector, "current_watermark")
+        self._last_wm: Optional[int] = None
 
     def _recover_offset(self) -> None:
         if self.state_table is None:
@@ -100,6 +106,14 @@ class SourceExecutor(Executor):
                 # throttled sources are not the hot path)
                 sent_this_interval += chunk.num_rows_host()
             yield chunk
+            if self.emit_watermarks:
+                wm = self.connector.current_watermark()
+                if self._last_wm is None or wm > self._last_wm:
+                    self._last_wm = wm
+                    from ..common.types import DataType
+                    from .message import Watermark
+                    yield Watermark(self.connector.watermark_col,
+                                    DataType.TIMESTAMP, wm)
             # let barriers/other actors in
             await asyncio.sleep(0)
 

@@ -1,0 +1,73 @@
+"""Instrument one q5 bench round to find where wall time goes."""
+
+import asyncio
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax
+import numpy as np
+
+from risingwave_tpu.connectors import NexmarkGenerator
+from risingwave_tpu.connectors.nexmark import NexmarkConfig
+from risingwave_tpu.expr.agg import count_star
+from risingwave_tpu.meta import BarrierCoordinator
+from risingwave_tpu.state import MemoryStateStore
+from risingwave_tpu.stream import (
+    Actor, HashAggExecutor, HopWindowExecutor, SourceExecutor,
+)
+from risingwave_tpu.common.chunk import StreamChunk
+from risingwave_tpu.stream.executor import Executor
+
+T0 = time.perf_counter()
+
+
+def log(msg):
+    print(f"[{time.perf_counter()-T0:8.3f}] {msg}", flush=True)
+
+
+async def main():
+    store = MemoryStateStore()
+    barrier_q = asyncio.Queue()
+    gen = NexmarkGenerator("bid", chunk_size=16384,
+                           cfg=NexmarkConfig(inter_event_us=1000))
+    src = SourceExecutor(1, gen, barrier_q)
+    hop = HopWindowExecutor(src, time_col=5, window_slide_us=2_000_000,
+                            window_size_us=10_000_000)
+    agg = HashAggExecutor(hop, group_key_indices=[0, hop.window_start_idx],
+                          agg_calls=[count_star(append_only=True)],
+                          capacity=1 << 16)
+
+    class Sink(Executor):
+        def __init__(self, input):
+            self.input = input
+            self.schema = input.schema
+            self.n_chunks = 0
+
+        async def execute(self):
+            async for msg in self.input.execute():
+                if isinstance(msg, StreamChunk):
+                    self.n_chunks += 1
+                    log(f"  sink chunk #{self.n_chunks} cap={msg.capacity}")
+                yield msg
+
+    sink = Sink(agg)
+    coord = BarrierCoordinator(store)
+    coord.register_source(barrier_q)
+    coord.register_actor(1)
+    task = Actor(1, sink, None, coord).spawn()
+
+    for i in range(6):
+        log(f"round {i} inject")
+        b = await coord.inject_barrier() if i else await coord.inject_barrier(
+            kind=__import__("risingwave_tpu.stream.message", fromlist=["BarrierKind"]).BarrierKind.INITIAL)
+        await coord.wait_collected(b)
+        log(f"round {i} collected")
+    await coord.stop_all({1})
+    await task
+    log(f"done offset={gen.offset}")
+
+
+asyncio.run(main())

@@ -1,0 +1,64 @@
+"""Microbenchmark the q5 pipeline pieces on the current jax backend."""
+
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax
+import numpy as np
+
+from risingwave_tpu.connectors import NexmarkGenerator
+from risingwave_tpu.connectors.nexmark import NexmarkConfig
+from risingwave_tpu.expr.agg import count_star
+from risingwave_tpu.stream import HashAggExecutor, HopWindowExecutor
+from risingwave_tpu.stream.executor import Executor
+
+
+class Dummy(Executor):
+    def __init__(self, schema):
+        self.schema = schema
+
+
+def t(label, f, n=20):
+    f()  # warmup/compile
+    jax.block_until_ready(f())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = f()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{label:35s} {dt*1e3:9.3f} ms")
+    return dt
+
+
+def main(chunk_size=16384):
+    print("devices:", jax.devices())
+    gen = NexmarkGenerator("bid", chunk_size=chunk_size,
+                           cfg=NexmarkConfig(inter_event_us=1000))
+    chunk = gen.next_chunk()
+    t("source gen", lambda: gen.next_chunk())
+
+    hop = HopWindowExecutor(Dummy(gen.schema), time_col=5,
+                            window_slide_us=2_000_000, window_size_us=10_000_000)
+    t("hop step (1 of 5 windows)", lambda: hop._step(chunk, 0))
+    hchunk = hop._step(chunk, 0)
+
+    agg = HashAggExecutor(Dummy(hop.schema), group_key_indices=[0, hop.window_start_idx],
+                          agg_calls=[count_star(append_only=True)], capacity=1 << 16)
+    d_apply = t("agg apply (16k rows)", lambda: agg._apply(agg.state, hchunk))
+    st, n_un, occ = agg._apply(agg.state, hchunk)
+    print("  unresolved:", int(n_un), " occupied:", int(occ))
+    agg.state = st
+    d_flush = t("agg flush", lambda: agg._flush(agg.state), n=5)
+    d_lz = t("live/zombie check", lambda: agg._live_zombie(agg.state))
+
+    total_per_chunk = 5 * (0 + d_apply) + 0  # 5 hop windows each applied
+    print(f"\nestimated apply-only throughput: "
+          f"{chunk_size / (5 * d_apply):,.0f} rows/s")
+    print(f"flush per barrier: {d_flush*1e3:.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Device open-addressing hash table kernel tests."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from risingwave_tpu.ops.hash_table import (
+    HashTable, lookup, lookup_or_insert, needs_rebuild,
+)
+
+
+def test_insert_then_lookup():
+    t = HashTable.empty(64, [jnp.int64])
+    keys = jnp.asarray([5, 17, 5, 99, 17, 5], dtype=jnp.int64)
+    active = jnp.ones(6, dtype=bool)
+    t, slots, n_un = lookup_or_insert(t, [keys], active)
+    assert int(n_un) == 0
+    slots = np.asarray(slots)
+    # identical keys share a slot; distinct keys don't
+    assert slots[0] == slots[2] == slots[5]
+    assert slots[1] == slots[4]
+    assert len({slots[0], slots[1], slots[3]}) == 3
+    # read-only lookup agrees
+    got = np.asarray(lookup(t, [jnp.asarray([99, 5, 1234], dtype=jnp.int64)],
+                            jnp.ones(3, dtype=bool)))
+    assert got[0] == slots[3]
+    assert got[1] == slots[0]
+    assert got[2] == -1  # absent key
+
+
+def test_inactive_rows_ignored():
+    t = HashTable.empty(16, [jnp.int64])
+    keys = jnp.asarray([1, 2, 3, 4], dtype=jnp.int64)
+    active = jnp.asarray([True, False, True, False])
+    t, slots, n_un = lookup_or_insert(t, [keys], active)
+    assert int(n_un) == 0
+    slots = np.asarray(slots)
+    assert slots[1] == -1 and slots[3] == -1
+    assert int(t.occupied.sum()) == 2
+
+
+def test_collision_chains():
+    # tiny table forces heavy collisions; all 12 distinct keys must fit
+    t = HashTable.empty(16, [jnp.int64])
+    keys = jnp.arange(12, dtype=jnp.int64) * 1000
+    t, slots, n_un = lookup_or_insert(t, [keys], jnp.ones(12, dtype=bool))
+    assert int(n_un) == 0
+    assert len(set(np.asarray(slots).tolist())) == 12
+    # every key still findable
+    got = np.asarray(lookup(t, [keys], jnp.ones(12, dtype=bool)))
+    np.testing.assert_array_equal(got, np.asarray(slots))
+
+
+def test_overflow_reported():
+    t = HashTable.empty(8, [jnp.int64])
+    keys = jnp.arange(12, dtype=jnp.int64)  # 12 distinct keys, 8 slots
+    t, slots, n_un = lookup_or_insert(t, [keys], jnp.ones(12, dtype=bool))
+    assert int(n_un) == 4  # exactly the overflow
+
+
+def test_multi_column_keys():
+    t = HashTable.empty(64, [jnp.int64, jnp.int32])
+    a = jnp.asarray([1, 1, 2, 2], dtype=jnp.int64)
+    b = jnp.asarray([10, 20, 10, 10], dtype=jnp.int32)
+    t, slots, n_un = lookup_or_insert(t, [a, b], jnp.ones(4, dtype=bool))
+    assert int(n_un) == 0
+    slots = np.asarray(slots)
+    assert slots[2] == slots[3]          # (2,10) == (2,10)
+    assert len({slots[0], slots[1], slots[2]}) == 3
+
+
+def test_needs_rebuild_policy():
+    assert needs_rebuild(10, 10, 100) == (False, 100)
+    # zombie-heavy: purge at same capacity
+    assert needs_rebuild(80, 10, 100) == (True, 100)
+    # live-heavy: grow
+    assert needs_rebuild(80, 60, 100) == (True, 200)

@@ -1,0 +1,81 @@
+"""HopWindow: each row lands in size/slide windows with correct bounds.
+
+Reference: hop_window.rs:386 (window expansion semantics).
+"""
+
+import asyncio
+
+import numpy as np
+
+from risingwave_tpu.common import DataType, schema
+from risingwave_tpu.common.chunk import StreamChunk
+from risingwave_tpu.common.epoch import EpochPair
+from risingwave_tpu.stream import Barrier, BarrierKind, HopWindowExecutor, Watermark
+from risingwave_tpu.stream.executor import Executor
+
+SCHEMA = schema(("id", DataType.INT64), ("ts", DataType.TIMESTAMP))
+
+
+class ScriptSource(Executor):
+    def __init__(self, sch, messages):
+        self.schema = sch
+        self.messages = messages
+
+    async def execute(self):
+        for m in self.messages:
+            yield m
+            await asyncio.sleep(0)
+
+
+async def collect(executor):
+    out = []
+    async for m in executor.execute():
+        out.append(m)
+    return out
+
+
+async def test_hop_expansion():
+    # slide 2s, size 10s -> 5 windows per row
+    ids = np.asarray([1, 2], dtype=np.int64)
+    ts = np.asarray([10_000_000, 11_999_999], dtype=np.int64)  # us
+    c = StreamChunk.from_numpy(SCHEMA, [ids, ts], capacity=8)
+    hop = HopWindowExecutor(ScriptSource(SCHEMA, [c]), time_col=1,
+                            window_slide_us=2_000_000, window_size_us=10_000_000)
+    out = [m for m in await collect(hop) if isinstance(m, StreamChunk)]
+    assert len(out) == 5
+    rows = [r for ch in out for r in ch.to_rows()]
+    # row 1 (ts=10s): windows starting at 2,4,6,8,10 (each [ws, ws+10s))
+    ws_row1 = sorted(r[1][2] for r in rows if r[1][0] == 1)
+    assert ws_row1 == [2_000_000, 4_000_000, 6_000_000, 8_000_000, 10_000_000]
+    # row 2 (ts=11.999999s): windows starting at 2,4,6,8,10
+    ws_row2 = sorted(r[1][2] for r in rows if r[1][0] == 2)
+    assert ws_row2 == [2_000_000, 4_000_000, 6_000_000, 8_000_000, 10_000_000]
+    # window_end = start + size, and every window contains its row's ts
+    for op, (rid, rts, ws, we) in rows:
+        assert we == ws + 10_000_000
+        assert ws <= rts < we
+
+
+async def test_hop_non_divisible_masks():
+    # slide 3s, size 5s -> ceil(5/3)=2 windows, second sometimes invalid
+    ids = np.asarray([1], dtype=np.int64)
+    ts = np.asarray([8_000_000], dtype=np.int64)  # aligned start = 6s
+    c = StreamChunk.from_numpy(SCHEMA, [ids, ts], capacity=4)
+    hop = HopWindowExecutor(ScriptSource(SCHEMA, [c]), time_col=1,
+                            window_slide_us=3_000_000, window_size_us=5_000_000)
+    out = [m for m in await collect(hop) if isinstance(m, StreamChunk)]
+    rows = [r for ch in out for r in ch.to_rows()]
+    # windows: start 6s [6,11) contains 8s -> valid; start 3s [3,8) excludes 8s
+    assert [(r[1][2], r[1][3]) for r in rows] == [(6_000_000, 11_000_000)]
+
+
+async def test_hop_watermark_transform():
+    hop = HopWindowExecutor(
+        ScriptSource(SCHEMA, [Watermark(1, DataType.TIMESTAMP, 20_000_000)]),
+        time_col=1, window_slide_us=2_000_000, window_size_us=10_000_000)
+    out = await collect(hop)
+    assert len(out) == 1
+    wm = out[0]
+    assert wm.col_idx == 2  # window_start column
+    # all windows with start <= 12s are complete once ts watermark is 20s
+    assert wm.val == (20_000_000 // 2_000_000 - 4) * 2_000_000
