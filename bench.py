@@ -3,13 +3,19 @@
 Measures Nexmark pipeline throughput (rows/sec/chip) on the current jax
 backend. Workload definitions mirror the reference's Nexmark SQL set
 (/root/reference/ci/scripts/sql/nexmark/q*.sql); the metric matches the
-reference's `stream_source_output_rows_counts` rate (BASELINE.md).
+reference's `stream_source_output_rows_counts` rate and the barrier-latency
+histogram (BASELINE.md; grafana/risingwave-dev-dashboard.dashboard.py:693-715,
+894-901).
 
-vs_baseline is measured against REF_CPU_ROWS_PER_SEC, an anchor for the
-reference's single-core CPU executor throughput on the same query shape
-(the reference publishes no absolute numbers — BASELINE.md — so the anchor
-is an order-of-magnitude estimate for one CPU core; the honest comparison
-is the recorded absolute rows/sec trend across rounds).
+vs_baseline is MEASURED: the same pipeline is run through a vectorized numpy
+host implementation (the stand-in for the reference's single-core CPU
+executor — the reference publishes no absolute numbers, BASELINE.md) on the
+same generated rows, and vs_baseline = device rows/s / numpy rows/s.
+
+Robustness contract (round-1 post-mortem: rc=124, no number recorded): the
+measurement loop is time-bounded, the whole bench runs under a hard deadline,
+and partial progress is emitted if anything hangs — a regression degrades the
+number instead of zeroing the round.
 """
 
 import asyncio
@@ -17,30 +23,133 @@ import json
 import sys
 import time
 
+import numpy as np
 
-# Anchor: RisingWave-class engines sustain ~1-2M rows/s/core on stateless
-# Nexmark q1-shaped plans; stateful q5/q7 are several times lower. Per-query
-# anchors keep vs_baseline comparable as the benched query upgrades.
-REF_CPU_ROWS_PER_SEC = {
-    "q1": 2.0e6,
-    "q5": 5.0e5,
-    "q7": 5.0e5,
-    "q8": 5.0e5,
-}
+# Hard wall-clock budget for the whole bench (driver timeouts are larger;
+# this guarantees a JSON line is printed well before any external timeout).
+GLOBAL_BUDGET_S = 300.0
+# Target duration of the timed measurement region per query.
+MEASURE_S = 12.0
 
 
-async def bench_q1(rounds: int = 20, chunk_size: int = 32768) -> dict:
-    from risingwave_tpu.common import DataType, schema
+# ---------------------------------------------------------------- numpy CPU
+# Host-side vectorized implementations of the same query shapes, the
+# vs_baseline denominator. They consume the same generator chunks (as numpy)
+# and maintain the same state, the way the reference's vectorized CPU
+# executors would.
+
+def _numpy_q1(chunks) -> float:
+    t0 = time.perf_counter()
+    acc = 0.0
+    for cols, vis in chunks:
+        price = cols[2] * 0.908
+        acc += float(price[vis].sum())  # force the work
+    return time.perf_counter() - t0
+
+
+def _numpy_q5(chunks, slide_us=2_000_000, size_us=10_000_000) -> float:
+    """Incremental hash-agg state as a sorted (keys, counts) pair, updated
+    with fully vectorized merges — the numpy analogue of a vectorized CPU
+    HashAgg executor (no per-row interpreter loops)."""
+    t0 = time.perf_counter()
+    state_keys = np.empty(0, dtype=np.int64)
+    state_counts = np.empty(0, dtype=np.int64)
+    k = size_us // slide_us
+    for cols, vis in chunks:
+        auction = cols[0][vis].astype(np.int64)
+        ts = cols[5][vis]
+        first = (ts // slide_us) * slide_us - (k - 1) * slide_us
+        keys = np.concatenate([
+            (auction << 20) ^ ((first + j * slide_us) // slide_us)
+            for j in range(k)])
+        uk, uc = np.unique(keys, return_counts=True)
+        idx = np.searchsorted(state_keys, uk)
+        safe = np.minimum(idx, max(len(state_keys) - 1, 0))
+        found = (idx < len(state_keys)) & (
+            state_keys[safe] == uk if len(state_keys) else False)
+        state_counts[idx[found]] += uc[found]
+        if not found.all():
+            nk, nc = uk[~found], uc[~found]
+            merged = np.concatenate([state_keys, nk])
+            order = np.argsort(merged, kind="stable")
+            state_keys = merged[order]
+            state_counts = np.concatenate([state_counts, nc])[order]
+    return time.perf_counter() - t0
+
+
+def _gen_numpy_chunks(kind: str, n_chunks: int, chunk_size: int, cfg=None):
+    """Materialize generator output as numpy (host baseline input)."""
+    from risingwave_tpu.connectors import NexmarkGenerator
+    kwargs = {} if cfg is None else {"cfg": cfg}
+    gen = NexmarkGenerator(kind, chunk_size=chunk_size, **kwargs)
+    out = []
+    for _ in range(n_chunks):
+        c = gen.next_chunk()
+        cols = [np.asarray(col.data) for col in c.columns]
+        out.append((cols, np.asarray(c.vis)))
+    return out
+
+
+# ------------------------------------------------------------------ device
+
+class _DeviceSink:
+    """Consume chunks without host readback (the bench measures the engine;
+    the reference's harness likewise reads source-side counters)."""
+
+    def __init__(self, input):
+        self.input = input
+        self.schema = input.schema
+        self.last = None
+
+    async def execute(self):
+        from risingwave_tpu.common.chunk import StreamChunk
+        async for msg in self.input.execute():
+            if isinstance(msg, StreamChunk):
+                self.last = msg.columns[-1].data
+            yield msg
+
+
+async def _measure(coord, gen, sink, progress: dict, measure_s: float,
+                   warmup_rounds: int = 2, interval_s: float = 0.0):
+    """Warmup (compile), then inject barriers one at a time until the
+    measured region reaches `measure_s`. Progress lands in `progress` after
+    every round so a deadline abort still reports a number."""
+    await coord.run_rounds(warmup_rounds)
+    # Drain the device queue before the timer starts: dispatch is async, so
+    # without this the measured region would begin with warmup (and compile)
+    # work still queued, and end-of-region sync would charge it to the run.
+    while sink.last is not None and not sink.last.is_ready():
+        await asyncio.sleep(0.01)
+    start_offset = gen.offset
+    t0 = time.perf_counter()
+    rounds = 0
+    while True:
+        if interval_s:
+            await asyncio.sleep(interval_s)
+        b = await coord.inject_barrier()
+        await coord.wait_collected(b)
+        rounds += 1
+        dt = time.perf_counter() - t0
+        progress["rows"] = gen.offset - start_offset
+        progress["seconds"] = dt
+        progress["rounds"] = rounds
+        progress["barrier_p50_s"] = coord.barrier_latency_percentile(0.5)
+        if dt >= measure_s:
+            break
+    if sink.last is not None:
+        sink.last.block_until_ready()
+    progress["seconds"] = time.perf_counter() - t0
+
+
+async def bench_q1(progress: dict) -> None:
+    from risingwave_tpu.common import DataType
     from risingwave_tpu.connectors import NexmarkGenerator
     from risingwave_tpu.expr import call, col, lit
     from risingwave_tpu.meta import BarrierCoordinator
-    from risingwave_tpu.state import MemoryStateStore, StateTable
-    from risingwave_tpu.stream import (
-        Actor, ProjectExecutor, SourceExecutor,
-    )
-    from risingwave_tpu.common.chunk import StreamChunk
-    from risingwave_tpu.stream.executor import Executor
+    from risingwave_tpu.state import MemoryStateStore
+    from risingwave_tpu.stream import Actor, ProjectExecutor, SourceExecutor
 
+    chunk_size = 32768
     store = MemoryStateStore()
     barrier_q = asyncio.Queue()
     gen = NexmarkGenerator("bid", chunk_size=chunk_size)
@@ -50,53 +159,33 @@ async def bench_q1(rounds: int = 20, chunk_size: int = 32768) -> dict:
         [col(0), col(1), call("multiply", col(2), lit(0.908)),
          col(5, DataType.TIMESTAMP)],
         names=["auction", "bidder", "price", "date_time"])
-
-    class DeviceSink(Executor):
-        """Consume chunks without leaving device (bench measures the
-        engine, not host materialization; the reference's bench harness
-        similarly reads source-side counters)."""
-
-        def __init__(self, input):
-            self.input = input
-            self.schema = input.schema
-            self.last = None
-
-        async def execute(self):
-            async for msg in self.input.execute():
-                if isinstance(msg, StreamChunk):
-                    self.last = msg.columns[2].data
-                yield msg
-
-    sink = DeviceSink(proj)
+    sink = _DeviceSink(proj)
     coord = BarrierCoordinator(store)
     coord.register_source(barrier_q)
     coord.register_actor(1)
     task = Actor(1, sink, None, coord).spawn()
-
-    # warmup (compile) round, then timed rounds
-    await coord.run_rounds(1)
-    start_offset = gen.offset
-    t0 = time.perf_counter()
-    await coord.run_rounds(rounds)
-    if sink.last is not None:
-        sink.last.block_until_ready()
-    dt = time.perf_counter() - t0
+    await _measure(coord, gen, sink, progress, MEASURE_S)
     await coord.stop_all({1})
     await task
-    rows = gen.offset - start_offset
-    return {
-        "query": "q1",
-        "rows": rows,
-        "seconds": dt,
-        "rows_per_sec": rows / dt,
-        "barrier_p50_s": coord.barrier_latency_percentile(0.5),
-    }
+
+    # measured host baseline on the same volume (capped to keep it cheap)
+    n_chunks = max(2, min(64, progress["rows"] // chunk_size))
+    chunks = _gen_numpy_chunks("bid", n_chunks, chunk_size)
+    base_dt = _numpy_q1(chunks)
+    progress["baseline_rows_per_sec"] = (n_chunks * chunk_size) / base_dt
 
 
-async def bench_q5(rounds: int = 8, chunk_size: int = 65536,
-                   interval_s: float = 0.5) -> dict:
+async def bench_q5(progress: dict) -> None:
     """q5 core: HOP(2s,10s) + count(*) GROUP BY (auction, window_start) —
-    the first stateful device pipeline (BASELINE config 2)."""
+    the first stateful device pipeline (BASELINE config 2).
+
+    Capacity 2^16: q5's live group set is bounded by watermark cleaning
+    (windows older than the event-time watermark are evicted every barrier),
+    so the table only has to hold the churn between purges — measured well
+    under 2^15 at this event rate. Round 1 shipped 2^21, which never
+    finished: lookup_or_insert's claim contest is O(capacity) per probe
+    iteration, so oversizing the table is catastrophically wrong, not safe.
+    """
     from risingwave_tpu.connectors import NexmarkGenerator
     from risingwave_tpu.connectors.nexmark import NexmarkConfig
     from risingwave_tpu.expr.agg import count_star
@@ -105,75 +194,72 @@ async def bench_q5(rounds: int = 8, chunk_size: int = 65536,
     from risingwave_tpu.stream import (
         Actor, HashAggExecutor, HopWindowExecutor, SourceExecutor,
     )
-    from risingwave_tpu.common.chunk import StreamChunk
-    from risingwave_tpu.stream.executor import Executor
 
+    chunk_size = 32768
+    cfg = NexmarkConfig(inter_event_us=2)
     store = MemoryStateStore()
     barrier_q = asyncio.Queue()
-    # event time advances so windows roll while state stays bounded
-    gen = NexmarkGenerator("bid", chunk_size=chunk_size,
-                           cfg=NexmarkConfig(inter_event_us=2))
+    gen = NexmarkGenerator("bid", chunk_size=chunk_size, cfg=cfg)
     src = SourceExecutor(1, gen, barrier_q, emit_watermarks=True)
     hop = HopWindowExecutor(src, time_col=5, window_slide_us=2_000_000,
                             window_size_us=10_000_000)
-    # q5 churns ~65k (auction, window) groups per 1M bids; capacity is sized
-    # for churn between purge rebuilds, watermark cleaning bounds the live set
     agg = HashAggExecutor(hop, group_key_indices=[0, hop.window_start_idx],
                           agg_calls=[count_star(append_only=True)],
-                          capacity=1 << 21,
+                          capacity=1 << 16,
                           cleaning_watermark_col=hop.window_start_idx)
-
-    class DeviceSink(Executor):
-        def __init__(self, input):
-            self.input = input
-            self.schema = input.schema
-            self.last = None
-
-        async def execute(self):
-            async for msg in self.input.execute():
-                if isinstance(msg, StreamChunk):
-                    self.last = msg.columns[-1].data
-                yield msg
-
-    sink = DeviceSink(agg)
+    sink = _DeviceSink(agg)
     coord = BarrierCoordinator(store)
     coord.register_source(barrier_q)
     coord.register_actor(1)
     task = Actor(1, sink, None, coord).spawn()
-
-    await coord.run_rounds(2)  # warmup: compile apply + flush
-    start_offset = gen.offset
-    t0 = time.perf_counter()
-    # barriers paced like the reference's cadence; chunks stream between them
-    await coord.run_rounds(rounds, interval_s=interval_s)
-    if sink.last is not None:
-        sink.last.block_until_ready()
-    dt = time.perf_counter() - t0
+    await _measure(coord, gen, sink, progress, MEASURE_S)
     await coord.stop_all({1})
     await task
-    rows = gen.offset - start_offset
-    return {
-        "query": "q5",
-        "rows": rows,
-        "seconds": dt,
-        "rows_per_sec": rows / dt,
-        "barrier_p50_s": coord.barrier_latency_percentile(0.5),
-    }
+
+    n_chunks = max(2, min(16, progress["rows"] // chunk_size))
+    chunks = _gen_numpy_chunks("bid", n_chunks, chunk_size, cfg=cfg)
+    base_dt = _numpy_q5(chunks)
+    progress["baseline_rows_per_sec"] = (n_chunks * chunk_size) / base_dt
 
 
 QUERIES = {"q1": bench_q1, "q5": bench_q5}
 
 
+def _emit(query: str, progress: dict, note: str = "") -> None:
+    rows = progress.get("rows", 0)
+    secs = progress.get("seconds", 0.0)
+    rps = rows / secs if secs > 0 else 0.0
+    base = progress.get("baseline_rows_per_sec")
+    out = {
+        "metric": f"nexmark_{query}_rows_per_sec_per_chip",
+        "value": round(rps, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(rps / base, 3) if base else None,
+        "barrier_p50_s": round(progress.get("barrier_p50_s", 0.0), 6),
+        "rows": rows,
+        "seconds": round(secs, 3),
+    }
+    if base:
+        out["baseline_rows_per_sec"] = round(base, 1)
+    if note:
+        out["note"] = note
+    print(json.dumps(out), flush=True)
+
+
 def main() -> None:
     query = sys.argv[1] if len(sys.argv) > 1 else "q5"
-    r = asyncio.run(QUERIES[query]())
-    value = r["rows_per_sec"]
-    print(json.dumps({
-        "metric": f"nexmark_{r['query']}_rows_per_sec_per_chip",
-        "value": round(value, 1),
-        "unit": "rows/s",
-        "vs_baseline": round(value / REF_CPU_ROWS_PER_SEC[r["query"]], 3),
-    }))
+    progress: dict = {}
+    note = ""
+    try:
+        asyncio.run(asyncio.wait_for(
+            QUERIES[query](progress), timeout=GLOBAL_BUDGET_S))
+    except asyncio.TimeoutError:
+        note = f"deadline {GLOBAL_BUDGET_S}s hit; partial measurement"
+    except Exception as e:  # noqa: BLE001 — a number beats a stack trace
+        note = f"error: {type(e).__name__}: {e}"
+    _emit(query, progress, note)
+    if note.startswith("error"):
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

@@ -1,23 +1,33 @@
-"""Device-resident open-addressing hash table — the state backbone of
-HashAgg and HashJoin.
+"""Device-resident bucketed hash table — the state backbone of HashAgg and
+HashJoin.
 
 Reference analogue: the executors' group/join hash maps (`JoinHashMap`,
 src/stream/src/executor/managed_state/join/mod.rs; `AggGroup` cache keyed by
 `HashKey`, hash_agg.rs:50-56). On TPU the map is a struct-of-arrays in HBM:
-fixed-capacity key columns + occupancy, probed with linear open addressing.
-The whole insert-or-lookup for a chunk is ONE compiled while_loop — no
-per-row host control flow.
+fixed-capacity key columns + occupancy.
 
-Parallel-insert race (two new keys landing on the same empty slot in the
-same probe round) resolves by scatter-min of row ids: the winner claims the
-slot, same-key losers match it on the next round, different-key losers
-advance. Rows advance past occupied non-matching slots (linear probing).
+Layout: capacity C = B buckets x S slots (S static). A key hashes to TWO
+candidate buckets (crc32 and a murmur-remix of it — power-of-two-choices);
+it lives in exactly one of their 2S slots. This shape is chosen for the
+hardware: a lookup is ONE vectorized [N, 2S] gather + compare — constant
+cost, no data-dependent probe loop — and an insert is two device sorts plus
+scatters. The previous design (linear open addressing driven by a
+`lax.while_loop` claim contest) had per-chunk cost proportional to the
+longest probe chain, which degrades sharply with load/clustering: a
+saturated table turned one chunk into an O(C)-iteration loop that stalled
+the device (observed: TPU watchdog killing the worker). Bounded bucket
+probing makes the worst case a constant.
 
-Deletion policy: slots are never freed (freeing breaks probe chains).
-Groups that empty out stay as zombies; the owner monitors live/zombie load
-via `needs_rebuild` and rebuilds (optionally growing) by re-inserting live
-entries — that is also the capacity-doubling growth path flagged in
-SURVEY.md §7 hard-parts (a).
+Two-choice balancing keeps bucket overflow improbable up to ~0.7 load
+(classic power-of-two-choices: max load ~ mean + lg lg B). Overflow is
+reported, never silent: `lookup_or_insert` returns `n_unresolved`, and the
+owning executor fail-stops / rebuilds larger (its existing policy).
+
+Within-bucket occupancy is a PREFIX: inserts append at the bucket's fill
+point and slots are never freed (groups that empty out stay as zombies;
+owners monitor live/zombie load via `needs_rebuild` and rebuild by
+re-inserting live entries — also the capacity-growth path flagged in
+SURVEY.md §7 hard-parts (a)).
 """
 
 from __future__ import annotations
@@ -29,6 +39,11 @@ import jax
 import jax.numpy as jnp
 
 from ..common.vnode import crc32_columns
+
+# Slots per bucket. 16 keeps the two-choice overflow probability negligible
+# at the 0.7 rebuild threshold while the [N, 2S] compare stays one small
+# vectorized gather per chunk.
+BUCKET_SLOTS = 16
 
 
 @jax.tree_util.register_pytree_node_class
@@ -53,15 +68,59 @@ class HashTable:
 
     @staticmethod
     def empty(capacity: int, key_dtypes: Sequence) -> "HashTable":
+        assert capacity % BUCKET_SLOTS == 0 and capacity >= 2 * BUCKET_SLOTS, \
+            f"capacity {capacity} must be a multiple of {BUCKET_SLOTS}"
         return HashTable(
             tuple(jnp.zeros(capacity, dtype=dt) for dt in key_dtypes),
             jnp.zeros(capacity, dtype=bool),
         )
 
 
-def _hash_to_slot(key_cols: Sequence[jnp.ndarray], capacity: int) -> jnp.ndarray:
-    # crc32 of the key bytes (same family as vnode hashing) -> starting slot
-    return (crc32_columns(key_cols) % jnp.uint32(capacity)).astype(jnp.int32)
+def _bucket_pair(key_cols: Sequence[jnp.ndarray], n_buckets: int):
+    """Two independent candidate buckets per row (int32 [N] each), plus a
+    per-key tiebreak bit so equal-fill choices split ~50/50 (without it, a
+    burst of new keys within one chunk — where fills are all read
+    pre-chunk — would pile into every key's first choice)."""
+    crc = crc32_columns(key_cols)
+    h1 = (crc % jnp.uint32(n_buckets)).astype(jnp.int32)
+    # murmur3 fmix32 of the crc — an independent-enough second choice
+    z = crc
+    z = (z ^ (z >> jnp.uint32(16))) * jnp.uint32(0x85EBCA6B)
+    z = (z ^ (z >> jnp.uint32(13))) * jnp.uint32(0xC2B2AE35)
+    z = z ^ (z >> jnp.uint32(16))
+    h2 = (z % jnp.uint32(n_buckets)).astype(jnp.int32)
+    tie = ((z >> jnp.uint32(15)) & jnp.uint32(1)).astype(bool)
+    return h1, h2, tie
+
+
+def _candidates(table: HashTable, key_cols: Sequence[jnp.ndarray]):
+    """[N, 2S] candidate slot ids + occupancy + key match per row."""
+    S = BUCKET_SLOTS
+    B = table.capacity // S
+    N = key_cols[0].shape[0]
+    h1, h2, tie = _bucket_pair(key_cols, B)
+    bases = jnp.stack([h1 * S, h2 * S], axis=1)            # [N, 2]
+    cand = (bases[:, :, None] + jnp.arange(S, dtype=jnp.int32)).reshape(N, 2 * S)
+    occ = table.occupied[cand]
+    match = occ
+    for tk, k in zip(table.keys, key_cols):
+        match = match & (tk[cand] == k[:, None])
+    return h1, h2, tie, cand, occ, match
+
+
+def lookup(table: HashTable, key_cols: Sequence[jnp.ndarray],
+           active: jnp.ndarray, max_probes: int = 0):
+    """Read-only probe: slot of each active row's key, -1 if absent.
+
+    One vectorized compare against both candidate buckets — constant cost.
+    (`max_probes` is accepted for API compatibility; probing is inherently
+    bounded by the bucket shape.)
+    """
+    _, _, _, cand, _, match = _candidates(table, key_cols)
+    has = match.any(axis=1)
+    sel = jnp.argmax(match, axis=1)
+    slot = jnp.take_along_axis(cand, sel[:, None], axis=1)[:, 0]
+    return jnp.where(active & has, slot, -1)
 
 
 def lookup_or_insert(table: HashTable, key_cols: Sequence[jnp.ndarray],
@@ -72,90 +131,90 @@ def lookup_or_insert(table: HashTable, key_cols: Sequence[jnp.ndarray],
     (invisible rows resolve immediately to slot -1).
 
     Returns (table', slots int32 [N] (-1 for inactive/unresolved),
-    n_unresolved int32 scalar). n_unresolved > 0 means the table is too
-    full / probe-bound — the caller must rebuild larger and retry.
+    n_unresolved int32 scalar). n_unresolved > 0 means both candidate
+    buckets of some new key are full — the caller must rebuild larger and
+    retry (two-choice balancing makes this improbable below ~0.7 load).
+
+    Insert algorithm (no data-dependent loops):
+      1. match pass as in `lookup`;
+      2. first device sort groups missing rows by key (in-chunk dedup:
+         each distinct new key forms a run, its first row is the leader);
+      3. each leader picks the emptier of its two buckets (pre-chunk fill —
+         within-bucket occupancy is a prefix, so fill = occ.sum);
+      4. second device sort ranks leaders within their chosen bucket, the
+         run's slot = bucket*S + fill + rank;
+      5. scatter keys/occupancy for leaders; run members inherit the
+         leader's slot via a segmented gather; unsort.
     """
+    S = BUCKET_SLOTS
     C = table.capacity
     N = key_cols[0].shape[0]
-    if max_probes == 0:
-        max_probes = C  # full linear scan worst case
     row_ids = jnp.arange(N, dtype=jnp.int32)
-    start = _hash_to_slot(key_cols, C)
 
-    def keys_match_at(slot_keys, key_cols):
-        m = jnp.ones(N, dtype=bool)
-        for tk, k in zip(slot_keys, key_cols):
-            m &= tk == k
-        return m
+    h1, h2, tie, cand, occ, match = _candidates(table, key_cols)
+    has = match.any(axis=1)
+    msel = jnp.argmax(match, axis=1)
+    mslot = jnp.take_along_axis(cand, msel[:, None], axis=1)[:, 0]
 
-    def cond(st):
-        _, _, resolved, _, it = st
-        return jnp.any(~resolved) & (it < max_probes)
+    fill1 = occ[:, :S].sum(axis=1, dtype=jnp.int32)
+    fill2 = occ[:, S:].sum(axis=1, dtype=jnp.int32)
+    choose2 = (fill2 < fill1) | ((fill2 == fill1) & tie)
+    c_bucket = jnp.where(choose2, h2, h1)
+    c_fill = jnp.minimum(fill1, fill2)
 
-    def body(st):
-        keys, occupied, resolved, slot, it = st
-        occ = occupied[slot]
-        slot_keys = tuple(tk[slot] for tk in keys)
-        match = occ & keys_match_at(slot_keys, key_cols)
-        found = ~resolved & match
-        empty = ~resolved & ~occ
-        # claim contest: min row id per contested slot wins
-        claim = jnp.full(C, N, dtype=jnp.int32)
-        claim = claim.at[jnp.where(empty, slot, C)].min(row_ids, mode="drop")
-        winner = empty & (claim[slot] == row_ids)
-        w_idx = jnp.where(winner, slot, C)
-        keys = tuple(tk.at[w_idx].set(k, mode="drop")
-                     for tk, k in zip(keys, key_cols))
-        occupied = occupied.at[w_idx].set(True, mode="drop")
-        resolved2 = resolved | found | winner
-        # advance only on occupied-mismatch; losers of a claim retry the
-        # same slot (it now holds the winner's key — may be theirs)
-        advance = ~resolved2 & occ & ~match
-        slot = jnp.where(advance, (slot + 1) % C, slot)
-        return keys, occupied, resolved2, slot, it + 1
+    miss = active & ~has
 
-    init = (table.keys, table.occupied, ~active, start, jnp.int32(0))
-    keys, occupied, resolved, slot, _ = jax.lax.while_loop(cond, body, init)
-    n_unresolved = jnp.sum(~resolved, dtype=jnp.int32)
-    slots = jnp.where(resolved & active, slot, -1)
+    # ---- sort 1: group missing rows by key (runs of identical keys) ----
+    sort_keys = [row_ids]
+    for k in key_cols:
+        sort_keys.append(k)
+    sort_keys.append(~miss)                       # primary: missing first
+    order = jnp.lexsort(tuple(sort_keys))
+    s_miss = miss[order]
+    same = s_miss[1:] & s_miss[:-1]
+    for k in key_cols:
+        sk = k[order]
+        same = same & (sk[1:] == sk[:-1])
+    is_leader = s_miss & jnp.concatenate([jnp.array([True]), ~same])
+    run_id = jnp.cumsum(is_leader.astype(jnp.int32)) - 1    # per sorted row
+    s_bucket = c_bucket[order]
+    s_fill = c_fill[order]
+
+    # ---- sort 2: rank leaders within their chosen bucket ----
+    B_sentinel = C // S                            # non-leaders sort last
+    rank_key = jnp.where(is_leader, s_bucket, B_sentinel)
+    order2 = jnp.lexsort((jnp.arange(N, dtype=jnp.int32), rank_key))
+    r_bucket = rank_key[order2]
+    new_bucket = jnp.concatenate(
+        [jnp.array([True]), r_bucket[1:] != r_bucket[:-1]])
+    pos = jnp.arange(N, dtype=jnp.int32)
+    bucket_start = jax.lax.cummax(jnp.where(new_bucket, pos, 0))
+    rank = pos - bucket_start
+    r_fill = s_fill[order2]
+    r_leader = is_leader[order2]
+    r_ok = r_leader & (r_fill + rank < S)
+    r_slot = jnp.where(r_ok, r_bucket * S + r_fill + rank, -1)
+
+    # scatter leader slots back to sorted-1 space, then spread over runs
+    slot_s1 = jnp.zeros(N, dtype=jnp.int32).at[order2].set(r_slot)
+    leader_slot_by_run = jnp.full(N + 1, -1, dtype=jnp.int32).at[
+        jnp.where(is_leader, run_id, N)].set(
+            jnp.where(is_leader, slot_s1, -1), mode="drop")
+    s_ins_slot = jnp.where(s_miss, leader_slot_by_run[run_id], -1)
+
+    # ---- write leaders' keys/occupancy ----
+    w_idx = jnp.where(r_ok, r_slot, C)
+    orig2 = order[order2]                          # sorted-2 -> original row
+    keys = tuple(tk.at[w_idx].set(k[orig2], mode="drop")
+                 for tk, k in zip(table.keys, key_cols))
+    occupied = table.occupied.at[w_idx].set(True, mode="drop")
+
+    # ---- unsort + combine ----
+    ins_slot = jnp.zeros(N, dtype=jnp.int32).at[order].set(s_ins_slot)
+    slots = jnp.where(has, mslot, jnp.where(miss, ins_slot, -1))
+    slots = jnp.where(active, slots, -1)
+    n_unresolved = jnp.sum((active & (slots < 0)).astype(jnp.int32))
     return HashTable(keys, occupied), slots, n_unresolved
-
-
-def lookup(table: HashTable, key_cols: Sequence[jnp.ndarray],
-           active: jnp.ndarray, max_probes: int = 0):
-    """Read-only probe: slot of each active row's key, -1 if absent.
-
-    Probing stops at the first never-occupied slot in the chain (slots are
-    never freed, so an empty slot terminates the chain definitively).
-    """
-    C = table.capacity
-    N = key_cols[0].shape[0]
-    if max_probes == 0:
-        max_probes = C
-    start = _hash_to_slot(key_cols, C)
-
-    def cond(st):
-        searching, _, it = st
-        return jnp.any(searching) & (it < max_probes)
-
-    def body(st):
-        searching, slot, it = st
-        occ = table.occupied[slot]
-        matched = jnp.ones(N, dtype=bool)
-        for tk, k in zip(table.keys, key_cols):
-            matched &= tk[slot] == k
-        hit = searching & occ & matched
-        miss_end = searching & ~occ          # chain ended: not present
-        advance = searching & occ & ~matched
-        searching2 = searching & ~hit & ~miss_end
-        slot2 = jnp.where(advance, (slot + 1) % C, slot)
-        # resolved rows keep their slot on hit; a miss parks at -1
-        return searching2, jnp.where(miss_end, -1, slot2), it + 1
-
-    searching, slot, _ = jax.lax.while_loop(
-        cond, body, (active, start.astype(jnp.int32), jnp.int32(0)))
-    # rows still searching after max_probes: treat as absent
-    return jnp.where(active & ~searching, slot, -1)
 
 
 def load(table: HashTable) -> jnp.ndarray:

@@ -14,4 +14,6 @@ from .exchange import (
     ChannelInput, MergeExecutor,
 )
 from .hash_agg import HashAggExecutor
+from .hash_join import HashJoinExecutor
+from .align import barrier_align
 from .hop_window import HopWindowExecutor

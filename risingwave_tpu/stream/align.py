@@ -1,0 +1,78 @@
+"""Two-input barrier alignment for joins.
+
+Reference: src/stream/src/executor/barrier_align.rs:34-43 — poll both
+upstreams; a side that yields a barrier is blocked until the other yields
+the same barrier, then ONE aligned barrier is delivered. Chunks and
+watermarks pass through eagerly, tagged with their side, so the consumer
+(HashJoin) sees a totally ordered interleaving whose epochs agree.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator
+
+from .executor import Executor
+from .message import Barrier
+
+LEFT = 0
+RIGHT = 1
+
+
+async def barrier_align(left: Executor, right: Executor) -> AsyncIterator[tuple]:
+    """Yields ("chunk"|"watermark", side, msg) and ("barrier", None, barrier)."""
+    from ..common.chunk import StreamChunk
+
+    streams = [left.execute().__aiter__(), right.execute().__aiter__()]
+    tasks: dict[int, asyncio.Task] = {
+        s: asyncio.create_task(anext(streams[s])) for s in (LEFT, RIGHT)}
+    pending: dict[int, Barrier] = {}
+    done: set[int] = set()
+    try:
+        while len(done) < 2:
+            ready = [tasks[s] for s in (LEFT, RIGHT)
+                     if s not in pending and s not in done]
+            if not ready:
+                # both sides parked on a barrier: emit one aligned barrier
+                bl, br = pending[LEFT], pending[RIGHT]
+                assert bl.epoch.curr == br.epoch.curr, \
+                    f"misaligned barriers {bl.epoch} vs {br.epoch}"
+                yield ("barrier", None, bl)
+                pending.clear()
+                for s in (LEFT, RIGHT):
+                    if s not in done:
+                        tasks[s] = asyncio.create_task(anext(streams[s]))
+                continue
+            finished, _ = await asyncio.wait(
+                ready, return_when=asyncio.FIRST_COMPLETED)
+            for t in finished:
+                s = next(k for k, v in tasks.items() if v is t)
+                try:
+                    msg = t.result()
+                except StopAsyncIteration:
+                    done.add(s)
+                    # treat an exhausted side as aligned (its stop barrier
+                    # was already delivered)
+                    if s in pending:
+                        del pending[s]
+                    continue
+                if isinstance(msg, Barrier):
+                    pending[s] = msg
+                elif isinstance(msg, StreamChunk):
+                    yield ("chunk", s, msg)
+                    tasks[s] = asyncio.create_task(anext(streams[s]))
+                else:
+                    yield ("watermark", s, msg)
+                    tasks[s] = asyncio.create_task(anext(streams[s]))
+            # one side exhausted while the other still holds a barrier: the
+            # barrier can never align; deliver it (stop barriers end streams)
+            if done and pending and len(done) + len(pending) == 2:
+                b = next(iter(pending.values()))
+                yield ("barrier", None, b)
+                pending.clear()
+                for s in (LEFT, RIGHT):
+                    if s not in done:
+                        tasks[s] = asyncio.create_task(anext(streams[s]))
+    finally:
+        for t in tasks.values():
+            t.cancel()

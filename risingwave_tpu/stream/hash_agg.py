@@ -109,6 +109,7 @@ class HashAggExecutor(Executor):
         self._flush = jax.jit(self._flush_impl)
         self._live_zombie = jax.jit(self._live_zombie_impl)
         self._evict = jax.jit(self._evict_impl)
+        self._evict_keys = jax.jit(self._evict_keys_impl)
         self._rehash = jax.jit(self._rehash_impl, static_argnums=1)
         # load/overflow watchdog (see _drain_telemetry)
         self.rebuilds = 0
@@ -182,9 +183,16 @@ class HashAggExecutor(Executor):
         exists = exists_now[d_slot]
         is_dirty = slot_ids < n_dirty
 
+        # no-change skip (reference agg_group.rs:71 build_change -> NoChange):
+        # a group that existed before, still exists, and whose emitted outputs
+        # are all unchanged produces no changelog rows
+        unchanged = existed & exists
+        for spec, st, pe in zip(self.specs, state.agg_states, state.prev_emit):
+            unchanged &= spec.emit(st)[d_slot] == pe[d_slot]
+
         # output row j at positions 2j (old) and 2j+1 (new)
-        vis_old = is_dirty & existed            # UD or Delete
-        vis_new = is_dirty & exists             # UI or Insert
+        vis_old = is_dirty & existed & ~unchanged   # UD or Delete
+        vis_new = is_dirty & exists & ~unchanged    # UI or Insert
         ops_old = jnp.where(exists, OP_UPDATE_DELETE, OP_DELETE)
         ops_new = jnp.where(existed, OP_UPDATE_INSERT, OP_INSERT)
 
@@ -213,6 +221,22 @@ class HashAggExecutor(Executor):
         occ = jnp.sum(state.table.occupied.astype(jnp.int32))
         live = jnp.sum((state.row_count > 0).astype(jnp.int32))
         return occ, live
+
+    def _evict_keys_impl(self, state: AggState, watermark):
+        """Compacted group keys of live groups below the cleaning watermark —
+        the rows that must be DELETED from the durable state table when the
+        device state is zeroed (reference: StateTable::update_watermark ->
+        Hummock table-watermark pruning keeps committed state bounded)."""
+        j = self.cleaning_watermark_key
+        evict = (state.table.occupied & (state.table.keys[j] < watermark)
+                 & (state.row_count > 0))
+        C = state.table.capacity
+        rank = jnp.cumsum(evict.astype(jnp.int32)) - 1
+        sel = jnp.zeros(C, dtype=jnp.int32).at[
+            jnp.where(evict, rank, C)].set(jnp.arange(C, dtype=jnp.int32),
+                                           mode="drop")
+        n = jnp.sum(evict.astype(jnp.int32))
+        return tuple(tk[sel] for tk in state.table.keys), n
 
     def _evict_impl(self, state: AggState, watermark) -> AggState:
         """Zero out groups below the state-cleaning watermark. Slots remain
@@ -318,20 +342,36 @@ class HashAggExecutor(Executor):
     def _persist(self, barrier: Barrier) -> None:
         if self.state_table is None:
             return
-        if not self._applied_since_flush:
-            self.state_table.commit(barrier.epoch.curr)
-            return
-        cols, ops, vis = self._flush_persist_view()
-        # rows: group key + agg outputs + hidden row_count
-        n = int(np.asarray(vis.sum()))
-        if n:
-            cols_np = [np.asarray(c)[np.asarray(vis)] for c in cols]
-            ops_np = np.asarray(ops)[np.asarray(vis)]
-            rows = []
-            for r in range(n):
-                rows.append((int(ops_np[r]), tuple(c[r].item() for c in cols_np)))
-            self.state_table.write_chunk_rows(rows)
+        if self._applied_since_flush:
+            cols, ops, vis = self._flush_persist_view()
+            # rows: group key + raw agg states + hidden row_count
+            n = int(np.asarray(vis.sum()))
+            if n:
+                cols_np = [np.asarray(c)[np.asarray(vis)] for c in cols]
+                ops_np = np.asarray(ops)[np.asarray(vis)]
+                rows = []
+                for r in range(n):
+                    rows.append((int(ops_np[r]), tuple(c[r].item() for c in cols_np)))
+                self.state_table.write_chunk_rows(rows)
+        if (self.cleaning_watermark_key is not None
+                and self._pending_clean_wm is not None):
+            # evicted groups leave the durable table in the SAME epoch their
+            # device state is zeroed, so committed state stays bounded and
+            # recovery never resurrects dead windows (mem-table is a dict:
+            # these tombstones override any insert staged above)
+            self._write_evict_deletes(self._pending_clean_wm)
         self.state_table.commit(barrier.epoch.curr)
+
+    def _write_evict_deletes(self, watermark: int) -> None:
+        keys, n = self._evict_keys(self.state, watermark)
+        n = int(n)
+        if not n:
+            return
+        keys_np = [np.asarray(k)[:n] for k in keys]
+        pad = (0,) * (len(self.specs) + 1)  # non-pk columns unused by delete
+        rows = [(int(OP_DELETE), tuple(k[r].item() for k in keys_np) + pad)
+                for r in range(n)]
+        self.state_table.write_chunk_rows(rows)
 
     def _flush_persist_view(self):
         """The state rows that changed this epoch (computed pre-flush)."""
@@ -362,6 +402,13 @@ class HashAggExecutor(Executor):
         rows = [r for _, r in self.state_table.iter_all()]
         if not rows:
             return
+        # Runtime capacity growth is not persisted; size the recovery table
+        # from the actual persisted row count so a post-growth crash can
+        # always be recovered (ADVICE r1: a hard assert at the constructor
+        # capacity made such recovery permanently fail).
+        need = 1 << max(self.capacity.bit_length() - 1,
+                        (int(len(rows) / 0.7)).bit_length())
+        self.capacity = max(self.capacity, need)
         nk = len(self.group_key_indices)
         key_cols = [
             jnp.asarray(np.asarray([r[j] for r in rows],
@@ -413,15 +460,18 @@ class HashAggExecutor(Executor):
                     yield msg
                     continue
                 self._persist(msg)
-                if self._applied_since_flush:
+                flushed = self._applied_since_flush
+                if flushed:
                     self._applied_since_flush = False
                     self.state, cols, ops, vis = self._flush(self.state)
                     yield StreamChunk(
                         tuple(Column(c) for c in cols), ops, vis, self.schema)
-                    if (self.cleaning_watermark_key is not None
-                            and self._pending_clean_wm is not None):
-                        self.state = self._evict(self.state, self._pending_clean_wm)
-                        self._pending_clean_wm = None
+                if (self.cleaning_watermark_key is not None
+                        and self._pending_clean_wm is not None):
+                    self.state = self._evict(self.state, self._pending_clean_wm)
+                    self._pending_clean_wm = None
+                    flushed = True
+                if flushed:
                     self._maybe_rebuild_at_barrier()
                 yield msg
             else:

@@ -1,0 +1,590 @@
+"""HashJoin executor — streaming two-sided equi-join with device state.
+
+Reference: src/stream/src/executor/hash_join.rs (JoinSide :76,141; aligned
+2-input loop `into_stream` :478; `eq_join_oneside` :792) and the join state
+at managed_state/join/mod.rs:238-268 — each side keeps a multimap
+join_key -> rows; a chunk from one side probes the OTHER side's map to emit
+joined changelog rows, then updates its OWN map.
+
+TPU re-design: each side's multimap is a struct-of-arrays in HBM —
+  * key_table: open-addressing HashTable over the join-key columns [CK]
+  * head[CK]:  first row index of the key's chain (-1 = empty)
+  * rows/valids: per-column row store [CR] + next[CR] links + live[CR]
+Applying a chunk is ONE jitted step: probe the other side's key table, walk
+all chains in lock-step (a while_loop over the longest chain, each iteration
+a cumsum-compaction append into a fixed-capacity match buffer), then apply
+deletes (chain walk + claim contest tombstones one instance per delete) and
+inserts (batch row allocation + vectorized multi-push-front chain link that
+handles duplicate keys within the chunk by sorting rows by key slot).
+
+Changelog contract: an insert-like input row emits Insert matches, a
+delete-like row emits Delete matches (update pairs degrade to Delete/Insert,
+as the reference does when pairs cannot be kept adjacent). Inner join only —
+degree tables for outer joins are the next increment.
+
+Deletion identifies rows by the side's pk within the key chain. Rows are
+never unlinked (chains stay intact); tombstones are reclaimed by the
+barrier-time rebuild, exactly like HashAgg's zombie purge.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.chunk import (
+    Column, StreamChunk, OP_DELETE, OP_INSERT, op_sign,
+)
+from ..common.types import Field, Schema
+from ..ops.hash_table import HashTable, lookup, lookup_or_insert
+from ..state.state_table import StateTable
+from .align import LEFT, RIGHT, barrier_align
+from .executor import Executor
+from .message import Barrier, BarrierKind, Watermark
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class JoinSideState:
+    """Device state of one join side (key table cap CK, row store cap CR)."""
+
+    key_table: HashTable                 # over join-key columns [CK]
+    head: jnp.ndarray                    # int32 [CK], -1 = empty chain
+    rows: tuple[jnp.ndarray, ...]        # per input column [CR]
+    valids: tuple[jnp.ndarray, ...]      # per input column bool [CR]
+    next: jnp.ndarray                    # int32 [CR]
+    live: jnp.ndarray                    # bool [CR]
+    dirty: jnp.ndarray                   # bool [CR] — changed since persist
+    top: jnp.ndarray                     # int32 scalar — rows ever allocated
+
+    def tree_flatten(self):
+        return ((self.key_table, self.head, self.rows, self.valids,
+                 self.next, self.live, self.dirty, self.top), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        kt, head, rows, valids, nxt, live, dirty, top = children
+        return cls(kt, head, tuple(rows), tuple(valids), nxt, live, dirty, top)
+
+    @property
+    def key_capacity(self) -> int:
+        return self.head.shape[0]
+
+    @property
+    def row_capacity(self) -> int:
+        return self.live.shape[0]
+
+
+def _empty_side(key_capacity: int, row_capacity: int,
+                key_dtypes: Sequence, col_dtypes: Sequence) -> JoinSideState:
+    return JoinSideState(
+        key_table=HashTable.empty(key_capacity, key_dtypes),
+        head=jnp.full(key_capacity, -1, dtype=jnp.int32),
+        rows=tuple(jnp.zeros(row_capacity, dtype=dt) for dt in col_dtypes),
+        valids=tuple(jnp.zeros(row_capacity, dtype=bool) for _ in col_dtypes),
+        next=jnp.full(row_capacity, -1, dtype=jnp.int32),
+        live=jnp.zeros(row_capacity, dtype=bool),
+        dirty=jnp.zeros(row_capacity, dtype=bool),
+        top=jnp.int32(0),
+    )
+
+
+def _bulk_insert(side: JoinSideState, slots: jnp.ndarray, ins: jnp.ndarray,
+                 col_data: Sequence[jnp.ndarray], col_valid: Sequence[jnp.ndarray],
+                 dirty_vals: jnp.ndarray):
+    """Insert the masked rows into the side's row store + chains.
+
+    slots: key slot per row (from lookup_or_insert); ins: bool mask; rows with
+    the SAME key slot within the batch are chained among themselves (sorted by
+    slot, linked in batch order, head points at the batch's last row — the
+    probe order of a chain is reverse insertion order, which is fine for an
+    unordered multimap). Returns (side', n_row_overflow).
+    """
+    CK = side.key_capacity
+    CR = side.row_capacity
+    N = slots.shape[0]
+    n_ins = jnp.sum(ins.astype(jnp.int32))
+    rank = jnp.cumsum(ins.astype(jnp.int32)) - 1
+    new_ridx = side.top + rank                       # row id per inserted row
+    ok = ins & (new_ridx < CR)
+    tgt = jnp.where(ok, new_ridx, CR)
+    rows = tuple(r.at[tgt].set(d.astype(r.dtype), mode="drop")
+                 for r, d in zip(side.rows, col_data))
+    valids = tuple(v.at[tgt].set(m, mode="drop")
+                   for v, m in zip(side.valids, col_valid))
+    live = side.live.at[tgt].set(True, mode="drop")
+    dirty = side.dirty.at[tgt].set(dirty_vals, mode="drop")
+
+    # chain link: sort batch rows by key slot so same-slot rows are adjacent
+    seg = jnp.where(ok, slots, CK)
+    order = jnp.argsort(seg, stable=True)            # [N]
+    sseg = seg[order]
+    sridx = new_ridx[order]
+    prev_same = jnp.concatenate([jnp.array([False]), sseg[1:] == sseg[:-1]])
+    prev_ridx = jnp.concatenate([jnp.array([0], dtype=sridx.dtype), sridx[:-1]])
+    old_head = side.head[jnp.clip(sseg, 0, CK - 1)]
+    nxt_val = jnp.where(prev_same, prev_ridx, old_head).astype(jnp.int32)
+    s_ok = ok[order]
+    nxt = side.next.at[jnp.where(s_ok, sridx, CR)].set(nxt_val, mode="drop")
+    is_last = jnp.concatenate([sseg[:-1] != sseg[1:], jnp.array([True])])
+    head = side.head.at[
+        jnp.where(s_ok & is_last, sseg, CK)].set(sridx.astype(jnp.int32), mode="drop")
+    top = jnp.minimum(side.top + n_ins, CR).astype(jnp.int32)
+    n_overflow = jnp.maximum(side.top + n_ins - CR, 0)
+    return JoinSideState(side.key_table, head, rows, valids, nxt, live,
+                         dirty, top), n_overflow
+
+
+class HashJoinExecutor(Executor):
+    """Inner equi-join. Output schema = left columns ++ right columns
+    (optionally projected by output_indices); output pk = left pk ++ right pk.
+
+    condition: optional expression over the FULL (left++right) output row,
+    applied as a post-probe filter (the reference's non-equi `cond`)."""
+
+    def __init__(self, left: Executor, right: Executor,
+                 left_key_indices: Sequence[int],
+                 right_key_indices: Sequence[int],
+                 left_pk_indices: Sequence[int],
+                 right_pk_indices: Sequence[int],
+                 key_capacity: int = 1 << 14,
+                 row_capacity: int = 1 << 16,
+                 match_factor: int = 2,
+                 condition=None,
+                 output_indices: Optional[Sequence[int]] = None,
+                 state_tables: Optional[tuple[StateTable, StateTable]] = None,
+                 clean_watermark_cols: tuple[Optional[int], Optional[int]] = (None, None)):
+        self.inputs = (left, right)
+        self.key_indices = (tuple(left_key_indices), tuple(right_key_indices))
+        self.pk_indices_side = (tuple(left_pk_indices), tuple(right_pk_indices))
+        assert len(self.key_indices[0]) == len(self.key_indices[1])
+        lt, rt = left.schema, right.schema
+        for li, ri in zip(*self.key_indices):
+            assert lt[li].data_type.np_dtype == rt[ri].data_type.np_dtype, \
+                f"join key dtype mismatch {lt[li]} vs {rt[ri]}"
+        self._key_dtypes = tuple(
+            lt[i].data_type.jnp_dtype for i in self.key_indices[0])
+        self._col_dtypes = (
+            tuple(f.data_type.jnp_dtype for f in lt),
+            tuple(f.data_type.jnp_dtype for f in rt),
+        )
+        full_fields = [Field(f"l_{f.name}" if f.name in {g.name for g in rt} else f.name,
+                             f.data_type, f.scale) for f in lt]
+        full_fields += [Field(f"r_{f.name}" if f.name in {g.name for g in lt} else f.name,
+                              f.data_type, f.scale) for f in rt]
+        self.output_indices = (tuple(output_indices) if output_indices is not None
+                               else tuple(range(len(full_fields))))
+        self.schema = Schema(tuple(full_fields[i] for i in self.output_indices))
+        out_pk_full = (tuple(self.pk_indices_side[0])
+                       + tuple(len(lt) + i for i in self.pk_indices_side[1]))
+        self.pk_indices = tuple(self.output_indices.index(i)
+                                for i in out_pk_full if i in self.output_indices)
+        self.key_capacity = [key_capacity, key_capacity]
+        self.row_capacity = [row_capacity, row_capacity]
+        self.match_factor = match_factor
+        self.condition = condition
+        self.state_tables = state_tables or (None, None)
+        self.clean_cols = tuple(clean_watermark_cols)
+        self._pending_clean: list[Optional[int]] = [None, None]
+        self.identity = (f"HashJoin(l={self.key_indices[0]}, "
+                         f"r={self.key_indices[1]})")
+        self.sides = [self._empty(s) for s in (LEFT, RIGHT)]
+        self._apply = jax.jit(self._apply_impl, static_argnames=("side",))
+        self._persist_view = jax.jit(self._persist_view_impl)
+        self._evict = jax.jit(self._evict_impl, static_argnames=("side",))
+        self._evict_rows = jax.jit(self._evict_rows_impl, static_argnames=("side",))
+        self._stats = jax.jit(self._stats_impl)
+        self._rehash = jax.jit(self._rehash_impl,
+                               static_argnames=("side", "new_ck", "new_cr"))
+        self.rebuilds = 0
+        self._telemetry: deque = deque()
+        self._dirty_since_flush = [False, False]
+        # watermark bookkeeping: per side, last seen watermark per key position
+        self._key_wms: list[dict[int, int]] = [{}, {}]
+        self._emitted_key_wm: dict[int, int] = {}
+
+    def _empty(self, side: int) -> JoinSideState:
+        return _empty_side(self.key_capacity[side], self.row_capacity[side],
+                           self._key_dtypes, self._col_dtypes[side])
+
+    # ------------------------------------------------------------- apply
+    def _apply_impl(self, own: JoinSideState, other: JoinSideState,
+                    chunk: StreamChunk, side: int):
+        """Probe `other`, emit matches, update `own`. Returns
+        (own', match buffers, telemetry scalars)."""
+        key_idx = self.key_indices[side]
+        pk_idx = self.pk_indices_side[side]
+        N = chunk.capacity
+        CRo = other.row_capacity
+        CRs = own.row_capacity
+        CKs = own.key_capacity
+        M = self.match_factor * N
+
+        key_cols = [chunk.columns[i].data for i in key_idx]
+        key_valid = jnp.ones(N, dtype=bool)
+        for i in key_idx:
+            key_valid &= chunk.columns[i].valid_mask()
+        active = chunk.vis & key_valid               # NULL keys never join
+        signs = op_sign(chunk.ops)
+        row_ids = jnp.arange(N, dtype=jnp.int32)
+
+        # ---- within-chunk pk-run resolution ----
+        # The reference applies rows strictly in order, so one chunk may
+        # insert AND delete the same pk. Lexsort active rows by pk (row order
+        # as tiebreak); each equal-pk run nets out to at most one effective
+        # stored-row delete (the run's first op, if delete-like) and one
+        # effective insert (the run's last op, if insert-like). Probe
+        # emission below still uses every row — only STATE updates net out.
+        sort_keys = [row_ids]                        # least significant
+        for p in pk_idx:
+            sort_keys.append(chunk.columns[p].data)
+        sort_keys.append(~active)                    # inactive rows last
+        order = jnp.lexsort(tuple(sort_keys))
+        s_act = active[order]
+        same = s_act[1:] & s_act[:-1]
+        for p in pk_idx:
+            d = chunk.columns[p].data[order]
+            same = same & (d[1:] == d[:-1])
+        run_start = jnp.concatenate([jnp.array([True]), ~same])
+        run_end = jnp.concatenate([~same, jnp.array([True])])
+        s_signs = signs[order]
+        eff_del_s = run_start & (s_signs < 0) & s_act
+        eff_ins_s = run_end & (s_signs > 0) & s_act
+        is_del = jnp.zeros(N, dtype=bool).at[order].set(eff_del_s)
+        is_ins = jnp.zeros(N, dtype=bool).at[order].set(eff_ins_s)
+
+        # ---- probe the other side: lock-step chain walk ----
+        oslot = lookup(other.key_table, key_cols, active)
+        cursor = jnp.where(oslot >= 0,
+                           other.head[jnp.clip(oslot, 0, None)], -1)
+
+        def pcond(st):
+            cursor, m, _, _ = st
+            return jnp.any(cursor >= 0)
+
+        def pbody(st):
+            cursor, m, out_own, out_oth = st
+            cc = jnp.clip(cursor, 0, None)
+            alive = (cursor >= 0) & other.live[cc]
+            rank = jnp.cumsum(alive.astype(jnp.int32)) - 1
+            pos = m + rank
+            tgt = jnp.where(alive & (pos < M), pos, M)
+            out_own = out_own.at[tgt].set(row_ids, mode="drop")
+            out_oth = out_oth.at[tgt].set(cursor, mode="drop")
+            m = (m + jnp.sum(alive.astype(jnp.int32))).astype(jnp.int32)
+            cursor = jnp.where(cursor >= 0, other.next[cc], -1)
+            return cursor, m, out_own, out_oth
+
+        _, m_total, out_own, out_oth = jax.lax.while_loop(
+            pcond, pbody,
+            (cursor, jnp.int32(0),
+             jnp.zeros(M, dtype=jnp.int32), jnp.zeros(M, dtype=jnp.int32)))
+        n_match_overflow = jnp.maximum(m_total - M, 0)
+
+        # ---- own-side update: deletes first (update pairs retract the OLD
+        # row before the new one lands — reference applies rows in order) ----
+        own_table, slots, n_un = lookup_or_insert(own.key_table, key_cols, active)
+        own = JoinSideState(own_table, own.head, own.rows, own.valids,
+                            own.next, own.live, own.dirty, own.top)
+        dcur = jnp.where(is_del & (slots >= 0),
+                         own.head[jnp.clip(slots, 0, None)], -1)
+
+        def dcond(st):
+            dcur = st[0]
+            return jnp.any(dcur >= 0)
+
+        def dbody(st):
+            dcur, live, dirty, found = st
+            cc = jnp.clip(dcur, 0, None)
+            alive = (dcur >= 0) & live[cc]
+            pkm = jnp.ones(N, dtype=bool)
+            for p in pk_idx:
+                pkm &= own.rows[p][cc] == chunk.columns[p].data.astype(own.rows[p].dtype)
+            cand = alive & pkm & ~found
+            # claim contest: at most one delete consumes a given row
+            claim = jnp.full(CRs, N, dtype=jnp.int32)
+            claim = claim.at[jnp.where(cand, dcur, CRs)].min(row_ids, mode="drop")
+            win = cand & (claim[cc] == row_ids)
+            live = live.at[jnp.where(win, dcur, CRs)].set(False, mode="drop")
+            dirty = dirty.at[jnp.where(win, dcur, CRs)].set(True, mode="drop")
+            found = found | win
+            dcur = jnp.where(found | (dcur < 0), -1, own.next[cc])
+            return dcur, live, dirty, found
+
+        _, live2, dirty2, found = jax.lax.while_loop(
+            dcond, dbody,
+            (dcur, own.live, own.dirty, jnp.zeros(N, dtype=bool)))
+        n_del_miss = jnp.sum((is_del & ~found).astype(jnp.int32))
+        own = JoinSideState(own.key_table, own.head, own.rows, own.valids,
+                            own.next, live2, dirty2, own.top)
+
+        # ---- inserts ----
+        own, n_row_overflow = _bulk_insert(
+            own, slots, is_ins,
+            [c.data for c in chunk.columns],
+            [c.valid_mask() for c in chunk.columns],
+            jnp.ones(N, dtype=bool))
+
+        # ---- output assembly: left cols ++ right cols ----
+        m_ok = jnp.minimum(m_total, M)
+        out_vis = jnp.arange(M) < m_ok
+        own_cols = [Column(jnp.take(c.data, out_own, axis=0),
+                           jnp.take(c.valid_mask(), out_own, axis=0))
+                    for c in chunk.columns]
+        oc = jnp.clip(out_oth, 0, None)
+        oth_cols = [Column(r[oc], v[oc])
+                    for r, v in zip(other.rows, other.valids)]
+        cols = own_cols + oth_cols if side == LEFT else oth_cols + own_cols
+        ops_out = jnp.where(jnp.take(signs, out_own) > 0,
+                            OP_INSERT, OP_DELETE).astype(jnp.int8)
+        occ = jnp.sum(own.key_table.occupied.astype(jnp.int32))
+        return (own, tuple(cols), ops_out, out_vis,
+                n_un, n_del_miss, n_match_overflow, n_row_overflow,
+                occ, own.top)
+
+    # ------------------------------------------------------- persistence
+    def _persist_view_impl(self, side_state: JoinSideState):
+        """Compacted dirty rows -> (cols..., valid flags..., ops, vis)."""
+        CR = side_state.row_capacity
+        dirty = side_state.dirty
+        rank = jnp.cumsum(dirty.astype(jnp.int32)) - 1
+        ids = jnp.arange(CR, dtype=jnp.int32)
+        sel = jnp.zeros(CR, dtype=jnp.int32).at[
+            jnp.where(dirty, rank, CR)].set(ids, mode="drop")
+        n_dirty = jnp.sum(dirty.astype(jnp.int32))
+        vis = ids < n_dirty
+        ops = jnp.where(side_state.live[sel], OP_INSERT, OP_DELETE).astype(jnp.int8)
+        cols = tuple(r[sel] for r in side_state.rows)
+        return cols, ops, vis
+
+    def _persist(self, barrier: Barrier) -> None:
+        for s in (LEFT, RIGHT):
+            st = self.state_tables[s]
+            if st is None:
+                continue
+            if self._dirty_since_flush[s]:
+                cols, ops, vis = self._persist_view(self.sides[s])
+                vis_np = np.asarray(vis)
+                n = int(vis_np.sum())
+                if n:
+                    cols_np = [np.asarray(c)[vis_np] for c in cols]
+                    ops_np = np.asarray(ops)[vis_np]
+                    rows = [(int(ops_np[r]), tuple(c[r].item() for c in cols_np))
+                            for r in range(n)]
+                    st.write_chunk_rows(rows)
+                side = self.sides[s]
+                self.sides[s] = JoinSideState(
+                    side.key_table, side.head, side.rows, side.valids,
+                    side.next, side.live,
+                    jnp.zeros(side.row_capacity, dtype=bool), side.top)
+                self._dirty_since_flush[s] = False
+            if self._pending_clean[s] is not None and self.clean_cols[s] is not None:
+                self._write_evict_deletes(s, self._pending_clean[s])
+            st.commit(barrier.epoch.curr)
+
+    def _write_evict_deletes(self, s: int, wm: int) -> None:
+        cols, n = self._evict_rows(self.sides[s], wm, side=s)
+        n = int(n)
+        if not n:
+            return
+        cols_np = [np.asarray(c)[:n] for c in cols]
+        rows = [(int(OP_DELETE), tuple(c[r].item() for c in cols_np))
+                for r in range(n)]
+        self.state_tables[s].write_chunk_rows(rows)
+
+    def _evict_rows_impl(self, side_state: JoinSideState, wm, side: int):
+        col = self.clean_cols[side]
+        CR = side_state.row_capacity
+        evict = side_state.live & (side_state.rows[col] < wm)
+        rank = jnp.cumsum(evict.astype(jnp.int32)) - 1
+        sel = jnp.zeros(CR, dtype=jnp.int32).at[
+            jnp.where(evict, rank, CR)].set(jnp.arange(CR, dtype=jnp.int32),
+                                            mode="drop")
+        n = jnp.sum(evict.astype(jnp.int32))
+        return tuple(r[sel] for r in side_state.rows), n
+
+    def _evict_impl(self, side_state: JoinSideState, wm, side: int):
+        col = self.clean_cols[side]
+        keep = ~(side_state.live & (side_state.rows[col] < wm))
+        return JoinSideState(
+            side_state.key_table, side_state.head, side_state.rows,
+            side_state.valids, side_state.next, side_state.live & keep,
+            side_state.dirty, side_state.top)
+
+    def recover(self) -> None:
+        for s in (LEFT, RIGHT):
+            st = self.state_tables[s]
+            if st is None:
+                continue
+            rows = [r for _, r in st.iter_all()]
+            if not rows:
+                continue
+            n = len(rows)
+            self.row_capacity[s] = max(self.row_capacity[s],
+                                       1 << (int(n / 0.7)).bit_length())
+            self.key_capacity[s] = max(self.key_capacity[s],
+                                       1 << (int(n / 0.7)).bit_length())
+            self.sides[s] = self._empty(s)
+            cap = 1 << max(1, (n - 1).bit_length())
+            sch = self.inputs[s].schema
+            arrays = [np.asarray([r[i] for r in rows], dtype=f.data_type.np_dtype)
+                      for i, f in enumerate(sch)]
+            chunk = StreamChunk.from_numpy(sch, arrays, capacity=cap)
+            out = self._apply(self.sides[s],
+                              self._empty(1 - s) if self.sides[1 - s] is None
+                              else self.sides[1 - s], chunk, side=s)
+            self.sides[s] = out[0]
+            # recovery rows are already durable: clear dirty
+            side = self.sides[s]
+            self.sides[s] = JoinSideState(
+                side.key_table, side.head, side.rows, side.valids, side.next,
+                side.live, jnp.zeros(side.row_capacity, dtype=bool), side.top)
+
+    # ---------------------------------------------------------- rebuild
+    def _stats_impl(self, side_state: JoinSideState):
+        occ = jnp.sum(side_state.key_table.occupied.astype(jnp.int32))
+        live = jnp.sum(side_state.live.astype(jnp.int32))
+        # live distinct keys: a key is live if its chain has a live row
+        CR = side_state.row_capacity
+        return occ, live, side_state.top
+
+    def _rehash_impl(self, side_state: JoinSideState, side: int,
+                     new_ck: int, new_cr: int) -> JoinSideState:
+        """Compact live rows into a fresh side (zombie purge / growth)."""
+        CR = side_state.row_capacity
+        keep = side_state.live | side_state.dirty   # dirty dead rows must
+        # survive until persisted as deletes
+        rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        sel = jnp.zeros(CR, dtype=jnp.int32).at[
+            jnp.where(keep, rank, CR)].set(jnp.arange(CR, dtype=jnp.int32),
+                                           mode="drop")
+        n_keep = jnp.sum(keep.astype(jnp.int32))
+        act = jnp.arange(CR) < n_keep
+        key_cols = [side_state.rows[i][sel] for i in self.key_indices[side]]
+        fresh = _empty_side(new_ck, new_cr, self._key_dtypes,
+                            self._col_dtypes[side])
+        table, slots, n_un = lookup_or_insert(fresh.key_table, key_cols,
+                                              act & side_state.live[sel])
+        fresh = JoinSideState(table, fresh.head, fresh.rows, fresh.valids,
+                              fresh.next, fresh.live, fresh.dirty, fresh.top)
+        fresh, _ = _bulk_insert(
+            fresh, slots, act & side_state.live[sel],
+            [r[sel] for r in side_state.rows],
+            [v[sel] for v in side_state.valids],
+            side_state.dirty[sel])
+        # dirty dead rows: append after live ones (not linked into chains)
+        dead = act & ~side_state.live[sel] & side_state.dirty[sel]
+        rank_d = jnp.cumsum(dead.astype(jnp.int32)) - 1
+        tgt = jnp.where(dead, fresh.top + rank_d, new_cr)
+        rows = tuple(fr.at[tgt].set(r[sel], mode="drop")
+                     for fr, r in zip(fresh.rows, side_state.rows))
+        dirty = fresh.dirty.at[tgt].set(True, mode="drop")
+        top = jnp.minimum(fresh.top + jnp.sum(dead.astype(jnp.int32)),
+                          new_cr).astype(jnp.int32)
+        return JoinSideState(fresh.key_table, fresh.head, rows, fresh.valids,
+                             fresh.next, fresh.live, dirty, top)
+
+    def _maybe_rebuild(self) -> None:
+        for s in (LEFT, RIGHT):
+            occ, live, top = self._stats(self.sides[s])
+            occ, live, top = int(occ), int(live), int(top)
+            ck, cr = self.key_capacity[s], self.row_capacity[s]
+            if occ <= 0.7 * ck and top <= 0.7 * cr:
+                continue
+            new_ck = ck * 2 if occ > 0.35 * ck else ck
+            new_cr = cr * 2 if live > 0.35 * cr else cr
+            self.sides[s] = self._rehash(self.sides[s], side=s,
+                                         new_ck=new_ck, new_cr=new_cr)
+            self.key_capacity[s], self.row_capacity[s] = new_ck, new_cr
+            self.rebuilds += 1
+
+    # --------------------------------------------------------- watchdog
+    def _drain_telemetry(self, block: bool = False) -> None:
+        while self._telemetry:
+            vals = self._telemetry[0]
+            if not block and not all(v.is_ready() for v in vals):
+                break
+            self._telemetry.popleft()
+            n_un, n_miss, n_mo, n_ro = (int(np.asarray(v)) for v in vals)
+            if n_un:
+                raise RuntimeError(
+                    f"join key-table overflow ({n_un} keys unresolved)")
+            if n_mo:
+                raise RuntimeError(
+                    f"join match-buffer overflow ({n_mo} matches dropped; "
+                    f"raise match_factor)")
+            if n_ro:
+                raise RuntimeError(
+                    f"join row-store overflow ({n_ro} rows dropped)")
+            if n_miss:
+                raise RuntimeError(
+                    f"join changelog inconsistency: {n_miss} deletes matched "
+                    f"no stored row")
+
+    # ----------------------------------------------------------- stream
+    async def execute(self):
+        first = True
+        async for kind, s, msg in barrier_align(*self.inputs):
+            if kind == "chunk":
+                self._drain_telemetry()
+                (self.sides[s], cols, ops, vis, n_un, n_miss, n_mo, n_ro,
+                 occ, top) = self._apply(self.sides[s], self.sides[1 - s],
+                                         msg, side=s)
+                for v in (n_un, n_miss, n_mo, n_ro):
+                    v.copy_to_host_async()
+                self._telemetry.append((n_un, n_miss, n_mo, n_ro))
+                self._dirty_since_flush[s] = True
+                out = StreamChunk(
+                    tuple(cols[i] for i in self.output_indices), ops, vis,
+                    self.schema)
+                if self.condition is not None:
+                    pred = self.condition.eval(cols)
+                    out = out.mask(pred.data & pred.valid_mask())
+                yield out
+            elif kind == "barrier":
+                barrier: Barrier = msg
+                if first or barrier.kind is BarrierKind.INITIAL:
+                    first = False
+                    for st in self.state_tables:
+                        if st is not None:
+                            st.init_epoch(barrier.epoch.curr)
+                    self.recover()
+                    yield barrier
+                    continue
+                self._drain_telemetry(block=True)
+                self._persist(barrier)
+                for s2 in (LEFT, RIGHT):
+                    if (self._pending_clean[s2] is not None
+                            and self.clean_cols[s2] is not None):
+                        self.sides[s2] = self._evict(
+                            self.sides[s2], self._pending_clean[s2], side=s2)
+                        self._pending_clean[s2] = None
+                self._maybe_rebuild()
+                yield barrier
+            else:
+                wm: Watermark = msg
+                if self.clean_cols[s] is not None and wm.col_idx == self.clean_cols[s]:
+                    self._pending_clean[s] = wm.val
+                # key-column watermarks: emit min over both sides on both
+                # output key positions (reference join watermark derivation)
+                if wm.col_idx in self.key_indices[s]:
+                    kpos = self.key_indices[s].index(wm.col_idx)
+                    self._key_wms[s][kpos] = wm.val
+                    other_wm = self._key_wms[1 - s].get(kpos)
+                    if other_wm is not None:
+                        val = min(wm.val, other_wm)
+                        if self._emitted_key_wm.get(kpos) != val:
+                            self._emitted_key_wm[kpos] = val
+                            n_left = len(self.inputs[LEFT].schema)
+                            for full_idx in (self.key_indices[LEFT][kpos],
+                                             n_left + self.key_indices[RIGHT][kpos]):
+                                if full_idx in self.output_indices:
+                                    yield Watermark(
+                                        self.output_indices.index(full_idx),
+                                        wm.data_type, val)

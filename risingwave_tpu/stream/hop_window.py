@@ -3,9 +3,12 @@
 Reference: src/stream/src/executor/hop_window.rs:386 — each input row is
 emitted once per window it falls into (window_size / window_slide copies)
 with computed window_start / window_end columns appended; pure map, no
-state. Here each copy is its own output chunk (same static capacity as the
-input — XLA-friendly), emitted back-to-back: copy k shifts the aligned
-window start back by k slides.
+state. The whole expansion is ONE jitted program emitting ONE chunk of
+static capacity n_windows * input_capacity (copy k shifts the aligned
+window start back by k slides). One big program beats n_windows small ones:
+per-program dispatch overhead through the TPU tunnel is the dominant cost
+for sub-ms kernels, and downstream executors amortize their own per-chunk
+overhead over n_windows times more rows.
 """
 
 from __future__ import annotations
@@ -34,32 +37,46 @@ class HopWindowExecutor(StatelessUnaryExecutor):
         self.size = window_size_us
         self.n_windows = math.ceil(window_size_us / window_slide_us)
         in_fields = list(input.schema)
-        self.schema = Schema(tuple(
-            in_fields + [Field("window_start", DataType.TIMESTAMP),
-                         Field("window_end", DataType.TIMESTAMP)]))
-        self.window_start_idx = len(in_fields)
-        self.window_end_idx = len(in_fields) + 1
+        full_fields = in_fields + [Field("window_start", DataType.TIMESTAMP),
+                                   Field("window_end", DataType.TIMESTAMP)]
+        ws_full, we_full = len(in_fields), len(in_fields) + 1
+        # output pruning (reference hop_window.rs applies output_indices);
+        # window_start_idx / window_end_idx are OUTPUT positions (-1 = pruned)
+        self.output_indices = (tuple(output_indices) if output_indices is not None
+                               else tuple(range(len(full_fields))))
+        self._ws_full, self._we_full = ws_full, we_full
+        self.schema = Schema(tuple(full_fields[i] for i in self.output_indices))
+        def _outpos(full_idx: int) -> int:
+            return self.output_indices.index(full_idx) if full_idx in self.output_indices else -1
+        self.window_start_idx = _outpos(ws_full)
+        self.window_end_idx = _outpos(we_full)
         self.identity = (f"HopWindow(col={time_col}, slide={window_slide_us}us, "
                          f"size={window_size_us}us)")
-        self._step = jax.jit(self._step_impl, static_argnums=1)
+        self._step = jax.jit(self._step_impl)
 
-    def _step_impl(self, chunk: StreamChunk, k: int) -> StreamChunk:
+    def _step_impl(self, chunk: StreamChunk) -> StreamChunk:
+        K = self.n_windows
         ts = chunk.columns[self.time_col].data
+        ks = jnp.repeat(jnp.arange(K, dtype=ts.dtype), chunk.capacity)
+        tiled = lambda a: jnp.tile(a, K)
+        ts_t = tiled(ts)
         # aligned window containing ts, shifted back k slides. floor-div
         # handles negative timestamps correctly (pre-epoch event time).
-        ws = (jnp.floor_divide(ts, self.slide) - k) * self.slide
+        ws = (jnp.floor_divide(ts_t, self.slide) - ks) * self.slide
         we = ws + self.size
         # row in window iff ws <= ts < we; ws <= ts always holds, the upper
         # bound can fail when slide does not divide size
-        vis = chunk.vis & (ts < we)
-        cols = chunk.columns + (Column(ws), Column(we))
-        return StreamChunk(cols, chunk.ops, vis, self.schema)
+        vis = tiled(chunk.vis) & (ts_t < we)
+        full = tuple(
+            Column(tiled(c.data), None if c.valid is None else tiled(c.valid))
+            for c in chunk.columns) + (Column(ws), Column(we))
+        cols = tuple(full[i] for i in self.output_indices)
+        return StreamChunk(cols, tiled(chunk.ops), vis, self.schema)
 
     async def execute(self):
         async for msg in self.input.execute():
             if isinstance(msg, StreamChunk):
-                for k in range(self.n_windows):
-                    yield self._step(msg, k)
+                yield self._step(msg)
             elif isinstance(msg, Watermark):
                 wm = self.map_watermark(msg)
                 if wm is not None:
@@ -71,6 +88,11 @@ class HopWindowExecutor(StatelessUnaryExecutor):
         if wm.col_idx == self.time_col:
             # a watermark on event time implies one on window_start lagged
             # by the full window size (reference derives the same bound)
+            if self.window_start_idx < 0:
+                return None
             ws = (wm.val // self.slide - (self.n_windows - 1)) * self.slide
             return Watermark(self.window_start_idx, DataType.TIMESTAMP, ws)
-        return wm
+        # input-column watermarks remap through the output pruning
+        if wm.col_idx in self.output_indices:
+            return wm.with_idx(self.output_indices.index(wm.col_idx))
+        return None

@@ -10,6 +10,7 @@ offsets are committed to a state table at each checkpoint barrier
 from __future__ import annotations
 
 import asyncio
+from collections import deque
 from typing import Optional, Protocol
 
 from ..common.chunk import StreamChunk
@@ -32,7 +33,8 @@ class SourceExecutor(Executor):
                  barrier_queue: "asyncio.Queue[Barrier]",
                  state_table: Optional[StateTable] = None,
                  rate_limit_rows_per_barrier: Optional[int] = None,
-                 emit_watermarks: bool = False):
+                 emit_watermarks: bool = False,
+                 max_inflight_chunks: int = 16):
         self.source_id = source_id
         self.connector = connector
         self.schema = connector.schema
@@ -46,6 +48,24 @@ class SourceExecutor(Executor):
         # host (no device readback); the source emits after each chunk.
         self.emit_watermarks = emit_watermarks and hasattr(connector, "current_watermark")
         self._last_wm: Optional[int] = None
+        # Device-credit flow control (reference: permit-based exchange
+        # channels, executor/exchange/permit.rs — bounded records in flight).
+        # JAX dispatch is asynchronous: without a bound, the host enqueues
+        # device programs far ahead of execution, queue depth explodes, and
+        # every downstream consistency signal (telemetry readbacks, barrier
+        # collection) lags unboundedly. The TPU runs programs in submission
+        # order, so "chunk N's generator output is ready" implies every
+        # program enqueued before it (the whole pipeline for chunk N-1) has
+        # executed: one token per emitted chunk bounds TOTAL pipeline depth.
+        self.max_inflight_chunks = max_inflight_chunks
+        self._tokens: deque = deque()
+
+    async def _acquire_credit(self) -> None:
+        while len(self._tokens) >= self.max_inflight_chunks:
+            if self._tokens[0].is_ready():
+                self._tokens.popleft()
+            else:
+                await asyncio.sleep(0.002)
 
     def _recover_offset(self) -> None:
         if self.state_table is None:
@@ -100,7 +120,9 @@ class SourceExecutor(Executor):
                 if barrier.is_stop(self.source_id):
                     return
                 continue
+            await self._acquire_credit()
             chunk = self.connector.next_chunk()
+            self._tokens.append(chunk.columns[0].data)
             if self.rate_limit is not None:
                 # visible rows, not padded capacity (device sync is fine here:
                 # throttled sources are not the hot path)

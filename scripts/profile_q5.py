@@ -42,8 +42,8 @@ def main(chunk_size=16384):
 
     hop = HopWindowExecutor(Dummy(gen.schema), time_col=5,
                             window_slide_us=2_000_000, window_size_us=10_000_000)
-    t("hop step (1 of 5 windows)", lambda: hop._step(chunk, 0))
-    hchunk = hop._step(chunk, 0)
+    t("hop step (full expansion)", lambda: hop._step(chunk))
+    hchunk = hop._step(chunk)
 
     agg = HashAggExecutor(Dummy(hop.schema), group_key_indices=[0, hop.window_start_idx],
                           agg_calls=[count_star(append_only=True)], capacity=1 << 16)

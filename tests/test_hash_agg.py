@@ -151,9 +151,9 @@ async def test_max_append_only():
     _, out = await run_agg(msgs, [agg_max(1, append_only=True)])
     chunks = [m for m in out if isinstance(m, StreamChunk)]
     assert chunks[0].to_rows() == [(OP_INSERT, (1, 30))]
-    # max unchanged but group dirty -> UD/UI with same value (reference also
-    # re-emits touched groups; dedup is the materialize/conflict layer's job)
-    assert chunks[1].to_rows() == [(OP_UPDATE_DELETE, (1, 30)), (OP_UPDATE_INSERT, (1, 30))]
+    # max unchanged -> no-change skip: no changelog rows for the touched
+    # group (reference agg_group.rs:71 build_change emits NoChange)
+    assert chunks[1].to_rows() == []
 
 
 async def test_retractable_max_rejected():
@@ -178,13 +178,14 @@ async def test_barrier_time_growth():
 
 
 async def test_overflow_fail_stop():
-    # 8-slot table cannot absorb 20 distinct groups in one epoch: the async
-    # watchdog must fail-stop (recovery replays the epoch in a real cluster)
-    rows = [(OP_INSERT, k, k) for k in range(20)]
-    msgs = [barrier(1, 0, BarrierKind.INITIAL), chunk(rows, cap=32),
-            chunk(rows, cap=32), barrier(2, 1), barrier(3, 2)]
+    # a 32-slot table cannot absorb 80 distinct groups in one epoch: the
+    # async watchdog must fail-stop (recovery replays the epoch in a real
+    # cluster)
+    rows = [(OP_INSERT, k, k) for k in range(80)]
+    msgs = [barrier(1, 0, BarrierKind.INITIAL), chunk(rows, cap=128),
+            chunk(rows, cap=128), barrier(2, 1), barrier(3, 2)]
     with pytest.raises(RuntimeError, match="overflow"):
-        await run_agg(msgs, [count_star()], capacity=8)
+        await run_agg(msgs, [count_star()], capacity=32)
 
 
 async def test_golden_random_stream():
@@ -308,3 +309,82 @@ async def test_watermark_state_cleaning():
         s = np.flatnonzero(occ & (keys == k))
         assert len(s) == 1
         assert (rc[s[0]] > 0) == alive
+
+
+async def test_eviction_deletes_from_state_table():
+    """Watermark eviction must bound DURABLE state too: evicted groups are
+    deleted from the state table in the same epoch, and recovery does not
+    resurrect them (ADVICE r1; reference: StateTable::update_watermark ->
+    Hummock table-watermark pruning)."""
+    from risingwave_tpu.common.types import DataType as DT
+    from risingwave_tpu.stream import Watermark
+
+    store = MemoryStateStore()
+
+    def make_table():
+        return StateTable(
+            store, table_id=11,
+            schema=schema(("k", DataType.INT64), ("count", DataType.INT64),
+                          ("sum", DataType.INT64), ("_row_count", DataType.INT64)),
+            pk_indices=[0])
+
+    src_msgs = [
+        barrier(1, 0, BarrierKind.INITIAL),
+        chunk([(OP_INSERT, 10, 1), (OP_INSERT, 20, 2), (OP_INSERT, 30, 3)]),
+        barrier(2, 1),
+        Watermark(0, DT.INT64, 25),
+        chunk([(OP_INSERT, 30, 4)]),
+        barrier(3, 2),
+    ]
+    src = ScriptSource(SCHEMA, src_msgs)
+    agg = HashAggExecutor(src, [0], [count_star(), agg_sum(1)], capacity=64,
+                          state_table=make_table(), cleaning_watermark_col=0)
+    async for _ in agg.execute():
+        pass
+    store.sync(3)
+    # only group 30 remains durable
+    survivors = sorted(r[0] for _, r in make_table().iter_all())
+    assert survivors == [30]
+
+    # recovery sees no zombie groups
+    msgs2 = [barrier(4, 3, BarrierKind.INITIAL),
+             chunk([(OP_INSERT, 30, 5)]), barrier(5, 4)]
+    agg2_src = ScriptSource(SCHEMA, msgs2)
+    agg2 = HashAggExecutor(agg2_src, [0], [count_star(), agg_sum(1)],
+                           capacity=64, state_table=make_table(),
+                           cleaning_watermark_col=0)
+    out2 = []
+    async for m in agg2.execute():
+        out2.append(m)
+    chunks2 = [m for m in out2 if isinstance(m, StreamChunk)]
+    assert chunks2[0].to_rows() == [
+        (OP_UPDATE_DELETE, (30, 2, 7)), (OP_UPDATE_INSERT, (30, 3, 12))]
+
+
+async def test_recover_beyond_constructor_capacity():
+    """Recovery must succeed even when more rows were persisted than the
+    constructor capacity can hold at target load (ADVICE r1: runtime growth
+    is not persisted; recovery sizes the table from the row count)."""
+    store = MemoryStateStore()
+
+    def make_table():
+        return StateTable(
+            store, table_id=12,
+            schema=schema(("k", DataType.INT64), ("count", DataType.INT64),
+                          ("_row_count", DataType.INT64)),
+            pk_indices=[0])
+
+    rows = [(OP_INSERT, k, 0) for k in range(100)]
+    msgs = [barrier(1, 0, BarrierKind.INITIAL), chunk(rows, cap=128),
+            barrier(2, 1)]
+    await run_agg(msgs, [count_star()], capacity=256, state_table=make_table())
+    store.sync(2)
+
+    # restart with a much smaller constructor capacity than the 100 rows
+    msgs2 = [barrier(3, 2, BarrierKind.INITIAL),
+             chunk([(OP_INSERT, 5, 0)]), barrier(4, 3)]
+    agg2, out2 = await run_agg(msgs2, [count_star()], capacity=32,
+                               state_table=make_table())
+    assert agg2.capacity >= 128
+    rows2 = emitted_rows(out2)
+    assert (OP_UPDATE_INSERT, (5, 2)) in rows2

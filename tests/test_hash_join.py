@@ -1,0 +1,255 @@
+"""HashJoin executor: changelog semantics vs a dict-based golden model.
+
+Mirrors the reference's hash_join.rs #[cfg(test)] style: scripted two-sided
+inputs, assert emitted change rows; a randomized run diffs the accumulated
+changelog against a python multimap inner join.
+"""
+
+import asyncio
+from collections import Counter
+
+import numpy as np
+
+from risingwave_tpu.common import DataType, schema
+from risingwave_tpu.common.chunk import (
+    OP_DELETE, OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT, StreamChunk,
+)
+from risingwave_tpu.common.epoch import EpochPair
+from risingwave_tpu.state import MemoryStateStore, StateTable
+from risingwave_tpu.stream import Barrier, BarrierKind
+from risingwave_tpu.stream.executor import Executor
+from risingwave_tpu.stream.hash_join import HashJoinExecutor
+
+L_SCHEMA = schema(("k", DataType.INT64), ("lv", DataType.INT64))
+R_SCHEMA = schema(("k", DataType.INT64), ("rv", DataType.INT64))
+
+
+class ScriptSource(Executor):
+    def __init__(self, sch, messages):
+        self.schema = sch
+        self.messages = messages
+        self.identity = "ScriptSource"
+
+    async def execute(self):
+        for m in self.messages:
+            yield m
+            await asyncio.sleep(0)
+
+
+def chunk(sch, rows, cap=16):
+    ops = np.asarray([r[0] for r in rows], dtype=np.int8)
+    cols = [np.asarray([r[1 + i] for r in rows], dtype=np.int64)
+            for i in range(len(sch))]
+    return StreamChunk.from_numpy(sch, cols, ops=ops, capacity=cap)
+
+
+def barrier(curr, prev, kind=BarrierKind.CHECKPOINT):
+    return Barrier(EpochPair(curr, prev), kind)
+
+
+async def run_join(l_msgs, r_msgs, **kw):
+    kw.setdefault("key_capacity", 64)
+    kw.setdefault("row_capacity", 64)
+    join = HashJoinExecutor(
+        ScriptSource(L_SCHEMA, l_msgs), ScriptSource(R_SCHEMA, r_msgs),
+        left_key_indices=[0], right_key_indices=[0],
+        left_pk_indices=[1], right_pk_indices=[1], **kw)
+    out = []
+    async for m in join.execute():
+        out.append(m)
+    return join, out
+
+
+def emitted(out):
+    rows = []
+    for m in out:
+        if isinstance(m, StreamChunk):
+            rows.extend(m.to_rows())
+    return rows
+
+
+def changelog_counter(out):
+    c = Counter()
+    for op, row in emitted(out):
+        sign = 1 if op in (OP_INSERT, OP_UPDATE_INSERT) else -1
+        c[row] += sign
+    return +c
+
+
+async def test_inner_join_basic():
+    l = [barrier(1, 0, BarrierKind.INITIAL),
+         chunk(L_SCHEMA, [(OP_INSERT, 1, 10), (OP_INSERT, 2, 20)]),
+         barrier(2, 1)]
+    r = [barrier(1, 0, BarrierKind.INITIAL),
+         chunk(R_SCHEMA, [(OP_INSERT, 1, 100), (OP_INSERT, 3, 300)]),
+         barrier(2, 1)]
+    _, out = await run_join(l, r)
+    assert changelog_counter(out) == Counter({(1, 10, 1, 100): 1})
+
+
+async def test_join_both_orders_and_duplicates():
+    # left rows arrive first epoch; right rows with duplicate keys second
+    l = [barrier(1, 0, BarrierKind.INITIAL),
+         chunk(L_SCHEMA, [(OP_INSERT, 1, 10), (OP_INSERT, 1, 11)]),
+         barrier(2, 1),
+         barrier(3, 2)]
+    r = [barrier(1, 0, BarrierKind.INITIAL),
+         barrier(2, 1),
+         chunk(R_SCHEMA, [(OP_INSERT, 1, 100), (OP_INSERT, 1, 101),
+                          (OP_INSERT, 1, 102)]),
+         barrier(3, 2)]
+    _, out = await run_join(l, r)
+    want = Counter({(1, lv, 1, rv): 1
+                    for lv in (10, 11) for rv in (100, 101, 102)})
+    assert changelog_counter(out) == want
+
+
+async def test_join_retraction():
+    l = [barrier(1, 0, BarrierKind.INITIAL),
+         chunk(L_SCHEMA, [(OP_INSERT, 1, 10)]),
+         barrier(2, 1),
+         barrier(3, 2)]
+    r = [barrier(1, 0, BarrierKind.INITIAL),
+         chunk(R_SCHEMA, [(OP_INSERT, 1, 100)]),
+         barrier(2, 1),
+         chunk(R_SCHEMA, [(OP_DELETE, 1, 100)]),
+         barrier(3, 2)]
+    _, out = await run_join(l, r)
+    rows = emitted(out)
+    assert (OP_INSERT, (1, 10, 1, 100)) in rows
+    assert (OP_DELETE, (1, 10, 1, 100)) in rows
+    assert changelog_counter(out) == Counter()
+
+
+async def test_join_update_pair_retracts_old_match():
+    """An UD/UI pair on the right (e.g. a max-agg output) swaps matches."""
+    l = [barrier(1, 0, BarrierKind.INITIAL),
+         chunk(L_SCHEMA, [(OP_INSERT, 5, 50), (OP_INSERT, 7, 70)]),
+         barrier(2, 1),
+         barrier(3, 2)]
+    r = [barrier(1, 0, BarrierKind.INITIAL),
+         chunk(R_SCHEMA, [(OP_INSERT, 5, 900)]),
+         barrier(2, 1),
+         chunk(R_SCHEMA, [(OP_UPDATE_DELETE, 5, 900), (OP_UPDATE_INSERT, 7, 900)]),
+         barrier(3, 2)]
+    _, out = await run_join(l, r)
+    assert changelog_counter(out) == Counter({(7, 70, 7, 900): 1})
+
+
+async def test_join_within_chunk_update_pair_same_key():
+    # UD/UI with the same key and pk: delete-then-insert must leave the new row
+    l = [barrier(1, 0, BarrierKind.INITIAL),
+         chunk(L_SCHEMA, [(OP_INSERT, 1, 10)]),
+         barrier(2, 1),
+         barrier(3, 2)]
+    r = [barrier(1, 0, BarrierKind.INITIAL),
+         chunk(R_SCHEMA, [(OP_INSERT, 1, 100)]),
+         barrier(2, 1),
+         # same pk 100, same key: value-in-place change modeled as UD/UI
+         chunk(R_SCHEMA, [(OP_UPDATE_DELETE, 1, 100), (OP_UPDATE_INSERT, 1, 100)]),
+         barrier(3, 2)]
+    join, out = await run_join(l, r)
+    assert changelog_counter(out) == Counter({(1, 10, 1, 100): 1})
+    live = np.asarray(join.sides[1].live)
+    assert live.sum() == 1
+
+
+async def test_join_condition():
+    from risingwave_tpu.expr import call, col, lit
+    cond = call("greater_than", col(3), col(1))  # rv > lv
+    l = [barrier(1, 0, BarrierKind.INITIAL),
+         chunk(L_SCHEMA, [(OP_INSERT, 1, 10), (OP_INSERT, 1, 200)]),
+         barrier(2, 1)]
+    r = [barrier(1, 0, BarrierKind.INITIAL),
+         chunk(R_SCHEMA, [(OP_INSERT, 1, 100)]),
+         barrier(2, 1)]
+    _, out = await run_join(l, r, condition=cond)
+    assert changelog_counter(out) == Counter({(1, 10, 1, 100): 1})
+
+
+async def test_join_persist_recover():
+    store = MemoryStateStore()
+
+    def tables():
+        return (StateTable(store, 20, L_SCHEMA, pk_indices=[1]),
+                StateTable(store, 21, R_SCHEMA, pk_indices=[1]))
+
+    l = [barrier(1, 0, BarrierKind.INITIAL),
+         chunk(L_SCHEMA, [(OP_INSERT, 1, 10), (OP_INSERT, 2, 20)]),
+         barrier(2, 1)]
+    r = [barrier(1, 0, BarrierKind.INITIAL),
+         chunk(R_SCHEMA, [(OP_INSERT, 1, 100)]),
+         barrier(2, 1)]
+    await run_join(l, r, state_tables=tables())
+    store.sync(2)
+
+    # restart: right side gains a row matching recovered left row 2
+    l2 = [barrier(3, 2, BarrierKind.INITIAL), barrier(4, 3)]
+    r2 = [barrier(3, 2, BarrierKind.INITIAL),
+          chunk(R_SCHEMA, [(OP_INSERT, 2, 200)]),
+          barrier(4, 3)]
+    _, out2 = await run_join(l2, r2, state_tables=tables())
+    assert changelog_counter(out2) == Counter({(2, 20, 2, 200): 1})
+
+
+async def test_join_golden_random():
+    """Random inserts/deletes on both sides; the accumulated changelog must
+    equal the inner join of the final live multisets."""
+    rng = np.random.default_rng(7)
+    live = [dict(), dict()]      # side -> pk -> key  (pk unique per side)
+    l_msgs = [barrier(1, 0, BarrierKind.INITIAL)]
+    r_msgs = [barrier(1, 0, BarrierKind.INITIAL)]
+    msgs = (l_msgs, r_msgs)
+    next_pk = [0, 1_000_000]
+    for epoch in range(2, 7):
+        for s in (0, 1):
+            rows = []
+            for _ in range(12):
+                if live[s] and rng.random() < 0.35:
+                    pk = int(rng.choice(list(live[s])))
+                    rows.append((OP_DELETE, live[s].pop(pk), pk))
+                else:
+                    k = int(rng.integers(0, 6))
+                    pk = next_pk[s]
+                    next_pk[s] += 1
+                    live[s][pk] = k
+                    rows.append((OP_INSERT, k, pk))
+            msgs[s].append(chunk([L_SCHEMA, R_SCHEMA][s], rows, cap=16))
+            msgs[s].append(barrier(epoch, epoch - 1))
+    _, out = await run_join(l_msgs, r_msgs, key_capacity=256,
+                            row_capacity=256, match_factor=16)
+    want = Counter()
+    for lpk, lk in live[0].items():
+        for rpk, rk in live[1].items():
+            if lk == rk:
+                want[(lk, lpk, rk, rpk)] += 1
+    assert changelog_counter(out) == want
+
+
+async def test_join_state_cleaning():
+    """Rows below the per-side cleaning watermark are evicted from device
+    AND durable state."""
+    from risingwave_tpu.stream import Watermark
+    store = MemoryStateStore()
+
+    def tables():
+        return (StateTable(store, 22, L_SCHEMA, pk_indices=[1]),
+                StateTable(store, 23, R_SCHEMA, pk_indices=[1]))
+
+    l = [barrier(1, 0, BarrierKind.INITIAL),
+         chunk(L_SCHEMA, [(OP_INSERT, 1, 10), (OP_INSERT, 9, 20)]),
+         barrier(2, 1),
+         Watermark(0, DataType.INT64, 5),   # key < 5 expires
+         barrier(3, 2)]
+    r = [barrier(1, 0, BarrierKind.INITIAL),
+         barrier(2, 1),
+         Watermark(0, DataType.INT64, 5),
+         barrier(3, 2)]
+    join, out = await run_join(l, r, state_tables=tables(),
+                               clean_watermark_cols=(0, 0))
+    store.sync(3)
+    lt, _ = tables()
+    remaining = sorted(r[0] for _, r in lt.iter_all())
+    assert remaining == [9]
+    live = np.asarray(join.sides[0].live)
+    assert live.sum() == 1

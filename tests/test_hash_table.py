@@ -1,4 +1,4 @@
-"""Device open-addressing hash table kernel tests."""
+"""Device bucketed (two-choice) hash table kernel tests."""
 
 import jax.numpy as jnp
 import numpy as np
@@ -28,7 +28,7 @@ def test_insert_then_lookup():
 
 
 def test_inactive_rows_ignored():
-    t = HashTable.empty(16, [jnp.int64])
+    t = HashTable.empty(32, [jnp.int64])
     keys = jnp.asarray([1, 2, 3, 4], dtype=jnp.int64)
     active = jnp.asarray([True, False, True, False])
     t, slots, n_un = lookup_or_insert(t, [keys], active)
@@ -38,9 +38,10 @@ def test_inactive_rows_ignored():
     assert int(t.occupied.sum()) == 2
 
 
-def test_collision_chains():
-    # tiny table forces heavy collisions; all 12 distinct keys must fit
-    t = HashTable.empty(16, [jnp.int64])
+def test_collision_heavy():
+    # 2-bucket table forces heavy collisions; 12 distinct keys must fit
+    # (each bucket holds 16, so even all-one-bucket placement fits)
+    t = HashTable.empty(32, [jnp.int64])
     keys = jnp.arange(12, dtype=jnp.int64) * 1000
     t, slots, n_un = lookup_or_insert(t, [keys], jnp.ones(12, dtype=bool))
     assert int(n_un) == 0
@@ -51,10 +52,34 @@ def test_collision_chains():
 
 
 def test_overflow_reported():
-    t = HashTable.empty(8, [jnp.int64])
-    keys = jnp.arange(12, dtype=jnp.int64)  # 12 distinct keys, 8 slots
-    t, slots, n_un = lookup_or_insert(t, [keys], jnp.ones(12, dtype=bool))
-    assert int(n_un) == 4  # exactly the overflow
+    t = HashTable.empty(32, [jnp.int64])
+    keys = jnp.arange(64, dtype=jnp.int64)  # 64 distinct keys, 32 slots
+    t, slots, n_un = lookup_or_insert(t, [keys], jnp.ones(64, dtype=bool))
+    # whatever fits is inserted; the rest is reported, never silent
+    inserted = int(t.occupied.sum())
+    assert int(n_un) == 64 - inserted
+    assert int(n_un) >= 32
+    # resolved rows got real slots, unresolved rows got -1
+    slots = np.asarray(slots)
+    assert (slots >= 0).sum() == inserted
+
+
+def test_incremental_fill_two_choice():
+    # inserting in small batches lets two-choice balancing see real fills;
+    # 28 distinct keys into 32 slots must all land
+    t = HashTable.empty(32, [jnp.int64])
+    all_slots = {}
+    for start in range(0, 28, 4):
+        keys = jnp.arange(start, start + 4, dtype=jnp.int64) * 7919
+        t, slots, n_un = lookup_or_insert(t, [keys], jnp.ones(4, dtype=bool))
+        assert int(n_un) == 0
+        for k, s in zip(range(start, start + 4), np.asarray(slots).tolist()):
+            all_slots[k] = s
+    assert len(set(all_slots.values())) == 28
+    # all keys still findable after the table filled up
+    keys = jnp.asarray(sorted(all_slots), dtype=jnp.int64) * 7919
+    got = np.asarray(lookup(t, [keys], jnp.ones(28, dtype=bool)))
+    np.testing.assert_array_equal(got, [all_slots[k] for k in sorted(all_slots)])
 
 
 def test_multi_column_keys():
