@@ -1,3 +1,5 @@
 from .store import StateStore, MemoryStateStore, WriteBatch, encode_table_key
 from .state_table import StateTable, StateTableError
 from .serde import RowSerde, encode_memcomparable, decode_memcomparable
+from .hummock import HummockStateStore
+from .object_store import ObjectStore, InMemObjectStore, LocalFsObjectStore

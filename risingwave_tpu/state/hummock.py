@@ -1,0 +1,164 @@
+"""Hummock-lite — the durable LSM state store behind checkpoints.
+
+Reference: src/storage/src/hummock/ (shared buffer -> L0 SST upload on
+`sync`, version manifest via meta, compaction; store.rs:172-257 and
+docs/checkpoint.md:38-44). The shape kept here:
+
+- `ingest_batch` stages writes in a per-epoch shared buffer (immediately
+  readable — mem-table read-through semantics match MemoryStateStore).
+- `sync(epoch)` seals every buffered epoch <= `epoch`, merges them into ONE
+  sorted run, uploads it as an L0 SST to the object store, then atomically
+  swaps the manifest (the version-commit step meta performs in the
+  reference). Only after the manifest lands is the epoch committed — a crash
+  at any point recovers to the last manifest, never a torn state.
+- Reads merge: shared buffer (newest epoch wins) > L0 (newest SST wins) > L1.
+- When L0 grows past a threshold, a full compaction merges L0+L1 into one
+  bottom-level SST and drops tombstones (the reference's compactor collapsed
+  to its essential effect).
+
+Recovery: `HummockStateStore.open(object_store)` reads the manifest and
+serves `get`/`iter_range` at the committed version; `committed_epoch()`
+seeds the barrier coordinator's epoch floor.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterator, Optional
+
+from .object_store import ObjectStore
+from .sstable import SsTable, build_sstable
+from .store import StateStore, WriteBatch
+
+MANIFEST_PATH = "MANIFEST"
+
+
+def _sst_path(sst_id: int) -> str:
+    return f"ssts/{sst_id:010d}.sst"
+
+
+class HummockStateStore(StateStore):
+    L0_COMPACT_THRESHOLD = 8
+
+    def __init__(self, object_store: ObjectStore):
+        self.objects = object_store
+        # epoch -> {key: value|None}; dict order = staging order within epoch
+        self._shared: dict[int, dict[bytes, Optional[bytes]]] = {}
+        self._l0: list[SsTable] = []   # newest first
+        self._l1: Optional[SsTable] = None
+        self._next_sst_id = 1
+        self._committed_epoch = 0
+        if object_store.exists(MANIFEST_PATH):
+            self._load_manifest()
+
+    # ------------------------------------------------------------ manifest
+    def _load_manifest(self) -> None:
+        m = json.loads(self.objects.read(MANIFEST_PATH))
+        assert m.get("format") == 1, f"unknown manifest format {m}"
+        self._committed_epoch = m["committed_epoch"]
+        self._next_sst_id = m["next_sst_id"]
+        self._l0 = [SsTable.parse(i, self.objects.read(_sst_path(i)))
+                    for i in m["l0"]]
+        self._l1 = (SsTable.parse(m["l1"], self.objects.read(_sst_path(m["l1"])))
+                    if m["l1"] is not None else None)
+
+    def _write_manifest(self) -> None:
+        m = {
+            "format": 1,
+            "committed_epoch": self._committed_epoch,
+            "next_sst_id": self._next_sst_id,
+            "l0": [t.sst_id for t in self._l0],
+            "l1": self._l1.sst_id if self._l1 is not None else None,
+        }
+        self.objects.upload(MANIFEST_PATH, json.dumps(m).encode())
+
+    # --------------------------------------------------------------- reads
+    def get(self, key: bytes) -> Optional[bytes]:
+        for epoch in sorted(self._shared, reverse=True):
+            buf = self._shared[epoch]
+            if key in buf:
+                return buf[key]
+        for sst in self._l0:
+            found, v = sst.get(key)
+            if found:
+                return v
+        if self._l1 is not None:
+            found, v = self._l1.get(key)
+            if found:
+                return v
+        return None
+
+    def iter_range(self, start: bytes, end: bytes
+                   ) -> Iterator[tuple[bytes, bytes]]:
+        merged: dict[bytes, Optional[bytes]] = {}
+        if self._l1 is not None:
+            for k, v in self._l1.iter_range(start, end):
+                merged[k] = v
+        for sst in reversed(self._l0):           # oldest -> newest overlay
+            for k, v in sst.iter_range(start, end):
+                merged[k] = v
+        for epoch in sorted(self._shared):
+            for k, v in self._shared[epoch].items():
+                if start <= k and (not end or k < end):
+                    merged[k] = v
+        for k in sorted(merged):
+            if merged[k] is not None:
+                yield k, merged[k]
+
+    def committed_epoch(self) -> int:
+        return self._committed_epoch
+
+    # -------------------------------------------------------------- writes
+    def ingest_batch(self, batch: WriteBatch) -> None:
+        self._shared.setdefault(batch.epoch, {}).update(batch.puts)
+
+    def sync(self, epoch: int) -> dict:
+        sealed = sorted(e for e in self._shared if e <= epoch)
+        merged: dict[bytes, Optional[bytes]] = {}
+        for e in sealed:                         # oldest -> newest overlay
+            merged.update(self._shared.pop(e))
+        new_ids: list[int] = []
+        if merged:
+            sst_id = self._next_sst_id
+            self._next_sst_id += 1
+            data = build_sstable(epoch, sorted(merged.items()))
+            self.objects.upload(_sst_path(sst_id), data)
+            self._l0.insert(0, SsTable.parse(sst_id, data))
+            new_ids.append(sst_id)
+        self._committed_epoch = max(self._committed_epoch, epoch)
+        obsolete: list[int] = []
+        if len(self._l0) > self.L0_COMPACT_THRESHOLD:
+            obsolete = self._compact()
+        # manifest swap = the commit point; object deletes strictly after
+        self._write_manifest()
+        for sst_id in obsolete:
+            self.objects.delete(_sst_path(sst_id))
+        return {"uncommitted_ssts": new_ids}
+
+    # ---------------------------------------------------------- compaction
+    def _compact(self) -> list[int]:
+        """Full merge of L1 + L0 into one bottom-level SST; tombstones are
+        dropped (nothing lives below L1). Returns obsolete sst ids — the
+        caller deletes them only after the new manifest is durable."""
+        merged: dict[bytes, Optional[bytes]] = {}
+        if self._l1 is not None:
+            merged.update(zip(self._l1.keys, self._l1.vals))
+        for sst in reversed(self._l0):
+            merged.update(zip(sst.keys, sst.vals))
+        live = sorted((k, v) for k, v in merged.items() if v is not None)
+        obsolete = [t.sst_id for t in self._l0]
+        if self._l1 is not None:
+            obsolete.append(self._l1.sst_id)
+        sst_id = self._next_sst_id
+        self._next_sst_id += 1
+        data = build_sstable(self._committed_epoch, live)
+        self.objects.upload(_sst_path(sst_id), data)
+        self._l1 = SsTable.parse(sst_id, data)
+        self._l0 = []
+        return obsolete
+
+    # ------------------------------------------------------------- helpers
+    @classmethod
+    def open(cls, object_store: ObjectStore) -> "HummockStateStore":
+        """Recovery entry: attach to whatever the last manifest committed."""
+        return cls(object_store)

@@ -41,8 +41,9 @@ async def test_hop_expansion():
     c = StreamChunk.from_numpy(SCHEMA, [ids, ts], capacity=8)
     hop = HopWindowExecutor(ScriptSource(SCHEMA, [c]), time_col=1,
                             window_slide_us=2_000_000, window_size_us=10_000_000)
+    # expansion is one jitted program -> one chunk of capacity K * input_cap
     out = [m for m in await collect(hop) if isinstance(m, StreamChunk)]
-    assert len(out) == 5
+    assert len(out) == 1 and out[0].capacity == 5 * 8
     rows = [r for ch in out for r in ch.to_rows()]
     # row 1 (ts=10s): windows starting at 2,4,6,8,10 (each [ws, ws+10s))
     ws_row1 = sorted(r[1][2] for r in rows if r[1][0] == 1)
