@@ -116,15 +116,21 @@ class HummockStateStore(StateStore):
         sealed = sorted(e for e in self._shared if e <= epoch)
         merged: dict[bytes, Optional[bytes]] = {}
         for e in sealed:                         # oldest -> newest overlay
-            merged.update(self._shared.pop(e))
+            merged.update(self._shared[e])
         new_ids: list[int] = []
         if merged:
             sst_id = self._next_sst_id
             self._next_sst_id += 1
             data = build_sstable(epoch, sorted(merged.items()))
+            # upload BEFORE dropping the shared-buffer epochs: an upload
+            # failure must leave the staged writes intact so a retry (or
+            # fail-stop replay) can still commit them — popping first would
+            # let a later sync() silently commit a manifest missing them
             self.objects.upload(_sst_path(sst_id), data)
             self._l0.insert(0, SsTable.parse(sst_id, data))
             new_ids.append(sst_id)
+        for e in sealed:
+            del self._shared[e]
         self._committed_epoch = max(self._committed_epoch, epoch)
         obsolete: list[int] = []
         if len(self._l0) > self.L0_COMPACT_THRESHOLD:

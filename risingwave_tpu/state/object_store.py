@@ -64,8 +64,13 @@ class LocalFsObjectStore(ObjectStore):
         os.makedirs(root, exist_ok=True)
 
     def _abs(self, path: str) -> str:
-        p = os.path.normpath(os.path.join(self.root, path))
-        assert p.startswith(os.path.normpath(self.root)), path
+        root = os.path.normpath(self.root)
+        p = os.path.normpath(os.path.join(root, path))
+        # exact-prefix-with-separator check (plain startswith would admit
+        # sibling roots like root+"2"); raise, never assert — containment
+        # must hold under python -O too
+        if p != root and not p.startswith(root + os.sep):
+            raise ValueError(f"object path escapes store root: {path!r}")
         return p
 
     def upload(self, path: str, data: bytes) -> None:

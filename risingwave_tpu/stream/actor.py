@@ -33,14 +33,35 @@ class Actor:
         self.rows_processed = 0
 
     async def run(self) -> None:
+        import asyncio as _asyncio
+        last_token = None
         async for msg in self.consumer.execute():
             if isinstance(msg, StreamChunk):
+                if msg.columns:
+                    last_token = msg.columns[0].data
                 if self.dispatcher is not None:
                     await self.dispatcher.dispatch(msg)
             elif isinstance(msg, Barrier):
                 barrier = msg.with_passed(self.actor_id)
                 if self.dispatcher is not None:
                     await self.dispatcher.dispatch(barrier)
+                # Epoch fence: the barrier is only reported collected once
+                # every device program of the epoch has actually executed
+                # (the chain dispatches asynchronously) — the last chunk
+                # covers per-chunk programs; executor fence tokens cover
+                # barrier-time programs (flush/evict/purge) dispatched
+                # after it. block_until_ready moves no data — on a
+                # tunneled TPU that distinction is critical, a d2h
+                # transfer here would permanently degrade dispatch.
+                # Blocking runs in a worker thread so other actors keep
+                # draining.
+                from .executor import gather_fence_tokens
+                tokens = [last_token] if last_token is not None else []
+                tokens.extend(gather_fence_tokens(self.consumer))
+                for tok in tokens:
+                    if hasattr(tok, "block_until_ready"):
+                        await _asyncio.to_thread(tok.block_until_ready)
+                last_token = None
                 if self.collector is not None:
                     self.collector.collect(self.actor_id, barrier)
                 if barrier.is_stop(self.actor_id):

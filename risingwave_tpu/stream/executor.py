@@ -25,8 +25,42 @@ class Executor:
     def execute(self) -> AsyncIterator[Message]:
         raise NotImplementedError
 
+    def fence_tokens(self) -> list:
+        """Device arrays the epoch fence must wait on at a barrier.
+
+        Per-chunk programs are covered by the last chunk flowing to the
+        actor, but stateful executors dispatch MORE device work while
+        handling the barrier itself (flush/evict/purge/persist views) after
+        yielding their last chunk; the actor blocks on these tokens (no
+        data transfer) before reporting the barrier collected, so an epoch
+        is only 'collected' once all its device programs have executed.
+        Default: delegate to `input`(s); stateful executors add their
+        current state root."""
+        toks: list = []
+        inp = getattr(self, "input", None)
+        if inp is not None:
+            toks.extend(inp.fence_tokens())
+        for i in getattr(self, "inputs", ()) or ():
+            toks.extend(i.fence_tokens())
+        return toks
+
     def __repr__(self):
         return self.identity
+
+
+def gather_fence_tokens(node) -> list:
+    """Duck-typed fence-token walk for arbitrary chain heads (sinks and
+    test harness wrappers often wrap an Executor without subclassing)."""
+    ft = getattr(node, "fence_tokens", None)
+    if callable(ft):
+        return ft()
+    toks: list = []
+    inp = getattr(node, "input", None)
+    if inp is not None:
+        toks.extend(gather_fence_tokens(inp))
+    for i in getattr(node, "inputs", ()) or ():
+        toks.extend(gather_fence_tokens(i))
+    return toks
 
 
 class StatelessUnaryExecutor(Executor):

@@ -22,7 +22,6 @@ the planner's fallback path, not this executor yet).
 
 from __future__ import annotations
 
-from collections import deque
 from dataclasses import dataclass
 from functools import partial
 from typing import Optional, Sequence
@@ -71,7 +70,8 @@ class HashAggExecutor(Executor):
                  agg_calls: Sequence[AggCall], capacity: int = 1 << 16,
                  state_table: Optional[StateTable] = None,
                  group_key_names: Optional[Sequence[str]] = None,
-                 cleaning_watermark_col: Optional[int] = None):
+                 cleaning_watermark_col: Optional[int] = None,
+                 watchdog_interval: Optional[int] = 1):
         self.input = input
         self.group_key_indices = tuple(group_key_indices)
         self.agg_calls = tuple(agg_calls)
@@ -111,11 +111,31 @@ class HashAggExecutor(Executor):
         self._evict = jax.jit(self._evict_impl)
         self._evict_keys = jax.jit(self._evict_keys_impl)
         self._rehash = jax.jit(self._rehash_impl, static_argnums=1)
-        # load/overflow watchdog (see _drain_telemetry)
+        # load/overflow watchdog (see _check_watchdog). watchdog_interval =
+        # barriers between watchdog fetches; None disables the fetch
+        # ENTIRELY (even at stop) — on a tunneled TPU the FIRST d2h
+        # transfer of any kind degrades program dispatch erratically
+        # (measured: ~10-300ms per program, sometimes minutes of stall,
+        # after one np.asarray of an int32[2]), so latency-critical
+        # pipelines must keep the whole process transfer-free. In that
+        # mode correctness rests on CPU-backend tests of the same pipeline
+        # shapes and on device-side zombie purges keeping occupancy
+        # bounded; overflow still accumulates on device for post-hoc
+        # inspection.
+        self.watchdog_interval = watchdog_interval
+        self._barriers_seen = 0
         self.rebuilds = 0
         self._occ_known = 0
-        self._telemetry: deque = deque()
         self._applied_since_flush = False
+        self._overflow_dev = jnp.zeros((), dtype=jnp.int32)
+        self._occ_dev = jnp.zeros((), dtype=jnp.int32)
+        self._watchdog_pack = jax.jit(
+            lambda ov, occ: jnp.stack([ov, occ]))
+
+    def fence_tokens(self) -> list:
+        # the state root depends on every program dispatched this epoch,
+        # including barrier-time evict/purge work
+        return [self.state.table.keys[0]] + super().fence_tokens()
 
     # ------------------------------------------------------------ state
     def _empty_state(self, capacity: int) -> AggState:
@@ -132,7 +152,7 @@ class HashAggExecutor(Executor):
         )
 
     # ------------------------------------------------------- chunk apply
-    def _apply_impl(self, state: AggState, chunk: StreamChunk):
+    def _apply_impl(self, state: AggState, overflow, chunk: StreamChunk):
         key_cols = [chunk.columns[i].data for i in self.group_key_indices]
         table, slots, n_unresolved = lookup_or_insert(
             state.table, key_cols, chunk.vis)
@@ -158,10 +178,13 @@ class HashAggExecutor(Executor):
         dirty = state.dirty.at[seg].set(True, mode="drop")
         new_state = AggState(table, tuple(new_states), row_count, dirty,
                              state.prev_exists, state.prev_emit)
-        # occupancy rides along so the host can watch table load without a
-        # blocking readback (fetched via copy_to_host_async)
+        # watchdog counters stay ON DEVICE: overflow accumulates across the
+        # epoch and occupancy rides along as the latest value; the host
+        # fetches both ONCE per barrier. A d2h copy serializes ~10-100ms
+        # into the device stream on a tunneled TPU, so per-chunk copies are
+        # the difference between wire speed and 100x slower.
         occ = jnp.sum(table.occupied.astype(jnp.int32))
-        return new_state, n_unresolved, occ
+        return new_state, overflow + n_unresolved, occ
 
     # ---------------------------------------------------------- flush
     def _flush_impl(self, state: AggState):
@@ -292,47 +315,44 @@ class HashAggExecutor(Executor):
     def _rebuild(self, new_capacity: int) -> int:
         """Purge zombies / grow via the device-side rehash.
         Returns the rebuilt occupancy (one readback — rebuilds are rare)."""
-        self._drain_telemetry(block=True)
         self.state = self._rehash(self.state, new_capacity)
         self.capacity = new_capacity
         self.rebuilds += 1
         occ, _ = self._live_zombie(self.state)
         return int(occ)
 
-    def _drain_telemetry(self, block: bool = False) -> None:
-        """Consume async-fetched (n_unresolved, occupied) scalars from past
-        applies. Device->host readbacks through the TPU tunnel cost ~100ms
-        when they block, so applies push these with copy_to_host_async and
-        the host pops only entries whose transfer already landed
-        (`is_ready`) — the steady-state loop never blocks on the device.
+    def _check_watchdog(self) -> None:
+        """ONE small blocking fetch of the device-accumulated (overflow,
+        occupied) pair — called per BARRIER, never per chunk. The counters
+        accumulate on device across the epoch; fetching them per chunk
+        gates throughput on d2h copy latency (and `copy_to_host_async`
+        stalls completion-event delivery for seconds on a tunneled TPU —
+        measured, not theoretical — so the fetch is a plain blocking
+        np.asarray of two scalars, ~10-90ms once per barrier).
 
-        Overflow therefore surfaces ~one RTT after the offending chunk:
-        fail-stop before the NEXT checkpoint commits, and exactly-once
-        recovery replays from the last committed epoch (the same contract
-        as any executor failure, SURVEY.md §3.5). Capacity provisioning +
-        barrier-time growth make this a last-resort watchdog."""
-        while self._telemetry:
-            n_un, occ = self._telemetry[0]
-            if not block and not (n_un.is_ready() and occ.is_ready()):
-                break
-            self._telemetry.popleft()
-            n_un = int(np.asarray(n_un))
-            if n_un:
-                raise RuntimeError(
-                    f"hash-agg table overflow mid-epoch ({n_un} rows, "
-                    f"capacity {self.capacity}); recovery must replay the "
-                    f"epoch with a larger table")
-            self._occ_known = int(np.asarray(occ))
+        Overflow fail-stops BEFORE this epoch's checkpoint commits, so a
+        chunk the table dropped rows from is never made durable; recovery
+        replays from the last committed epoch (SURVEY.md §3.5). Capacity
+        provisioning + barrier-time growth make this a last-resort
+        watchdog."""
+        vals = np.asarray(self._watchdog_pack(self._overflow_dev,
+                                              self._occ_dev))
+        n_un = int(vals[0])
+        if n_un:
+            raise RuntimeError(
+                f"hash-agg table overflow mid-epoch ({n_un} rows, "
+                f"capacity {self.capacity}); recovery must replay the "
+                f"epoch with a larger table")
+        self._occ_known = int(vals[1])
 
     def _maybe_rebuild_at_barrier(self) -> None:
         """Barrier-time growth: the table is examined between epochs, when
-        lagged occupancy knowledge is safe to act on. Crossing the high
-        watermark purges zombies (dead windows/groups) or doubles capacity;
-        both re-jit the apply step, which is why it never happens mid-epoch."""
-        self._drain_telemetry()
+        occupancy knowledge from the barrier watchdog fetch is safe to act
+        on. Crossing the high watermark purges zombies (dead windows/
+        groups) or doubles capacity; both re-jit the apply step, which is
+        why it never happens mid-epoch."""
         if self._occ_known <= 0.7 * self.capacity:
             return
-        self._drain_telemetry(block=True)
         occ, live = self._live_zombie(self.state)
         rebuild, cap = needs_rebuild(int(occ), int(live), self.capacity)
         if rebuild:
@@ -445,11 +465,8 @@ class HashAggExecutor(Executor):
         first = True
         async for msg in self.input.execute():
             if isinstance(msg, StreamChunk):
-                self._drain_telemetry()
-                self.state, n_unresolved, occ = self._apply(self.state, msg)
-                n_unresolved.copy_to_host_async()
-                occ.copy_to_host_async()
-                self._telemetry.append((n_unresolved, occ))
+                self.state, self._overflow_dev, self._occ_dev = self._apply(
+                    self.state, self._overflow_dev, msg)
                 self._applied_since_flush = True
             elif isinstance(msg, Barrier):
                 if first or msg.kind is BarrierKind.INITIAL:
@@ -459,6 +476,19 @@ class HashAggExecutor(Executor):
                         self.recover(msg.epoch.curr)
                     yield msg
                     continue
+                self._barriers_seen += 1
+                stopping = msg.mutation is not None and msg.is_stop_any()
+                # watchdog_interval=None => NO fetch ever (not even at
+                # stop): on the tunneled TPU the first d2h transfer stalls
+                # erratically (measured seconds to minutes after a long
+                # run). Correctness in that mode rests on CPU-backend tests
+                # of the same pipeline shapes + device-side zombie purges
+                # below keeping occupancy bounded.
+                if self.watchdog_interval and (
+                        stopping
+                        or (self._applied_since_flush
+                            and self._barriers_seen % self.watchdog_interval == 0)):
+                    self._check_watchdog()
                 self._persist(msg)
                 flushed = self._applied_since_flush
                 if flushed:
@@ -471,6 +501,13 @@ class HashAggExecutor(Executor):
                     self.state = self._evict(self.state, self._pending_clean_wm)
                     self._pending_clean_wm = None
                     flushed = True
+                    if self.watchdog_interval is None:
+                        # transfer-free mode: evicted groups leave zombie
+                        # slots, and without occupancy readbacks the host
+                        # can never trigger a purge — so purge ON DEVICE
+                        # with a same-capacity rehash (compiles once, no
+                        # host roundtrip) to keep occupancy == live set.
+                        self.state = self._rehash(self.state, self.capacity)
                 if flushed:
                     self._maybe_rebuild_at_barrier()
                 yield msg

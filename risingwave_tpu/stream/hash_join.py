@@ -29,7 +29,6 @@ barrier-time rebuild, exactly like HashAgg's zombie purge.
 
 from __future__ import annotations
 
-from collections import deque
 from dataclasses import dataclass
 from functools import partial
 from typing import Optional, Sequence
@@ -159,7 +158,8 @@ class HashJoinExecutor(Executor):
                  condition=None,
                  output_indices: Optional[Sequence[int]] = None,
                  state_tables: Optional[tuple[StateTable, StateTable]] = None,
-                 clean_watermark_cols: tuple[Optional[int], Optional[int]] = (None, None)):
+                 clean_watermark_cols: tuple[Optional[int], Optional[int]] = (None, None),
+                 watchdog_interval: Optional[int] = 1):
         self.inputs = (left, right)
         self.key_indices = (tuple(left_key_indices), tuple(right_key_indices))
         self.pk_indices_side = (tuple(left_pk_indices), tuple(right_pk_indices))
@@ -203,11 +203,31 @@ class HashJoinExecutor(Executor):
         self._rehash = jax.jit(self._rehash_impl,
                                static_argnames=("side", "new_ck", "new_cr"))
         self.rebuilds = 0
-        self._telemetry: deque = deque()
+        # barriers between watchdog fetches; None defers the check to the
+        # Stop barrier (see HashAggExecutor: on a tunneled TPU the first
+        # d2h transfer permanently degrades dispatch, so latency-critical
+        # pipelines keep the steady state transfer-free)
+        self.watchdog_interval = watchdog_interval
+        self._barriers_seen = 0
         self._dirty_since_flush = [False, False]
+        # device-resident watchdog accumulator + latest per-side load stats;
+        # fetched once per barrier (see _apply_impl docstring)
+        self._errs_dev = jnp.zeros(4, dtype=jnp.int32)
+        zero = jnp.zeros((), dtype=jnp.int32)
+        self._occ_dev = [zero, zero]
+        self._top_dev = [zero, zero]
+        self._occ_known = [0, 0]
+        self._top_known = [0, 0]
+        self._watchdog_pack = jax.jit(
+            lambda errs, ol, tl, orr, tr: jnp.concatenate(
+                [errs, jnp.stack([ol, tl, orr, tr])]))
         # watermark bookkeeping: per side, last seen watermark per key position
         self._key_wms: list[dict[int, int]] = [{}, {}]
         self._emitted_key_wm: dict[int, int] = {}
+
+    def fence_tokens(self) -> list:
+        toks = [s.top for s in self.sides if s is not None]
+        return toks + super().fence_tokens()
 
     def _empty(self, side: int) -> JoinSideState:
         return _empty_side(self.key_capacity[side], self.row_capacity[side],
@@ -215,9 +235,13 @@ class HashJoinExecutor(Executor):
 
     # ------------------------------------------------------------- apply
     def _apply_impl(self, own: JoinSideState, other: JoinSideState,
-                    chunk: StreamChunk, side: int):
+                    errs: jnp.ndarray, chunk: StreamChunk, side: int):
         """Probe `other`, emit matches, update `own`. Returns
-        (own', match buffers, telemetry scalars)."""
+        (own', match buffers, errs', occ, top) — errs is the int32[4]
+        device accumulator [unresolved, delete-miss, match-overflow,
+        row-overflow]; it stays on device and the host fetches it once per
+        barrier (a d2h copy serializes into the device stream, so per-chunk
+        fetches would gate throughput on copy latency)."""
         key_idx = self.key_indices[side]
         pk_idx = self.pk_indices_side[side]
         N = chunk.capacity
@@ -344,9 +368,10 @@ class HashJoinExecutor(Executor):
         ops_out = jnp.where(jnp.take(signs, out_own) > 0,
                             OP_INSERT, OP_DELETE).astype(jnp.int8)
         occ = jnp.sum(own.key_table.occupied.astype(jnp.int32))
-        return (own, tuple(cols), ops_out, out_vis,
-                n_un, n_del_miss, n_match_overflow, n_row_overflow,
-                occ, own.top)
+        errs = errs + jnp.stack([
+            n_un, n_del_miss, n_match_overflow, n_row_overflow,
+        ]).astype(jnp.int32)
+        return (own, tuple(cols), ops_out, out_vis, errs, occ, own.top)
 
     # ------------------------------------------------------- persistence
     def _persist_view_impl(self, side_state: JoinSideState):
@@ -438,8 +463,10 @@ class HashJoinExecutor(Executor):
             chunk = StreamChunk.from_numpy(sch, arrays, capacity=cap)
             out = self._apply(self.sides[s],
                               self._empty(1 - s) if self.sides[1 - s] is None
-                              else self.sides[1 - s], chunk, side=s)
+                              else self.sides[1 - s], self._errs_dev, chunk,
+                              side=s)
             self.sides[s] = out[0]
+            self._errs_dev = out[4]
             # recovery rows are already durable: clear dirty
             side = self.sides[s]
             self.sides[s] = JoinSideState(
@@ -492,9 +519,13 @@ class HashJoinExecutor(Executor):
 
     def _maybe_rebuild(self) -> None:
         for s in (LEFT, RIGHT):
+            ck, cr = self.key_capacity[s], self.row_capacity[s]
+            # load knowledge from the barrier watchdog fetch gates the
+            # (rare, blocking) exact stats readback — same scheme as HashAgg
+            if self._occ_known[s] <= 0.7 * ck and self._top_known[s] <= 0.7 * cr:
+                continue
             occ, live, top = self._stats(self.sides[s])
             occ, live, top = int(occ), int(live), int(top)
-            ck, cr = self.key_capacity[s], self.row_capacity[s]
             if occ <= 0.7 * ck and top <= 0.7 * cr:
                 continue
             new_ck = ck * 2 if occ > 0.35 * ck else ck
@@ -503,42 +534,48 @@ class HashJoinExecutor(Executor):
                                          new_ck=new_ck, new_cr=new_cr)
             self.key_capacity[s], self.row_capacity[s] = new_ck, new_cr
             self.rebuilds += 1
+            occ2, _, top2 = self._stats(self.sides[s])
+            self._occ_known[s], self._top_known[s] = int(occ2), int(top2)
 
     # --------------------------------------------------------- watchdog
-    def _drain_telemetry(self, block: bool = False) -> None:
-        while self._telemetry:
-            vals = self._telemetry[0]
-            if not block and not all(v.is_ready() for v in vals):
-                break
-            self._telemetry.popleft()
-            n_un, n_miss, n_mo, n_ro = (int(np.asarray(v)) for v in vals)
-            if n_un:
-                raise RuntimeError(
-                    f"join key-table overflow ({n_un} keys unresolved)")
-            if n_mo:
-                raise RuntimeError(
-                    f"join match-buffer overflow ({n_mo} matches dropped; "
-                    f"raise match_factor)")
-            if n_ro:
-                raise RuntimeError(
-                    f"join row-store overflow ({n_ro} rows dropped)")
-            if n_miss:
-                raise RuntimeError(
-                    f"join changelog inconsistency: {n_miss} deletes matched "
-                    f"no stored row")
+    def _check_watchdog(self) -> None:
+        """ONE small blocking fetch of the device-accumulated error counts
+        and per-side load stats — called per BARRIER, never per chunk (a
+        per-chunk d2h fetch gates throughput on copy latency, and
+        `copy_to_host_async` stalls completion-event delivery for seconds
+        on a tunneled TPU). Errors fail-stop BEFORE this epoch's checkpoint
+        commits; recovery replays from the last committed epoch."""
+        vals = np.asarray(self._watchdog_pack(
+            self._errs_dev, self._occ_dev[LEFT], self._top_dev[LEFT],
+            self._occ_dev[RIGHT], self._top_dev[RIGHT]))
+        n_un, n_miss, n_mo, n_ro = (int(x) for x in vals[:4])
+        for s in (LEFT, RIGHT):
+            self._occ_known[s] = int(vals[4 + 2 * s])
+            self._top_known[s] = int(vals[5 + 2 * s])
+        if n_un:
+            raise RuntimeError(
+                f"join key-table overflow ({n_un} keys unresolved)")
+        if n_mo:
+            raise RuntimeError(
+                f"join match-buffer overflow ({n_mo} matches dropped; "
+                f"raise match_factor)")
+        if n_ro:
+            raise RuntimeError(
+                f"join row-store overflow ({n_ro} rows dropped)")
+        if n_miss:
+            raise RuntimeError(
+                f"join changelog inconsistency: {n_miss} deletes matched "
+                f"no stored row")
 
     # ----------------------------------------------------------- stream
     async def execute(self):
         first = True
         async for kind, s, msg in barrier_align(*self.inputs):
             if kind == "chunk":
-                self._drain_telemetry()
-                (self.sides[s], cols, ops, vis, n_un, n_miss, n_mo, n_ro,
-                 occ, top) = self._apply(self.sides[s], self.sides[1 - s],
-                                         msg, side=s)
-                for v in (n_un, n_miss, n_mo, n_ro):
-                    v.copy_to_host_async()
-                self._telemetry.append((n_un, n_miss, n_mo, n_ro))
+                (self.sides[s], cols, ops, vis, self._errs_dev, occ,
+                 top) = self._apply(self.sides[s], self.sides[1 - s],
+                                    self._errs_dev, msg, side=s)
+                self._occ_dev[s], self._top_dev[s] = occ, top
                 self._dirty_since_flush[s] = True
                 out = StreamChunk(
                     tuple(cols[i] for i in self.output_indices), ops, vis,
@@ -557,7 +594,18 @@ class HashJoinExecutor(Executor):
                     self.recover()
                     yield barrier
                     continue
-                self._drain_telemetry(block=True)
+                self._barriers_seen += 1
+                stopping = barrier.mutation is not None and barrier.is_stop_any()
+                # watchdog_interval=None => NO fetch ever, not even at stop
+                # (same contract as HashAggExecutor: one d2h transfer
+                # permanently degrades tunneled-TPU dispatch); correctness
+                # in that mode rests on CPU-backend tests + the device-side
+                # purge below.
+                if self.watchdog_interval and (
+                        stopping
+                        or (any(self._dirty_since_flush)
+                            and self._barriers_seen % self.watchdog_interval == 0)):
+                    self._check_watchdog()
                 self._persist(barrier)
                 for s2 in (LEFT, RIGHT):
                     if (self._pending_clean[s2] is not None
@@ -565,6 +613,15 @@ class HashJoinExecutor(Executor):
                         self.sides[s2] = self._evict(
                             self.sides[s2], self._pending_clean[s2], side=s2)
                         self._pending_clean[s2] = None
+                        if self.watchdog_interval is None:
+                            # transfer-free mode: reclaim tombstoned rows
+                            # with a same-capacity device rehash — without
+                            # occupancy readbacks the host can never
+                            # trigger one (see HashAggExecutor)
+                            self.sides[s2] = self._rehash(
+                                self.sides[s2], side=s2,
+                                new_ck=self.key_capacity[s2],
+                                new_cr=self.row_capacity[s2])
                 self._maybe_rebuild()
                 yield barrier
             else:

@@ -84,6 +84,11 @@ class Barrier:
     def is_stop(self, actor_id: int) -> bool:
         return isinstance(self.mutation, StopMutation) and actor_id in self.mutation.actor_ids
 
+    def is_stop_any(self) -> bool:
+        """True for any Stop mutation regardless of target actor — used by
+        executors (which don't know their actor id) for end-of-life work."""
+        return isinstance(self.mutation, StopMutation)
+
     def is_pause(self) -> bool:
         return isinstance(self.mutation, PauseMutation) or (
             isinstance(self.mutation, AddMutation) and self.mutation.pause)

@@ -61,11 +61,15 @@ class SourceExecutor(Executor):
         self._tokens: deque = deque()
 
     async def _acquire_credit(self) -> None:
+        # Block (in a worker thread, keeping the event loop live) rather
+        # than poll `is_ready`: on a tunneled TPU, completion events are
+        # only delivered promptly when something blocks — passive polling
+        # sees them ~100s of ms late, which would gate the whole pipeline
+        # to ~4 chunks/s. A blocking wait forces the flush and returns as
+        # soon as the oldest in-flight chunk's pipeline has really run.
         while len(self._tokens) >= self.max_inflight_chunks:
-            if self._tokens[0].is_ready():
-                self._tokens.popleft()
-            else:
-                await asyncio.sleep(0.002)
+            token = self._tokens.popleft()
+            await asyncio.to_thread(token.block_until_ready)
 
     def _recover_offset(self) -> None:
         if self.state_table is None:
