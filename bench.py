@@ -80,6 +80,30 @@ def _numpy_q5(chunks, slide_us=2_000_000, size_us=10_000_000) -> float:
     return time.perf_counter() - t0
 
 
+def _numpy_q7(chunks, window_us=10_000_000) -> float:
+    """Vectorized numpy q7: per-window running max + bids-at-max join.
+    Incremental across chunks like a CPU streaming executor would be."""
+    t0 = time.perf_counter()
+    win_max: dict[int, int] = {}
+    emitted = 0
+    for cols, vis in chunks:
+        price = cols[2][vis]
+        ts = cols[5][vis]
+        we = (ts - ts % window_us) + window_us
+        order = np.argsort(we, kind="stable")
+        we_s, p_s = we[order], price[order]
+        bounds = np.flatnonzero(np.r_[True, we_s[1:] != we_s[:-1]])
+        chunk_max = np.maximum.reduceat(p_s, bounds)
+        for w, m in zip(we_s[bounds], chunk_max):
+            w = int(w)
+            if win_max.get(w, -1) < m:
+                win_max[w] = int(m)
+        # join: bids whose price equals their window's current max
+        cur = np.array([win_max[int(w)] for w in we_s], dtype=p_s.dtype)
+        emitted += int((p_s == cur).sum())
+    return time.perf_counter() - t0
+
+
 def _gen_numpy_chunks(kind: str, n_chunks: int, chunk_size: int, cfg=None):
     """Materialize generator output as numpy (host baseline input)."""
     from risingwave_tpu.connectors import NexmarkGenerator
@@ -104,6 +128,10 @@ def _baseline_main(query: str, n_chunks: int, chunk_size: int) -> None:
     if query == "q1":
         chunks = _gen_numpy_chunks("bid", n_chunks, chunk_size)
         dt = _numpy_q1(chunks)
+    elif query == "q7":
+        cfg = NexmarkConfig(inter_event_us=250)
+        chunks = _gen_numpy_chunks("bid", n_chunks, chunk_size, cfg=cfg)
+        dt = _numpy_q7(chunks)
     else:
         cfg = NexmarkConfig(inter_event_us=2)
         chunks = _gen_numpy_chunks("bid", n_chunks, chunk_size, cfg=cfg)
@@ -272,7 +300,89 @@ async def bench_q5(progress: dict) -> None:
         "q5", n_chunks, chunk_size)
 
 
-QUERIES = {"q1": bench_q1, "q5": bench_q5}
+async def bench_q7(progress: dict) -> None:
+    """q7: tumble-window MAX(price) joined back to bids at the max price
+    (BASELINE config 3) — reference workload
+    /root/reference/src/tests/simulation/src/nexmark/q7.sql. Two actors:
+    source+broadcast, and the join graph (2-input barrier alignment).
+
+    inter_event_us=250 keeps the join's live left side (one window span of
+    bids plus watermark lag) within a 2^17-row device store — join compile
+    and probe cost grow with capacity, and the driver budget caps warmup.
+    """
+    from risingwave_tpu.common import DataType
+    from risingwave_tpu.connectors import NexmarkGenerator
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig
+    from risingwave_tpu.expr import call, col, lit
+    from risingwave_tpu.expr.agg import agg_max
+    from risingwave_tpu.meta import BarrierCoordinator
+    from risingwave_tpu.state import MemoryStateStore
+    from risingwave_tpu.stream import (
+        Actor, BroadcastDispatcher, Channel, ChannelInput, HashAggExecutor,
+        HashJoinExecutor, ProjectExecutor, SourceExecutor,
+    )
+
+    W = 10_000_000          # 10s tumble window, microseconds
+    # join-apply XLA compile time scales superlinearly with chunk capacity
+    # (measured: 8s at 4k rows, 230s at 32k) — q7 uses smaller chunks, and
+    # a small agg table so the barrier flush chunk (2*capacity) stays small
+    chunk_size = 8192
+    cfg = NexmarkConfig(inter_event_us=250)
+    store = MemoryStateStore()
+    barrier_q = asyncio.Queue()
+    gen = NexmarkGenerator("bid", chunk_size=chunk_size, cfg=cfg)
+    src = SourceExecutor(1, gen, barrier_q, emit_watermarks=True,
+                         watermark_lag_us=2 * W)
+    bid4 = ProjectExecutor(
+        src, [col(0), col(1), col(2), col(5, DataType.TIMESTAMP)],
+        names=["auction", "bidder", "price", "date_time"])
+    ch_l, ch_r = Channel(64), Channel(64)
+    disp = BroadcastDispatcher([ch_l, ch_r])
+    BID4 = bid4.schema
+
+    right_in = ChannelInput(ch_r, BID4)
+    tumble = ProjectExecutor(
+        right_in,
+        [call("tumble_end", col(3, DataType.TIMESTAMP), lit(W)), col(2)],
+        names=["window_end", "price"],
+        # tumble_end is monotone: a date_time watermark implies a
+        # window_end watermark, which lets the agg evict closed windows
+        watermark_transforms={3: (0, lambda v: (v - v % W) + W)})
+    agg = HashAggExecutor(tumble, group_key_indices=[0],
+                          agg_calls=[agg_max(1, append_only=True)],
+                          capacity=1 << 12, group_key_names=["window_end"],
+                          cleaning_watermark_col=0,
+                          watchdog_interval=None)
+    cond = call("and",
+                call("greater_than", col(3, DataType.TIMESTAMP),
+                     call("subtract", col(4, DataType.TIMESTAMP), lit(W))),
+                call("less_than_or_equal", col(3, DataType.TIMESTAMP),
+                     col(4, DataType.TIMESTAMP)))
+    join = HashJoinExecutor(
+        ChannelInput(ch_l, BID4), agg,
+        left_key_indices=[2], right_key_indices=[1],
+        left_pk_indices=[0, 1, 2, 3], right_pk_indices=[0],
+        key_capacity=1 << 17, row_capacity=1 << 17, match_factor=2,
+        condition=cond, output_indices=[0, 2, 1, 3],
+        clean_watermark_cols=(3, None), watchdog_interval=None)
+    sink = _DeviceSink(join)
+    coord = BarrierCoordinator(store)
+    coord.register_source(barrier_q)
+    coord.register_actor(1)
+    coord.register_actor(2)
+    t1 = Actor(1, bid4, disp, coord).spawn()
+    t2 = Actor(2, sink, None, coord).spawn()
+    await _measure(coord, gen, sink, progress, MEASURE_S)
+    await coord.stop_all({1, 2})
+    await t1
+    await t2
+
+    n_chunks = max(2, min(16, progress["rows"] // chunk_size))
+    progress["baseline_rows_per_sec"] = _measured_baseline(
+        "q7", n_chunks, chunk_size)
+
+
+QUERIES = {"q1": bench_q1, "q5": bench_q5, "q7": bench_q7}
 
 
 def _emit(query: str, progress: dict, note: str = "") -> None:

@@ -122,8 +122,13 @@ class HashAggExecutor(Executor):
         # shapes and on device-side zombie purges keeping occupancy
         # bounded; overflow still accumulates on device for post-hoc
         # inspection.
+        if watchdog_interval not in (None, 1):
+            raise ValueError(
+                "watchdog_interval must be 1 (check before every checkpoint "
+                "commit) or None (transfer-free mode): any lag would let a "
+                "checkpoint commit state whose overflow counter was never "
+                "checked, defeating the fail-stop contract")
         self.watchdog_interval = watchdog_interval
-        self._barriers_seen = 0
         self.rebuilds = 0
         self._occ_known = 0
         self._applied_since_flush = False
@@ -476,7 +481,6 @@ class HashAggExecutor(Executor):
                         self.recover(msg.epoch.curr)
                     yield msg
                     continue
-                self._barriers_seen += 1
                 stopping = msg.mutation is not None and msg.is_stop_any()
                 # watchdog_interval=None => NO fetch ever (not even at
                 # stop): on the tunneled TPU the first d2h transfer stalls
@@ -485,9 +489,7 @@ class HashAggExecutor(Executor):
                 # of the same pipeline shapes + device-side zombie purges
                 # below keeping occupancy bounded.
                 if self.watchdog_interval and (
-                        stopping
-                        or (self._applied_since_flush
-                            and self._barriers_seen % self.watchdog_interval == 0)):
+                        stopping or self._applied_since_flush):
                     self._check_watchdog()
                 self._persist(msg)
                 flushed = self._applied_since_flush

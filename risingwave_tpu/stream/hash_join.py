@@ -203,12 +203,16 @@ class HashJoinExecutor(Executor):
         self._rehash = jax.jit(self._rehash_impl,
                                static_argnames=("side", "new_ck", "new_cr"))
         self.rebuilds = 0
-        # barriers between watchdog fetches; None defers the check to the
-        # Stop barrier (see HashAggExecutor: on a tunneled TPU the first
-        # d2h transfer permanently degrades dispatch, so latency-critical
-        # pipelines keep the steady state transfer-free)
+        # 1 = fetch + fail-stop before every checkpoint commit; None =
+        # NO fetch ever, not even at stop (see HashAggExecutor: on a
+        # tunneled TPU the first d2h transfer permanently degrades
+        # dispatch, so latency-critical pipelines keep the whole process
+        # transfer-free and rest on CPU-backend tests for correctness)
+        if watchdog_interval not in (None, 1):
+            raise ValueError(
+                "watchdog_interval must be 1 or None (a lagged check would "
+                "let checkpoints commit unverified state)")
         self.watchdog_interval = watchdog_interval
-        self._barriers_seen = 0
         self._dirty_since_flush = [False, False]
         # device-resident watchdog accumulator + latest per-side load stats;
         # fetched once per barrier (see _apply_impl docstring)
@@ -594,7 +598,6 @@ class HashJoinExecutor(Executor):
                     self.recover()
                     yield barrier
                     continue
-                self._barriers_seen += 1
                 stopping = barrier.mutation is not None and barrier.is_stop_any()
                 # watchdog_interval=None => NO fetch ever, not even at stop
                 # (same contract as HashAggExecutor: one d2h transfer
@@ -602,9 +605,7 @@ class HashJoinExecutor(Executor):
                 # in that mode rests on CPU-backend tests + the device-side
                 # purge below.
                 if self.watchdog_interval and (
-                        stopping
-                        or (any(self._dirty_since_flush)
-                            and self._barriers_seen % self.watchdog_interval == 0)):
+                        stopping or any(self._dirty_since_flush)):
                     self._check_watchdog()
                 self._persist(barrier)
                 for s2 in (LEFT, RIGHT):

@@ -26,7 +26,8 @@ from .message import Watermark
 class ProjectExecutor(StatelessUnaryExecutor):
     def __init__(self, input: Executor, exprs: Sequence[Expr],
                  names: Optional[Sequence[str]] = None,
-                 watermark_mapping: Optional[dict[int, int]] = None):
+                 watermark_mapping: Optional[dict[int, int]] = None,
+                 watermark_transforms: Optional[dict] = None):
         super().__init__(input)
         self.exprs = tuple(exprs)
         names = names or [f"expr{i}" for i in range(len(exprs))]
@@ -37,6 +38,11 @@ class ProjectExecutor(StatelessUnaryExecutor):
             e.index: i for i, e in enumerate(self.exprs)
             if type(e).__name__ == "InputRef"
         }
+        # input col idx -> (output col idx, host fn) for watermarks through
+        # MONOTONE non-decreasing expressions (reference: Watermark::
+        # transform_with_expr, e.g. tumble_end) — the caller asserts
+        # monotonicity by providing the transform
+        self.watermark_transforms = dict(watermark_transforms or {})
         self.identity = f"Project({', '.join(map(repr, self.exprs))})"
         self._step = jax.jit(self._step_impl)
 
@@ -48,6 +54,10 @@ class ProjectExecutor(StatelessUnaryExecutor):
         return self._step(chunk)
 
     def map_watermark(self, wm: Watermark):
+        tf = self.watermark_transforms.get(wm.col_idx)
+        if tf is not None:
+            out_idx, fn = tf
+            return Watermark(out_idx, self.schema[out_idx].data_type, fn(wm.val))
         out = self.watermark_mapping.get(wm.col_idx)
         return wm.with_idx(out) if out is not None else None
 

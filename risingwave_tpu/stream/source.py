@@ -34,6 +34,7 @@ class SourceExecutor(Executor):
                  state_table: Optional[StateTable] = None,
                  rate_limit_rows_per_barrier: Optional[int] = None,
                  emit_watermarks: bool = False,
+                 watermark_lag_us: int = 0,
                  max_inflight_chunks: int = 16):
         self.source_id = source_id
         self.connector = connector
@@ -47,6 +48,10 @@ class SourceExecutor(Executor):
         # sources + WatermarkFilterExecutor). The connector computes them on
         # host (no device readback); the source emits after each chunk.
         self.emit_watermarks = emit_watermarks and hasattr(connector, "current_watermark")
+        # watermark lag (reference: WATERMARK FOR ts AS ts - interval):
+        # downstream lookback joins/windows need rows to outlive the raw
+        # event-time frontier by their window span
+        self.watermark_lag_us = watermark_lag_us
         self._last_wm: Optional[int] = None
         # Device-credit flow control (reference: permit-based exchange
         # channels, executor/exchange/permit.rs — bounded records in flight).
@@ -133,7 +138,7 @@ class SourceExecutor(Executor):
                 sent_this_interval += chunk.num_rows_host()
             yield chunk
             if self.emit_watermarks:
-                wm = self.connector.current_watermark()
+                wm = self.connector.current_watermark() - self.watermark_lag_us
                 if self._last_wm is None or wm > self._last_wm:
                     self._last_wm = wm
                     from ..common.types import DataType
