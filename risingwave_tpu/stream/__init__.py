@@ -17,3 +17,6 @@ from .hash_agg import HashAggExecutor
 from .hash_join import HashJoinExecutor
 from .align import barrier_align
 from .hop_window import HopWindowExecutor
+from .dedup import AppendOnlyDedupExecutor
+from .simple_agg import SimpleAggExecutor, StatelessSimpleAggExecutor
+from .top_n import GroupTopNExecutor, top_n

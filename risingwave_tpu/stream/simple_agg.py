@@ -1,0 +1,203 @@
+"""SimpleAgg / StatelessSimpleAgg — global (ungrouped) aggregation.
+
+Reference: src/stream/src/executor/simple_agg.rs (singleton fragment holding
+one global agg group, emitting a changelog row pair at each barrier) and
+stateless_simple_agg.rs (per-chunk partial aggregates BEFORE the exchange —
+the classic two-phase agg split; partials are combined downstream by a
+SimpleAgg).
+
+TPU re-design: the group state is one scalar per agg call; applying a chunk
+is a single jitted segment-reduction with every visible row in segment 0.
+StatelessSimpleAgg emits one partial row per chunk, which is exactly what
+the mesh path psum-combines across shards (SURVEY §2.3 singleton analogue).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.chunk import (
+    Column, StreamChunk, OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT,
+    op_sign,
+)
+from ..common.types import Field, Schema
+from ..expr.agg import AggCall, AggKind
+from ..state.state_table import StateTable
+from .executor import Executor, StatefulUnaryExecutor
+from .message import Barrier, Watermark
+
+
+class StatelessSimpleAggExecutor(Executor):
+    """Emits one Insert row of chunk-local partial aggregates per chunk.
+    Stateless: no barrier work, no state table (reference
+    stateless_simple_agg.rs — partials feed a downstream SimpleAgg)."""
+
+    def __init__(self, input: Executor, agg_calls: Sequence[AggCall]):
+        self.input = input
+        self.agg_calls = tuple(agg_calls)
+        self.specs = tuple(c.spec() for c in agg_calls)
+        self.schema = Schema(tuple(
+            Field(f"agg{j}", c.ret_type) for j, c in enumerate(agg_calls)))
+        self.pk_indices = ()
+        self.identity = "StatelessSimpleAgg"
+        self._step = jax.jit(self._step_impl)
+
+    def _step_impl(self, chunk: StreamChunk):
+        signs = jnp.where(chunk.vis, op_sign(chunk.ops), 0)
+        seg = jnp.zeros(chunk.capacity, dtype=jnp.int32)
+        outs = []
+        for spec, call in zip(self.specs, self.agg_calls):
+            if call.arg is None:
+                values = jnp.zeros(chunk.capacity, dtype=spec.state_dtype)
+                row_signs = signs
+            else:
+                col = chunk.columns[call.arg]
+                values = col.data
+                row_signs = jnp.where(col.valid_mask(), signs, 0)
+            part = spec.partial(values, row_signs, seg, 1)
+            outs.append(spec.emit(part))
+        return tuple(outs)
+
+    async def execute(self):
+        ops = jnp.asarray(np.asarray([OP_INSERT], dtype=np.int8))
+        vis = jnp.ones(1, dtype=bool)
+        async for msg in self.input.execute():
+            if isinstance(msg, StreamChunk):
+                outs = self._step(msg)
+                yield StreamChunk(
+                    tuple(Column(o) for o in outs), ops, vis, self.schema)
+            else:
+                yield msg
+
+
+class SimpleAggExecutor(StatefulUnaryExecutor):
+    """Global agg group in a singleton fragment; emits the UD/UI changelog
+    pair at each barrier (Insert on first emission), like the reference's
+    AggGroup::build_change."""
+
+    def __init__(self, input: Executor, agg_calls: Sequence[AggCall],
+                 state_table: Optional[StateTable] = None,
+                 combine_partials: bool = False):
+        self.input = input
+        self.agg_calls = tuple(agg_calls)
+        self.specs = tuple(c.spec() for c in agg_calls)
+        for c in agg_calls:
+            if c.kind in (AggKind.MIN, AggKind.MAX) and not c.append_only:
+                raise NotImplementedError(
+                    "retractable min/max needs materialized-input state")
+        # combine_partials: input rows are partial STATES from an upstream
+        # StatelessSimpleAgg (two-phase agg); combine instead of re-reduce.
+        self.combine_partials = combine_partials
+        if combine_partials and any(c.arg is None for c in agg_calls):
+            raise ValueError(
+                "combine_partials reads partial values from input columns; "
+                "every agg call needs an arg (count partials are summed)")
+        self.schema = Schema(tuple(
+            Field(f"agg{j}", c.ret_type) for j, c in enumerate(agg_calls)))
+        self.pk_indices = ()
+        self.identity = "SimpleAgg"
+        self.states = tuple(s.init_state(()) for s in self.specs)
+        self.row_count = jnp.zeros((), dtype=jnp.int64)
+        self._emitted = False
+        self._prev_emit: Optional[tuple] = None
+        self._apply = jax.jit(self._apply_impl)
+        self._init_stateful(state_table, 1)
+
+    def fence_tokens(self) -> list:
+        return [self.row_count] + super().fence_tokens()
+
+    def _apply_impl(self, states, row_count, chunk: StreamChunk):
+        signs = jnp.where(chunk.vis, op_sign(chunk.ops), 0)
+        seg = jnp.zeros(chunk.capacity, dtype=jnp.int32)
+        new_states = []
+        for j, (spec, call) in enumerate(zip(self.specs, self.agg_calls)):
+            if call.arg is None:
+                values = jnp.zeros(chunk.capacity, dtype=spec.state_dtype)
+                row_signs = signs
+            else:
+                col = chunk.columns[call.arg]
+                values = col.data
+                row_signs = jnp.where(col.valid_mask(), signs, 0)
+            if self.combine_partials and call.kind is AggKind.COUNT:
+                # partial rows carry COUNTS in the arg column: combining
+                # means summing them, not counting rows
+                v = values.astype(spec.state_dtype) * row_signs.astype(
+                    spec.state_dtype)
+                part = jnp.sum(v)
+            else:
+                part = spec.partial(values, row_signs, seg, 1)[0]
+            new_states.append(spec.combine(states[j], part))
+        rc = row_count + jnp.sum(signs.astype(jnp.int64))
+        return tuple(new_states), rc
+
+    # -------------------------------------------------------------- hooks
+    def on_chunk(self, chunk: StreamChunk) -> None:
+        self.states, self.row_count = self._apply(
+            self.states, self.row_count, chunk)
+        self._dirty_persist = True
+        return None
+
+    def flush(self) -> Optional[StreamChunk]:
+        cur = tuple(
+            np.asarray(spec.emit(st))
+            for spec, st in zip(self.specs, self.states))
+        prev = self._prev_emit
+        existed = self._emitted
+        self._prev_emit = cur
+        self._emitted = True
+        if existed and prev is not None and all(
+                (a == b).all() for a, b in zip(prev, cur)):
+            return None  # NoChange (reference agg_group.rs:71)
+        rows_ops = []
+        if existed:
+            rows_ops.append((OP_UPDATE_DELETE, prev))
+            rows_ops.append((OP_UPDATE_INSERT, cur))
+        else:
+            rows_ops.append((OP_INSERT, cur))
+        cap = 2
+        ops = np.full(cap, OP_INSERT, dtype=np.int8)
+        vis = np.zeros(cap, dtype=bool)
+        cols = [np.zeros(cap, dtype=np.asarray(c).dtype) for c in cur]
+        for i, (op, vals) in enumerate(rows_ops):
+            ops[i] = op
+            vis[i] = True
+            for j, v in enumerate(vals):
+                cols[j][i] = v
+        return StreamChunk(
+            tuple(Column(jnp.asarray(c)) for c in cols),
+            jnp.asarray(ops), jnp.asarray(vis), self.schema)
+
+    def persist(self, barrier: Barrier, flushed) -> None:
+        if self.state_table is None:
+            return
+        if getattr(self, "_dirty_persist", False):
+            self._dirty_persist = False
+            # .item() preserves the state dtype (int() would truncate
+            # floats and overflow on +-inf min/max identities)
+            row = tuple(np.asarray(s).item() for s in self.states) + (
+                int(np.asarray(self.row_count)),)
+            self.state_table.write_chunk_rows([(int(OP_INSERT), (0,) + row)])
+        self.state_table.commit(barrier.epoch.curr)
+
+    def recover_state(self, epoch: int) -> None:
+        row = self.state_table.get_row((0,))
+        if row is None:
+            return
+        vals = row[1:]
+        self.states = tuple(
+            jnp.asarray(v, dtype=s.state_dtype)
+            for v, s in zip(vals[:-1], self.specs))
+        self.row_count = jnp.asarray(vals[-1], dtype=jnp.int64)
+        # recovered state was flushed before the crash: seed prev_emit so
+        # recovery does not re-emit an Insert for an already-emitted group
+        self._prev_emit = tuple(
+            np.asarray(spec.emit(st))
+            for spec, st in zip(self.specs, self.states))
+        self._emitted = True
+
+    def map_watermark(self, wm: Watermark) -> Optional[Watermark]:
+        return None  # no group keys to carry watermarks

@@ -1,0 +1,257 @@
+"""TopN / GroupTopN executors (append-only) with device-resident state.
+
+Reference: src/stream/src/executor/top_n/ — `TopNCache` keeps the rows in
+[0, offset+limit) per group, materialized in a state table, emitting
+changelog rows as entries enter/leave the window
+(top_n_cache.rs, group_top_n.rs, top_n_appendonly.rs).
+
+TPU re-design: per-group state is a dense sorted buffer in HBM —
+  keys_sorted [C, K]  (K = offset + limit; asc or desc)
+  valid       [C, K]  explicit cell validity (no in-band sentinel: a real
+                      row whose order value equals iinfo.max must survive)
+  payload     [C, K]  per output column
+Group lookup reuses the open-addressing HashTable (ungrouped TopN is the
+C=1 degenerate case, no table). Applying a chunk is ONE jitted step:
+  1. slot assignment for each row's group key;
+  2. in-chunk top-K per group: lexsort rows by (slot, sort_key), rank
+     within the slot run, keep rank < K, scatter into cand[C, K];
+  3. merge: lexsort(concat(state, cand), keys=(order, ~valid), axis=1)
+     [:, :K] — invalid cells sort last, payload columns ride along via
+     take_along_axis.
+At each barrier a second jitted step diffs the previous emitted window
+against the new one POSITIONALLY and lays out Delete/Insert rows for dirty
+groups (a positional diff may retract+reinsert a shifted row — a correct,
+slightly redundant changelog; the reference emits minimal diffs). The SAME
+diff chunk is what gets persisted: deletes tombstone rows that left a
+window, so committed state stays bounded by the live windows.
+
+Append-only only: deletions would need refill-from-below (the reference
+fetches from the state table); that retractable variant is future work.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.chunk import Column, StreamChunk, OP_DELETE, OP_INSERT, op_sign
+from ..ops.hash_table import HashTable, lookup_or_insert
+from ..state.state_table import StateTable
+from .executor import Executor, StatefulUnaryExecutor
+from .message import Barrier, Watermark
+
+
+class GroupTopNExecutor(StatefulUnaryExecutor):
+    """Append-only GroupTopN. Output schema == input schema (the reference
+    emits input rows; rank is not a column unless the plan projects it).
+
+    group_key_indices=() gives ungrouped TopN (single window, capacity 1).
+    order_col: the sort column (int-comparable dtypes); descending=False
+    emits the smallest `limit` rows per group after skipping `offset`."""
+
+    def __init__(self, input: Executor, group_key_indices: Sequence[int],
+                 order_col: int, limit: int, offset: int = 0,
+                 descending: bool = False,
+                 capacity: int = 1 << 12,
+                 state_table: Optional[StateTable] = None,
+                 watchdog_interval: Optional[int] = 1):
+        self.input = input
+        self.group_key_indices = tuple(group_key_indices)
+        self.grouped = bool(self.group_key_indices)
+        self.order_col = order_col
+        self.limit = limit
+        self.offset = offset
+        self.K = offset + limit
+        self.descending = descending
+        self.schema = input.schema
+        self.pk_indices = input.pk_indices
+        self.capacity = capacity if self.grouped else 1
+        self.identity = (f"GroupTopN(keys={self.group_key_indices}, "
+                         f"order={order_col}, limit={limit}, offset={offset})")
+        in_schema = input.schema
+        self._key_dtypes = tuple(
+            in_schema[i].data_type.jnp_dtype for i in self.group_key_indices)
+        self._col_dtypes = tuple(f.data_type.jnp_dtype for f in in_schema)
+        self._order_dtype = in_schema[order_col].data_type.jnp_dtype
+        C, K = self.capacity, self.K
+        self.table = (HashTable.empty(C, self._key_dtypes)
+                      if self.grouped else None)
+        self.keys_sorted = jnp.zeros((C, K), dtype=self._order_dtype)
+        self.valid = jnp.zeros((C, K), dtype=bool)
+        self.payload = tuple(
+            jnp.zeros((C, K), dtype=dt) for dt in self._col_dtypes)
+        self.dirty = jnp.zeros(C, dtype=bool)
+        self.prev_keys = jnp.zeros((C, K), dtype=self._order_dtype)
+        self.prev_valid = jnp.zeros((C, K), dtype=bool)
+        self.prev_payload = tuple(
+            jnp.zeros((C, K), dtype=dt) for dt in self._col_dtypes)
+        self._apply = jax.jit(self._apply_impl)
+        self._flush = jax.jit(self._flush_impl)
+        self._errs_dev = jnp.zeros((), dtype=jnp.int32)
+        self._init_stateful(state_table, watchdog_interval)
+
+    def fence_tokens(self) -> list:
+        return [self.valid] + super().fence_tokens()
+
+    # --------------------------------------------------------- chunk step
+    def _apply_impl(self, table, keys_sorted, valid, payload, dirty,
+                    errs, chunk: StreamChunk):
+        N = chunk.capacity
+        K = self.K
+        C = self.capacity
+        active = chunk.vis & (op_sign(chunk.ops) > 0)   # append-only
+        n_viol = jnp.sum((chunk.vis & (op_sign(chunk.ops) < 0))
+                         .astype(jnp.int32))
+        if self.grouped:
+            key_cols = [chunk.columns[i].data
+                        for i in self.group_key_indices]
+            table, slots, n_un = lookup_or_insert(table, key_cols, active)
+            ok = slots >= 0
+            seg = jnp.where(ok, slots, C)
+        else:
+            n_un = jnp.int32(0)
+            ok = active
+            seg = jnp.where(active, 0, C).astype(jnp.int32)
+
+        order_vals = chunk.columns[self.order_col].data
+        # descending: bitwise-not is monotone-decreasing and, unlike unary
+        # minus, cannot overflow at iinfo.min
+        rank_key = (jnp.invert(order_vals) if self.descending
+                    else order_vals)
+        # in-chunk rank within group; inactive rows sort last via ~ok key
+        row_ids = jnp.arange(N, dtype=jnp.int32)
+        order = jnp.lexsort((row_ids, rank_key, seg))
+        sseg = seg[order]
+        new_run = jnp.concatenate([jnp.array([True]), sseg[1:] != sseg[:-1]])
+        pos = jnp.arange(N, dtype=jnp.int32)
+        run_start = jax.lax.cummax(jnp.where(new_run, pos, 0))
+        rank = pos - run_start
+        keep = (sseg < C) & (rank < K)
+        tgt_row = jnp.where(keep, sseg, C)
+        tgt_col = jnp.minimum(rank, K - 1)
+
+        cand_keys = jnp.zeros((C + 1, K), dtype=self._order_dtype)
+        cand_keys = cand_keys.at[tgt_row, tgt_col].set(
+            order_vals[order].astype(self._order_dtype), mode="drop")
+        cand_valid = jnp.zeros((C + 1, K), dtype=bool)
+        cand_valid = cand_valid.at[tgt_row, tgt_col].set(True, mode="drop")
+
+        merged_keys = jnp.concatenate([keys_sorted, cand_keys[:C]], axis=1)
+        merged_valid = jnp.concatenate([valid, cand_valid[:C]], axis=1)
+        mk = jnp.invert(merged_keys) if self.descending else merged_keys
+        # lexsort axis=1: primary = invalid-last, secondary = order key
+        sort_idx = jnp.lexsort((mk, ~merged_valid), axis=1)[:, :K]
+        new_sorted = jnp.take_along_axis(merged_keys, sort_idx, axis=1)
+        new_valid = jnp.take_along_axis(merged_valid, sort_idx, axis=1)
+        new_payload = []
+        for j, (p, dt) in enumerate(zip(payload, self._col_dtypes)):
+            col = chunk.columns[j].data
+            cand_p = jnp.zeros((C + 1, K), dtype=dt)
+            cand_p = cand_p.at[tgt_row, tgt_col].set(
+                col[order].astype(dt), mode="drop")
+            merged_p = jnp.concatenate([p, cand_p[:C]], axis=1)
+            new_payload.append(
+                jnp.take_along_axis(merged_p, sort_idx, axis=1))
+        adds = jax.ops.segment_sum(keep.astype(jnp.int32), tgt_row, C + 1)[:C]
+        touched = adds > 0
+        changed = touched & jnp.any(
+            (new_sorted != keys_sorted) | (new_valid != valid), axis=1)
+        return (table, new_sorted, new_valid, tuple(new_payload),
+                dirty | changed, errs + n_un + n_viol)
+
+    # ------------------------------------------------------- barrier diff
+    def _flush_impl(self, keys_sorted, valid, payload, dirty,
+                    prev_keys, prev_valid, prev_payload):
+        """Positional diff of window [offset, K) between prev and current.
+        Layout: per group, K delete rows then K insert rows (delete before
+        insert keeps downstream MV conflict handling trivial)."""
+        C, K = keys_sorted.shape
+        win = jnp.arange(K)[None, :] >= self.offset
+        in_new = win & valid
+        in_prev = win & prev_valid
+        same = (valid == prev_valid) & (
+            ~valid | (keys_sorted == prev_keys))
+        for p, pp in zip(payload, prev_payload):
+            same = same & (~valid | ~prev_valid | (p == pp))
+        emit_del = dirty[:, None] & in_prev & ~(in_new & same)
+        emit_ins = dirty[:, None] & in_new & ~(in_prev & same)
+        out_vis = jnp.concatenate([emit_del, emit_ins], axis=1).reshape(-1)
+        ops_row = jnp.concatenate(
+            [jnp.full((C, K), OP_DELETE, dtype=jnp.int8),
+             jnp.full((C, K), OP_INSERT, dtype=jnp.int8)],
+            axis=1).reshape(-1)
+        out_cols = [jnp.concatenate([pp, p], axis=1).reshape(-1)
+                    for p, pp in zip(payload, prev_payload)]
+        return out_cols, ops_row, out_vis
+
+    # -------------------------------------------------------------- hooks
+    def on_chunk(self, chunk: StreamChunk) -> None:
+        (self.table, self.keys_sorted, self.valid, self.payload,
+         self.dirty, self._errs_dev) = self._apply(
+            self.table, self.keys_sorted, self.valid, self.payload,
+            self.dirty, self._errs_dev, chunk)
+        return None
+
+    def check_watchdog(self) -> None:
+        n = int(np.asarray(self._errs_dev))
+        if n:
+            raise RuntimeError(
+                f"group-topn overflow or append-only violation ({n} rows, "
+                f"capacity {self.capacity})")
+
+    def flush(self) -> StreamChunk:
+        cols, ops, vis = self._flush(
+            self.keys_sorted, self.valid, self.payload, self.dirty,
+            self.prev_keys, self.prev_valid, self.prev_payload)
+        self.prev_keys = self.keys_sorted
+        self.prev_valid = self.valid
+        self.prev_payload = self.payload
+        self.dirty = jnp.zeros(self.capacity, dtype=bool)
+        return StreamChunk(
+            tuple(Column(c) for c in cols), ops, vis, self.schema)
+
+    def persist(self, barrier: Barrier,
+                flushed: Optional[StreamChunk]) -> None:
+        """Persist the window CHANGELOG: inserts for rows that entered,
+        deletes (tombstones) for rows that left — committed state stays
+        bounded by the live windows (hash_agg's _write_evict_deletes has
+        the same role)."""
+        if self.state_table is None:
+            return
+        if flushed is not None:
+            self.state_table.write_chunk_rows(flushed.to_rows())
+        self.state_table.commit(barrier.epoch.curr)
+
+    def recover_state(self, epoch: int) -> None:
+        rows = [row for _, row in self.state_table.iter_all()]
+        if not rows:
+            return
+        arrays = [np.asarray([r[j] for r in rows])
+                  for j in range(len(self._col_dtypes))]
+        cap = max(64, 1 << int(np.ceil(np.log2(len(rows) + 1))))
+        n = len(rows)
+        vis = np.arange(cap) < n
+        chunk = StreamChunk(
+            tuple(Column(jnp.asarray(np.resize(a, cap))) for a in arrays),
+            jnp.full(cap, OP_INSERT, dtype=jnp.int8),
+            jnp.asarray(vis), self.schema)
+        self.on_chunk(chunk)
+        # recovered windows were already emitted before the crash
+        self.prev_keys = self.keys_sorted
+        self.prev_valid = self.valid
+        self.prev_payload = self.payload
+        self.dirty = jnp.zeros(self.capacity, dtype=bool)
+        self._applied_since_flush = False
+
+    def map_watermark(self, wm: Watermark) -> Optional[Watermark]:
+        return wm if wm.col_idx in self.group_key_indices else None
+
+
+def top_n(input: Executor, order_col: int, limit: int, offset: int = 0,
+          descending: bool = False, **kw) -> GroupTopNExecutor:
+    """Ungrouped TopN (reference top_n_appendonly.rs) — the C=1 case."""
+    return GroupTopNExecutor(input, (), order_col, limit, offset=offset,
+                             descending=descending, **kw)
