@@ -47,6 +47,7 @@ class BarrierCoordinator:
         self.latencies_ns: list[int] = []
         self.committed_epochs: list[int] = []
         self._stopped = False
+        self._failure: Optional[tuple] = None
 
     # -------------------------------------------------------- registration
     def register_source(self, queue: asyncio.Queue) -> None:
@@ -64,9 +65,21 @@ class BarrierCoordinator:
         if not st.remaining:
             st.done.set()
 
+    def actor_failed(self, actor_id: int, exc: BaseException) -> None:
+        """Failure detection (reference: barrier-collection failure on meta
+        triggers global recovery, barrier/recovery.rs:332): a dead actor
+        can never collect, so every in-flight and future barrier wait must
+        fail fast instead of hanging the coordinator forever."""
+        self._failure = (actor_id, exc)
+        for st in self._epochs.values():
+            st.done.set()
+
     # ------------------------------------------------------------ injection
     async def inject_barrier(self, mutation: Optional[Mutation] = None,
                              kind: Optional[BarrierKind] = None) -> Barrier:
+        if self._failure is not None:
+            actor_id, exc = self._failure
+            raise RuntimeError(f"actor {actor_id} died") from exc
         curr = next_epoch(self._prev_epoch)
         epoch = EpochPair(curr, self._prev_epoch)
         if kind is None:
@@ -83,6 +96,12 @@ class BarrierCoordinator:
     async def wait_collected(self, barrier: Barrier) -> None:
         st = self._epochs[barrier.epoch.curr]
         await st.done.wait()
+        if self._failure is not None:
+            actor_id, exc = self._failure
+            raise RuntimeError(
+                f"actor {actor_id} died; epoch {barrier.epoch.curr} cannot "
+                f"complete — recovery must restart from the last committed "
+                f"checkpoint") from exc
         # complete IN ORDER (reference mod.rs:779): this epoch seals epoch.prev
         if barrier.kind is BarrierKind.CHECKPOINT and barrier.epoch.prev != INVALID_EPOCH:
             self.store.sync(barrier.epoch.prev)

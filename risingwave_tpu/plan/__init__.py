@@ -1,0 +1,2 @@
+from .graph import Exchange, Fragment, Node, StreamGraph
+from .build import BUILDERS, BuildEnv, Deployment, build_graph, register_builder

@@ -1,0 +1,377 @@
+"""Fragment-graph builder — the `from_proto` registry seam.
+
+Reference: from_proto/mod.rs:105-126 (41-way `NodeBody` -> ExecutorBuilder
+match) + LocalStreamManager::build_actors (task/stream_manager.rs:253):
+recursively instantiate executors from the plan, wrap the fragment root in
+its dispatcher, spawn actors, register everything with the barrier manager.
+
+Deployment model (v1, single process): each fragment becomes
+`parallelism` actors; inter-fragment edges are bounded channels; hash
+dispatch partitions rows by vnode(dist_keys) across the consumer's actors
+with the contiguous vnode->actor mapping (parallel/mesh.py); a consumer of
+a parallel fragment merges with barrier alignment. State tables of a
+parallel stateful fragment share one table id and split the vnode space by
+bitmap — exactly the reference's vnode-partitioned state contract.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..common.types import DataType, Field as SchemaField, Schema
+from ..common.vnode import VNODE_COUNT
+from ..meta.barrier_manager import BarrierCoordinator
+from ..parallel.mesh import shard_vnode_bitmaps, vnode_to_shard
+from ..state.state_table import StateTable
+from ..state.store import StateStore
+from ..stream import (
+    Actor, AppendOnlyDedupExecutor, BroadcastDispatcher, Channel,
+    ChannelInput, FilterExecutor, GroupTopNExecutor, HashAggExecutor,
+    HashDispatcher, HashJoinExecutor, HopWindowExecutor,
+    MaterializeExecutor, MergeExecutor, ProjectExecutor, RowIdGenExecutor,
+    SimpleAggExecutor, SimpleDispatcher, SourceExecutor,
+    StatelessSimpleAggExecutor,
+)
+from ..stream.executor import Executor
+from .graph import Exchange, Fragment, Node, StreamGraph
+
+BUILDERS: dict[str, Callable] = {}
+
+
+def register_builder(kind: str):
+    def deco(fn):
+        BUILDERS[kind] = fn
+        return fn
+    return deco
+
+
+class BuildEnv:
+    """Shared build-time services: the state store, table-id allocation,
+    and the barrier coordinator being wired up."""
+
+    def __init__(self, store: StateStore, coord: BarrierCoordinator,
+                 channel_capacity: int = 64):
+        self.store = store
+        self.coord = coord
+        self.channel_capacity = channel_capacity
+        self._next_table_id = 1
+        self._next_actor_id = 1
+
+    def alloc_table_id(self) -> int:
+        t = self._next_table_id
+        self._next_table_id += 1
+        return t
+
+    def alloc_actor_id(self) -> int:
+        a = self._next_actor_id
+        self._next_actor_id += 1
+        return a
+
+    def state_table(self, table_id: int, schema: Schema,
+                    pk_indices: Sequence[int],
+                    vnode_bitmap: Optional[np.ndarray] = None) -> StateTable:
+        return StateTable(self.store, table_id=table_id, schema=schema,
+                          pk_indices=pk_indices, vnode_bitmap=vnode_bitmap)
+
+
+@dataclass
+class ActorCtx:
+    """Per-actor build context handed to node builders."""
+
+    env: BuildEnv
+    fragment: Fragment
+    actor_id: int
+    actor_idx: int            # position within the fragment [0, parallelism)
+    vnode_bitmap: Optional[np.ndarray]
+    table_ids: dict           # node id -> table id (shared across actors)
+
+
+@dataclass
+class Deployment:
+    coord: BarrierCoordinator
+    actors: list[Actor] = field(default_factory=list)
+    roots: dict[int, list[Executor]] = field(default_factory=dict)
+    tasks: list[asyncio.Task] = field(default_factory=list)
+
+    def spawn(self) -> "Deployment":
+        self.tasks = [a.spawn() for a in self.actors]
+        return self
+
+    async def stop(self) -> None:
+        await self.coord.stop_all()
+        for t in self.tasks:
+            await t
+
+
+def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
+    dep = Deployment(coord=env.coord)
+    # channels[(up_fid, down_fid, edge_k)][u_actor][d_actor] — one matrix
+    # PER EXCHANGE EDGE, so a fragment consuming the same upstream twice
+    # (self-join) gets independent channels on each input
+    channels: dict[tuple[int, int, int], list[list[Channel]]] = {}
+    built_schema: dict[int, Schema] = {}
+
+    order = graph.topo_order()
+    consumers = {fid: graph.consumers(fid) for fid in order}
+
+    # allocate the channel matrices first (consumers may be built after
+    # producers, but the producer's dispatcher needs the channels)
+    for fid in order:
+        f = graph.fragments[fid]
+        for d_fid, k in consumers[fid]:
+            d = graph.fragments[d_fid]
+            channels[(fid, d_fid, k)] = [
+                [Channel(env.channel_capacity) for _ in range(d.parallelism)]
+                for _ in range(f.parallelism)]
+
+    for fid in order:
+        f = graph.fragments[fid]
+        dep.roots[fid] = []
+        bitmaps = (shard_vnode_bitmaps(f.parallelism)
+                   if f.parallelism > 1 else [None])
+        # table ids are shared across a fragment's actors (vnode-split)
+        frag_tables: dict = {}
+        for idx in range(f.parallelism):
+            actor_id = env.alloc_actor_id()
+            ctx = ActorCtx(env=env, fragment=f, actor_id=actor_id,
+                           actor_idx=idx, vnode_bitmap=bitmaps[idx],
+                           table_ids=frag_tables)
+            # per-actor Exchange occurrence counters: the build walk visits
+            # leaves in the same pre-order as StreamGraph.edges()
+            edge_seen: dict[int, int] = {}
+
+            def build_node(n):
+                if isinstance(n, Exchange):
+                    k = edge_seen.get(n.upstream, 0)
+                    edge_seen[n.upstream] = k + 1
+                    up = graph.fragments[n.upstream]
+                    matrix = channels[(n.upstream, fid, k)]
+                    sch = built_schema[n.upstream]
+                    if up.dispatch == "simple" and up.parallelism > 1:
+                        # NoShuffle: 1:1 actor pairing
+                        return ChannelInput(matrix[idx][idx], sch)
+                    chans = [matrix[u][idx] for u in range(up.parallelism)]
+                    if len(chans) == 1:
+                        return ChannelInput(chans[0], sch)
+                    return MergeExecutor(chans, sch)
+                inputs = [build_node(i) for i in n.inputs]
+                return BUILDERS[n.kind](dict(n.args), inputs, ctx, id(n))
+
+            root = build_node(f.root)
+            dep.roots[fid].append(root)
+            if idx == 0:
+                built_schema[fid] = root.schema
+
+            # output dispatcher
+            cons = consumers[fid]
+            dispatcher = None
+            if cons:
+                per_consumer = []
+                for d_fid, k in cons:
+                    d = graph.fragments[d_fid]
+                    outs = channels[(fid, d_fid, k)][idx]
+                    if f.dispatch == "hash":
+                        per_consumer.append(HashDispatcher(
+                            outs, f.dist_key_indices,
+                            vnode_to_shard(d.parallelism)))
+                    elif f.dispatch == "broadcast":
+                        per_consumer.append(BroadcastDispatcher(outs))
+                    else:
+                        assert d.parallelism == f.parallelism, \
+                            "simple dispatch is 1:1 (NoShuffle)"
+                        per_consumer.append(SimpleDispatcher(outs[idx]))
+                dispatcher = (per_consumer[0] if len(per_consumer) == 1
+                              else FanoutDispatcher(per_consumer))
+            env.coord.register_actor(actor_id)
+            dep.actors.append(Actor(actor_id, root, dispatcher, env.coord))
+    return dep
+
+
+class FanoutDispatcher:
+    """One dispatcher per consumer fragment (reference DispatchExecutor
+    holds a dispatcher LIST, dispatch.rs:421)."""
+
+    def __init__(self, dispatchers):
+        self.dispatchers = list(dispatchers)
+
+    async def dispatch(self, msg) -> None:
+        for d in self.dispatchers:
+            await d.dispatch(msg)
+
+
+# ----------------------------------------------------------------- builders
+
+@register_builder("nexmark_source")
+def _build_source(args, inputs, ctx: ActorCtx, key):
+    from ..connectors import NexmarkGenerator
+    from ..connectors.nexmark import NexmarkConfig
+    assert ctx.fragment.parallelism == 1, \
+        "parallel sources need split assignment (future: SourceManager)"
+    cfg = NexmarkConfig(**args.get("cfg", {})) if args.get("cfg") else None
+    gen = NexmarkGenerator(args["table"],
+                           chunk_size=args.get("chunk_size", 8192),
+                           **({"cfg": cfg} if cfg else {}))
+    barrier_q: asyncio.Queue = asyncio.Queue()
+    ctx.env.coord.register_source(barrier_q)
+    st = None
+    if args.get("durable"):
+        tid = ctx.table_ids.setdefault(key, ctx.env.alloc_table_id())
+        st = ctx.env.state_table(
+            tid, Schema((SchemaField("source_id", DataType.INT64),
+                         SchemaField("offset", DataType.INT64))), (0,))
+    return SourceExecutor(
+        ctx.actor_id, gen, barrier_q, state_table=st,
+        emit_watermarks=args.get("emit_watermarks", False),
+        watermark_lag_us=args.get("watermark_lag_us", 0),
+        rate_limit_rows_per_barrier=args.get("rate_limit"))
+
+
+@register_builder("project")
+def _build_project(args, inputs, ctx, key):
+    return ProjectExecutor(inputs[0], args["exprs"],
+                           names=args.get("names"),
+                           watermark_mapping=args.get("watermark_mapping"),
+                           watermark_transforms=args.get("watermark_transforms"))
+
+
+@register_builder("filter")
+def _build_filter(args, inputs, ctx, key):
+    return FilterExecutor(inputs[0], args["predicate"])
+
+
+@register_builder("hop_window")
+def _build_hop(args, inputs, ctx, key):
+    return HopWindowExecutor(inputs[0], time_col=args["time_col"],
+                             window_slide_us=args["slide_us"],
+                             window_size_us=args["size_us"],
+                             output_indices=args.get("output_indices"))
+
+
+def _agg_state_schema(in_schema: Schema, group_key_indices, agg_calls) -> Schema:
+    fields = [in_schema[i] for i in group_key_indices]
+    fields += [SchemaField(f"state{j}", c.ret_type)
+               for j, c in enumerate(agg_calls)]
+    fields.append(SchemaField("_row_count", DataType.INT64))
+    return Schema(tuple(fields))
+
+
+@register_builder("hash_agg")
+def _build_hash_agg(args, inputs, ctx: ActorCtx, key):
+    st = None
+    if args.get("durable"):
+        gk = tuple(args["group_key_indices"])
+        sch = _agg_state_schema(inputs[0].schema, gk, args["agg_calls"])
+        tid = ctx.table_ids.setdefault(key, ctx.env.alloc_table_id())
+        st = ctx.env.state_table(tid, sch, tuple(range(len(gk))),
+                                 vnode_bitmap=ctx.vnode_bitmap)
+    return HashAggExecutor(
+        inputs[0], args["group_key_indices"], args["agg_calls"],
+        capacity=args.get("capacity", 1 << 16),
+        state_table=st,
+        group_key_names=args.get("group_key_names"),
+        cleaning_watermark_col=args.get("cleaning_watermark_col"),
+        watchdog_interval=args.get("watchdog_interval", 1))
+
+
+@register_builder("hash_join")
+def _build_hash_join(args, inputs, ctx: ActorCtx, key):
+    state_tables = None
+    if args.get("durable"):
+        tabs = []
+        for s, inp in enumerate(inputs):
+            tid = ctx.table_ids.setdefault((key, s), ctx.env.alloc_table_id())
+            pk = tuple(args["left_pk_indices" if s == 0 else "right_pk_indices"])
+            tabs.append(ctx.env.state_table(
+                tid, inp.schema, pk, vnode_bitmap=ctx.vnode_bitmap))
+        state_tables = tuple(tabs)
+    return HashJoinExecutor(
+        inputs[0], inputs[1],
+        left_key_indices=args["left_key_indices"],
+        right_key_indices=args["right_key_indices"],
+        left_pk_indices=args["left_pk_indices"],
+        right_pk_indices=args["right_pk_indices"],
+        key_capacity=args.get("key_capacity", 1 << 14),
+        row_capacity=args.get("row_capacity", 1 << 16),
+        match_factor=args.get("match_factor", 2),
+        condition=args.get("condition"),
+        output_indices=args.get("output_indices"),
+        state_tables=state_tables,
+        clean_watermark_cols=args.get("clean_watermark_cols", (None, None)),
+        watchdog_interval=args.get("watchdog_interval", 1))
+
+
+@register_builder("group_top_n")
+def _build_top_n(args, inputs, ctx: ActorCtx, key):
+    st = None
+    if args.get("durable"):
+        tid = ctx.table_ids.setdefault(key, ctx.env.alloc_table_id())
+        gk = tuple(args.get("group_key_indices", ()))
+        pk = gk + (args["order_col"],) + tuple(inputs[0].pk_indices)
+        st = ctx.env.state_table(tid, inputs[0].schema,
+                                 tuple(dict.fromkeys(pk)),
+                                 vnode_bitmap=ctx.vnode_bitmap)
+    return GroupTopNExecutor(
+        inputs[0], args.get("group_key_indices", ()), args["order_col"],
+        args["limit"], offset=args.get("offset", 0),
+        descending=args.get("descending", False),
+        capacity=args.get("capacity", 1 << 12),
+        state_table=st,
+        watchdog_interval=args.get("watchdog_interval", 1))
+
+
+@register_builder("dedup")
+def _build_dedup(args, inputs, ctx: ActorCtx, key):
+    st = None
+    if args.get("durable"):
+        tid = ctx.table_ids.setdefault(key, ctx.env.alloc_table_id())
+        gk = tuple(args["dedup_key_indices"])
+        sch = Schema(tuple(inputs[0].schema[i] for i in gk))
+        st = ctx.env.state_table(tid, sch, tuple(range(len(gk))),
+                                 vnode_bitmap=ctx.vnode_bitmap)
+    return AppendOnlyDedupExecutor(
+        inputs[0], args["dedup_key_indices"],
+        capacity=args.get("capacity", 1 << 16), state_table=st,
+        watchdog_interval=args.get("watchdog_interval", 1))
+
+
+@register_builder("simple_agg")
+def _build_simple_agg(args, inputs, ctx: ActorCtx, key):
+    st = None
+    if args.get("durable"):
+        calls = args["agg_calls"]
+        fields = [SchemaField("slot", DataType.INT64)]
+        fields += [SchemaField(f"state{j}", c.ret_type)
+                   for j, c in enumerate(calls)]
+        fields.append(SchemaField("_row_count", DataType.INT64))
+        tid = ctx.table_ids.setdefault(key, ctx.env.alloc_table_id())
+        st = ctx.env.state_table(tid, Schema(tuple(fields)), (0,))
+    return SimpleAggExecutor(inputs[0], args["agg_calls"], state_table=st,
+                             combine_partials=args.get("combine_partials",
+                                                       False))
+
+
+@register_builder("stateless_simple_agg")
+def _build_stateless_agg(args, inputs, ctx, key):
+    return StatelessSimpleAggExecutor(inputs[0], args["agg_calls"])
+
+
+@register_builder("row_id_gen")
+def _build_row_id(args, inputs, ctx: ActorCtx, key):
+    return RowIdGenExecutor(inputs[0], instance=ctx.actor_id)
+
+
+@register_builder("materialize")
+def _build_materialize(args, inputs, ctx: ActorCtx, key):
+    tid = ctx.table_ids.setdefault(key, ctx.env.alloc_table_id())
+    st = ctx.env.state_table(tid, inputs[0].schema,
+                             tuple(args.get("pk_indices",
+                                            inputs[0].pk_indices)),
+                             vnode_bitmap=ctx.vnode_bitmap)
+    kw = {}
+    if args.get("conflict") is not None:
+        kw["conflict"] = args["conflict"]
+    return MaterializeExecutor(inputs[0], st, **kw)

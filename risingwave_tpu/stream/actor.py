@@ -33,6 +33,18 @@ class Actor:
         self.rows_processed = 0
 
     async def run(self) -> None:
+        try:
+            await self._run_inner()
+        except BaseException as e:
+            # report the death so barrier collection fails fast instead of
+            # hanging the coordinator (reference: collection failure =>
+            # global recovery, barrier/recovery.rs:332)
+            failed = getattr(self.collector, "actor_failed", None)
+            if failed is not None:
+                failed(self.actor_id, e)
+            raise
+
+    async def _run_inner(self) -> None:
         import asyncio as _asyncio
         last_token = None
         async for msg in self.consumer.execute():

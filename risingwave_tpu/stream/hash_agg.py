@@ -104,7 +104,7 @@ class HashAggExecutor(Executor):
         self.identity = f"HashAgg(keys={self.group_key_indices})"
         self._key_dtypes = tuple(
             in_schema[i].data_type.jnp_dtype for i in self.group_key_indices)
-        self.state = self._empty_state(capacity)
+        self.state = self._initial_state(capacity)
         self._apply = jax.jit(self._apply_impl)
         self._flush = jax.jit(self._flush_impl)
         self._live_zombie = jax.jit(self._live_zombie_impl)
@@ -143,6 +143,12 @@ class HashAggExecutor(Executor):
         return [self.state.table.keys[0]] + super().fence_tokens()
 
     # ------------------------------------------------------------ state
+    def _initial_state(self, capacity: int) -> AggState:
+        """Constructor-time state; sharded variants override this to place
+        global arrays over the mesh while _empty_state stays LOCAL (it is
+        called inside jitted per-shard impls like _rehash_impl)."""
+        return self._empty_state(capacity)
+
     def _empty_state(self, capacity: int) -> AggState:
         table = HashTable.empty(capacity, self._key_dtypes)
         return AggState(
