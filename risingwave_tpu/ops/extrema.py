@@ -1,0 +1,186 @@
+"""Retractable MIN/MAX state — materialized-input top-K value buffers.
+
+Reference: src/stream/src/executor/aggregation/minput.rs — retractable
+extrema keep the input values materialized in a state table with a cached
+top-N window; deleting the current extremum refills from the next cached
+value (or the state table on cache miss).
+
+TPU re-design: per group, a dense buffer of the K best DISTINCT values
+with multiplicities, entirely in HBM:
+
+    vals [C, K]   sorted best-first (desc for max, asc for min)
+    cnts [C, K]   multiplicity per value (0 = empty cell)
+    lossy [C]     True once any insert was dropped past the K-th value —
+                  from then on deletes of untracked values are legal
+
+One jitted update per chunk: net (group, value) deltas by run-reduction,
+top-K chunk candidates per group, then a per-row 2K merge (sort + adjacent
+equal-value combine) — the same merge shape as GroupTopN. Inconsistencies
+(a delete that matches no tracked value while the buffer is NOT lossy, or
+a buffer that empties while rows remain and history was lossy) are counted
+on device and fail-stopped by the executor watchdog before the checkpoint
+commits; the reference instead refills from its state table, which is the
+durable follow-up for this design (buffer persists with the lossy flag).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _order_key(vals, is_max):
+    if not is_max:
+        return vals
+    # ints: bitwise-not is a monotone-decreasing map with no overflow at
+    # the dtype extremes (unary minus overflows at iinfo.min);
+    # floats: negation is safe (-inf is fine)
+    if jnp.issubdtype(vals.dtype, jnp.floating):
+        return -vals
+    return jnp.invert(vals)
+
+
+def extrema_empty(C: int, K: int, dtype) -> tuple:
+    return (jnp.zeros((C, K), dtype=dtype),
+            jnp.zeros((C, K), dtype=jnp.int32),
+            jnp.zeros(C, dtype=bool))
+
+
+def extrema_update(state: tuple, values, valid_in, signs, seg, C: int,
+                   is_max: bool):
+    """Apply one chunk's rows to the buffers.
+
+    values: [N] input column; valid_in: [N] non-null mask; signs: [N] in
+    {-1, 0, +1}; seg: [N] group slot (C = trash). Returns
+    (state', n_err int32)."""
+    vals, cnts, lossy = state
+    K = vals.shape[1]
+    N = values.shape[0]
+    act = (signs != 0) & valid_in & (seg < C)
+    sgs = jnp.where(act, signs, 0)
+    sseg = jnp.where(act, seg, C)
+
+    # ---- net delta per (group, value) run ----
+    okey = _order_key(values, is_max)
+    order = jnp.lexsort((okey, sseg))
+    o_seg = sseg[order]
+    o_val = values[order]
+    o_sign = sgs[order]
+    leader = jnp.concatenate([jnp.array([True]),
+                              (o_seg[1:] != o_seg[:-1])
+                              | (o_val[1:] != o_val[:-1])])
+    run_id = jnp.cumsum(leader.astype(jnp.int32)) - 1
+    run_delta_all = jax.ops.segment_sum(o_sign, run_id, N)
+    run_delta = run_delta_all[run_id]           # per sorted row
+
+    # per-SIGN candidate ranks (zero-delta runs consume no slots):
+    # positives and negatives each get K candidate slots per group. Keeping
+    # the best-K inserts is sound (a dropped insert cannot belong to the
+    # merged top-K this chunk; if it matters later the group is lossy and
+    # underflow fail-stops). Deletes target TRACKED values (<= K distinct
+    # per group), so K delete slots suffice unless one chunk deletes more
+    # than K distinct values of one group — that residue cannot be applied
+    # to a bounded buffer soundly, so it always fail-stops.
+    pos = jnp.arange(N, dtype=jnp.int32)
+
+    def rank_among(mask):
+        """Rank of each masked leader within its group, in value order."""
+        cnt = jnp.cumsum((leader & mask & (o_seg < C)).astype(jnp.int32))
+        seg_start = jax.lax.cummax(jnp.where(
+            jnp.concatenate([jnp.array([True]), o_seg[1:] != o_seg[:-1]]),
+            pos, 0))
+        return (cnt - 1) - (cnt[seg_start] - (leader & mask
+                                              & (o_seg < C))[seg_start])
+
+    is_pos = run_delta > 0
+    is_neg = run_delta < 0
+    rank_pos = rank_among(is_pos)
+    rank_neg = rank_among(is_neg)
+
+    keep_pos = leader & (o_seg < C) & is_pos & (rank_pos < K)
+    drop_pos = leader & (o_seg < C) & is_pos & (rank_pos >= K)
+    keep_neg = leader & (o_seg < C) & is_neg & (rank_neg < K)
+    drop_neg = leader & (o_seg < C) & is_neg & (rank_neg >= K)
+    lossy_seg = jnp.where(drop_pos, o_seg, C)
+    lossy2 = lossy.at[lossy_seg].set(True, mode="drop")
+    err_dropped_del = jnp.sum(drop_neg.astype(jnp.int32))
+
+    def scatter_cand(keep, rank):
+        tgt_row = jnp.where(keep, o_seg, C)
+        tgt_col = jnp.where(keep, jnp.minimum(rank, K - 1), 0)
+        cv = jnp.zeros((C + 1, K), dtype=vals.dtype)
+        cv = cv.at[tgt_row, tgt_col].set(o_val, mode="drop")
+        cc = jnp.zeros((C + 1, K), dtype=jnp.int32)
+        cc = cc.at[tgt_row, tgt_col].set(run_delta, mode="drop")
+        return cv[:C], cc[:C]
+
+    cand_vals_p, cand_cnts_p = scatter_cand(keep_pos, rank_pos)
+    cand_vals_n, cand_cnts_n = scatter_cand(keep_neg, rank_neg)
+    cand_vals = jnp.concatenate([cand_vals_p, cand_vals_n], axis=1)
+    cand_cnts = jnp.concatenate([cand_cnts_p, cand_cnts_n], axis=1)
+
+    # ---- per-group 3K merge (K state + K insert-cands + K delete-cands)
+    m_vals = jnp.concatenate([vals, cand_vals], axis=1)
+    m_cnts = jnp.concatenate([cnts, cand_cnts], axis=1)
+    m_valid = m_cnts != 0
+    sort_idx = jnp.lexsort((_order_key(m_vals, is_max), ~m_valid), axis=1)
+    s_vals = jnp.take_along_axis(m_vals, sort_idx, axis=1)
+    s_cnts = jnp.take_along_axis(m_cnts, sort_idx, axis=1)
+    s_valid = jnp.take_along_axis(m_valid, sort_idx, axis=1)
+    # adjacent equal-value combine (state values and cand values are each
+    # distinct, so at most one duplicate pair per value)
+    dup = (s_valid[:, 1:] & s_valid[:, :-1]
+           & (s_vals[:, 1:] == s_vals[:, :-1]))
+    add = jnp.where(dup, s_cnts[:, 1:], 0)
+    s_cnts = s_cnts.at[:, :-1].add(add)
+    s_valid = s_valid.at[:, 1:].set(jnp.where(dup, False, s_valid[:, 1:]))
+    # negative residue = delete of an untracked value
+    neg = s_valid & (s_cnts < 0)
+    err_neg = jnp.sum((neg & ~lossy2[:, None]).astype(jnp.int32))
+    s_valid = s_valid & (s_cnts > 0)
+    # resort (combined zeros / negatives drop out), keep best K
+    sort2 = jnp.lexsort((_order_key(s_vals, is_max), ~s_valid), axis=1)
+    f_vals = jnp.take_along_axis(s_vals, sort2, axis=1)
+    f_cnts = jnp.take_along_axis(s_cnts, sort2, axis=1)
+    f_valid = jnp.take_along_axis(s_valid, sort2, axis=1)
+    spill = jnp.any(f_valid[:, K:], axis=1)
+    lossy3 = lossy2 | spill
+    out_vals = jnp.where(f_valid[:, :K], f_vals[:, :K], 0)
+    out_cnts = jnp.where(f_valid[:, :K], f_cnts[:, :K], 0)
+    n_err = err_dropped_del + err_neg
+    return (out_vals, out_cnts, lossy3), n_err
+
+
+def extrema_emit(state: tuple, init, dtype):
+    """Best value per group (identity where the buffer is empty)."""
+    vals, cnts, _ = state
+    has = cnts[:, 0] > 0
+    return jnp.where(has, vals[:, 0], jnp.asarray(init, dtype=dtype))
+
+
+def extrema_underflow(state: tuple, row_count) -> jnp.ndarray:
+    """Groups with live rows, an empty buffer, and lossy history — the
+    extremum is unknowable without a durable refill: fail-stop count."""
+    vals, cnts, lossy = state
+    empty = cnts[:, 0] <= 0
+    return jnp.sum((empty & lossy & (row_count > 0)).astype(jnp.int32))
+
+
+def extrema_gather(state: tuple, sel, tgt, C_new: int, K: int, dtype):
+    """Rehash support: move group g's buffers via compaction select `sel`
+    and scatter to `tgt` (same contract as the scalar agg states)."""
+    vals, cnts, lossy = state
+    e_vals = jnp.zeros((C_new, K), dtype=dtype)
+    e_cnts = jnp.zeros((C_new, K), dtype=jnp.int32)
+    e_lossy = jnp.zeros(C_new, dtype=bool)
+    return (e_vals.at[tgt].set(vals[sel], mode="drop"),
+            e_cnts.at[tgt].set(cnts[sel], mode="drop"),
+            e_lossy.at[tgt].set(lossy[sel], mode="drop"))
+
+
+def extrema_mask_keep(state: tuple, keep) -> tuple:
+    """Watermark eviction: zero the buffers of evicted groups."""
+    vals, cnts, lossy = state
+    return (jnp.where(keep[:, None], vals, 0),
+            jnp.where(keep[:, None], cnts, 0),
+            lossy & keep)

@@ -102,9 +102,18 @@ class Deployment:
         return self
 
     async def stop(self) -> None:
-        await self.coord.stop_all()
-        for t in self.tasks:
-            await t
+        try:
+            await self.coord.stop_all()
+        finally:
+            # a failed coordinator raises before the stop barrier reaches
+            # anyone; surviving actors must still be torn down, not leaked
+            for t in self.tasks:
+                if not t.done():
+                    t.cancel()
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
 
 
 def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
@@ -251,10 +260,20 @@ def _build_hop(args, inputs, ctx, key):
                              output_indices=args.get("output_indices"))
 
 
-def _agg_state_schema(in_schema: Schema, group_key_indices, agg_calls) -> Schema:
+def _agg_state_schema(in_schema: Schema, group_key_indices, agg_calls,
+                      minput_k: int) -> Schema:
+    from ..expr.agg import AggKind
     fields = [in_schema[i] for i in group_key_indices]
-    fields += [SchemaField(f"state{j}", c.ret_type)
-               for j, c in enumerate(agg_calls)]
+    for j, c in enumerate(agg_calls):
+        if c.kind in (AggKind.MIN, AggKind.MAX) and not c.append_only:
+            # retractable extrema persist their top-K value buffer
+            fields += [SchemaField(f"s{j}v{k}", c.ret_type)
+                       for k in range(minput_k)]
+            fields += [SchemaField(f"s{j}c{k}", DataType.INT64)
+                       for k in range(minput_k)]
+            fields.append(SchemaField(f"s{j}lossy", DataType.INT64))
+        else:
+            fields.append(SchemaField(f"state{j}", c.ret_type))
     fields.append(SchemaField("_row_count", DataType.INT64))
     return Schema(tuple(fields))
 
@@ -262,9 +281,11 @@ def _agg_state_schema(in_schema: Schema, group_key_indices, agg_calls) -> Schema
 @register_builder("hash_agg")
 def _build_hash_agg(args, inputs, ctx: ActorCtx, key):
     st = None
+    minput_k = args.get("minput_k", 32)
     if args.get("durable"):
         gk = tuple(args["group_key_indices"])
-        sch = _agg_state_schema(inputs[0].schema, gk, args["agg_calls"])
+        sch = _agg_state_schema(inputs[0].schema, gk, args["agg_calls"],
+                                minput_k)
         tid = ctx.table_ids.setdefault(key, ctx.env.alloc_table_id())
         st = ctx.env.state_table(tid, sch, tuple(range(len(gk))),
                                  vnode_bitmap=ctx.vnode_bitmap)
@@ -274,7 +295,8 @@ def _build_hash_agg(args, inputs, ctx: ActorCtx, key):
         state_table=st,
         group_key_names=args.get("group_key_names"),
         cleaning_watermark_col=args.get("cleaning_watermark_col"),
-        watchdog_interval=args.get("watchdog_interval", 1))
+        watchdog_interval=args.get("watchdog_interval", 1),
+        minput_k=minput_k)
 
 
 @register_builder("hash_join")

@@ -36,6 +36,10 @@ from ..common.chunk import (
 )
 from ..common.types import Field, Schema
 from ..expr.agg import AggCall, AggKind
+from ..ops.extrema import (
+    extrema_emit, extrema_empty, extrema_gather, extrema_mask_keep,
+    extrema_underflow, extrema_update,
+)
 from ..ops.hash_table import HashTable, lookup_or_insert, needs_rebuild
 from ..state.state_table import StateTable
 from .executor import Executor
@@ -71,15 +75,18 @@ class HashAggExecutor(Executor):
                  state_table: Optional[StateTable] = None,
                  group_key_names: Optional[Sequence[str]] = None,
                  cleaning_watermark_col: Optional[int] = None,
-                 watchdog_interval: Optional[int] = 1):
+                 watchdog_interval: Optional[int] = 1,
+                 minput_k: int = 32):
         self.input = input
         self.group_key_indices = tuple(group_key_indices)
         self.agg_calls = tuple(agg_calls)
         self.specs = tuple(c.spec() for c in agg_calls)
-        for c in agg_calls:
-            if c.kind in (AggKind.MIN, AggKind.MAX) and not c.append_only:
-                raise NotImplementedError(
-                    "retractable min/max needs materialized-input state")
+        # retractable MIN/MAX use materialized-input top-K value buffers
+        # (reference minput.rs); linear aggs keep one scalar per group
+        self.minput_k = minput_k
+        self._retractable = tuple(
+            c.kind in (AggKind.MIN, AggKind.MAX) and not c.append_only
+            for c in agg_calls)
         in_schema = input.schema
         gk_names = list(group_key_names or
                         [in_schema[i].name for i in self.group_key_indices])
@@ -149,11 +156,27 @@ class HashAggExecutor(Executor):
         called inside jitted per-shard impls like _rehash_impl)."""
         return self._empty_state(capacity)
 
+    # ---- per-call state polymorphism: linear scalar vs extrema buffer
+    def _call_init_state(self, j: int, capacity: int):
+        if self._retractable[j]:
+            return extrema_empty(capacity, self.minput_k,
+                                 self.specs[j].state_dtype)
+        return self.specs[j].init_state((capacity,))
+
+    def _call_emit(self, j: int, st):
+        if self._retractable[j]:
+            # match the scalar path's ret-type cast (schema dtype contract)
+            return extrema_emit(
+                st, self.specs[j].init, self.specs[j].state_dtype).astype(
+                    self.agg_calls[j].ret_type.jnp_dtype)
+        return self.specs[j].emit(st)
+
     def _empty_state(self, capacity: int) -> AggState:
         table = HashTable.empty(capacity, self._key_dtypes)
         return AggState(
             table=table,
-            agg_states=tuple(s.init_state((capacity,)) for s in self.specs),
+            agg_states=tuple(self._call_init_state(j, capacity)
+                             for j in range(len(self.specs))),
             row_count=jnp.zeros(capacity, dtype=jnp.int64),
             dirty=jnp.zeros(capacity, dtype=bool),
             prev_exists=jnp.zeros(capacity, dtype=bool),
@@ -175,17 +198,30 @@ class HashAggExecutor(Executor):
         row_count = state.row_count + jax.ops.segment_sum(
             signs.astype(jnp.int64), seg, C + 1)[:C]
         new_states = []
-        for spec, call, st in zip(self.specs, self.agg_calls, state.agg_states):
+        n_err = jnp.int32(0)
+        for j, (spec, call, st) in enumerate(
+                zip(self.specs, self.agg_calls, state.agg_states)):
             if call.arg is None:
-                values = jnp.zeros(chunk.capacity, dtype=st.dtype)
-                row_signs = signs
+                values = jnp.zeros(chunk.capacity, dtype=spec.state_dtype)
+                valid_in = jnp.ones(chunk.capacity, dtype=bool)
             else:
                 col = chunk.columns[call.arg]
                 values = col.data
-                # NULL inputs don't contribute (reference strict agg semantics)
-                row_signs = jnp.where(col.valid_mask(), signs, 0)
-            part = spec.partial(values, row_signs, seg, C + 1)[:C]
-            new_states.append(spec.combine(st, part))
+                # NULL inputs don't contribute (reference strict agg
+                # semantics)
+                valid_in = col.valid_mask()
+            if self._retractable[j]:
+                st2, e = extrema_update(
+                    st, values.astype(spec.state_dtype), valid_in, signs,
+                    seg, C, is_max=(call.kind is AggKind.MAX))
+                # lossy + emptied + live rows = unknowable extremum
+                e = e + extrema_underflow(st2, row_count)
+                n_err = n_err + e
+                new_states.append(st2)
+            else:
+                row_signs = jnp.where(valid_in, signs, 0)
+                part = spec.partial(values, row_signs, seg, C + 1)[:C]
+                new_states.append(spec.combine(st, part))
         dirty = state.dirty.at[seg].set(True, mode="drop")
         new_state = AggState(table, tuple(new_states), row_count, dirty,
                              state.prev_exists, state.prev_emit)
@@ -195,7 +231,7 @@ class HashAggExecutor(Executor):
         # into the device stream on a tunneled TPU, so per-chunk copies are
         # the difference between wire speed and 100x slower.
         occ = jnp.sum(table.occupied.astype(jnp.int32))
-        return new_state, overflow + n_unresolved, occ
+        return new_state, overflow + n_unresolved + n_err, occ
 
     # ---------------------------------------------------------- flush
     def _flush_impl(self, state: AggState):
@@ -221,8 +257,8 @@ class HashAggExecutor(Executor):
         # a group that existed before, still exists, and whose emitted outputs
         # are all unchanged produces no changelog rows
         unchanged = existed & exists
-        for spec, st, pe in zip(self.specs, state.agg_states, state.prev_emit):
-            unchanged &= spec.emit(st)[d_slot] == pe[d_slot]
+        for j, (st, pe) in enumerate(zip(state.agg_states, state.prev_emit)):
+            unchanged &= self._call_emit(j, st)[d_slot] == pe[d_slot]
 
         # output row j at positions 2j (old) and 2j+1 (new)
         vis_old = is_dirty & existed & ~unchanged   # UD or Delete
@@ -240,8 +276,8 @@ class HashAggExecutor(Executor):
             v = tk[d_slot]
             out_cols.append(interleave(v, v))
         new_emit = []
-        for spec, st, pe in zip(self.specs, state.agg_states, state.prev_emit):
-            cur = spec.emit(st)
+        for j, (st, pe) in enumerate(zip(state.agg_states, state.prev_emit)):
+            cur = self._call_emit(j, st)
             new_emit.append(cur)
             out_cols.append(interleave(pe[d_slot], cur[d_slot]))
 
@@ -278,11 +314,16 @@ class HashAggExecutor(Executor):
         j = self.cleaning_watermark_key
         evict = state.table.occupied & (state.table.keys[j] < watermark)
         keep = ~evict
+        def zero_call(jj, st):
+            if self._retractable[jj]:
+                return extrema_mask_keep(st, keep)
+            return jnp.where(keep, st, self.specs[jj].init)
+
         return AggState(
             table=state.table,
             agg_states=tuple(
-                jnp.where(keep, s, spec.init)
-                for s, spec in zip(state.agg_states, self.specs)),
+                zero_call(jj, st)
+                for jj, st in enumerate(state.agg_states)),
             row_count=jnp.where(keep, state.row_count, 0),
             dirty=state.dirty & keep,
             prev_exists=state.prev_exists & keep,
@@ -309,11 +350,18 @@ class HashAggExecutor(Executor):
         # n_un must be 0 by construction (new_capacity >= live set)
         tgt = jnp.where(active, slots, new_capacity)
         empty = self._empty_state(new_capacity)
+        def gather_call(j, os):
+            if self._retractable[j]:
+                return extrema_gather(os, sel, tgt, new_capacity,
+                                      self.minput_k,
+                                      self.specs[j].state_dtype)
+            return empty.agg_states[j].at[tgt].set(os[sel], mode="drop")
+
         return AggState(
             table=table,
             agg_states=tuple(
-                es.at[tgt].set(os[sel], mode="drop")
-                for es, os in zip(empty.agg_states, state.agg_states)),
+                gather_call(j, os)
+                for j, os in enumerate(state.agg_states)),
             row_count=empty.row_count.at[tgt].set(state.row_count[sel], mode="drop"),
             dirty=empty.dirty.at[tgt].set(state.dirty[sel], mode="drop"),
             prev_exists=empty.prev_exists.at[tgt].set(state.prev_exists[sel], mode="drop"),
@@ -399,7 +447,9 @@ class HashAggExecutor(Executor):
         if not n:
             return
         keys_np = [np.asarray(k)[:n] for k in keys]
-        pad = (0,) * (len(self.specs) + 1)  # non-pk columns unused by delete
+        width = sum(self._call_persist_width(j)
+                    for j in range(len(self.specs))) + 1
+        pad = (0,) * width                  # non-pk columns unused by delete
         rows = [(int(OP_DELETE), tuple(k[r].item() for k in keys_np) + pad)
                 for r in range(n)]
         self.state_table.write_chunk_rows(rows)
@@ -422,9 +472,22 @@ class HashAggExecutor(Executor):
         vis = is_dirty & (exists | existed)
         ops = jnp.where(exists, OP_INSERT, OP_DELETE).astype(jnp.int8)
         cols = [tk[d_slot] for tk in st.table.keys]
-        cols += [s[d_slot] for s in st.agg_states]
+        for j, ags in enumerate(st.agg_states):
+            if self._retractable[j]:
+                vals, cnts, lossy = ags
+                for k in range(self.minput_k):
+                    cols.append(vals[d_slot, k])
+                for k in range(self.minput_k):
+                    cols.append(cnts[d_slot, k].astype(jnp.int64))
+                cols.append(lossy[d_slot].astype(jnp.int64))
+            else:
+                cols.append(ags[d_slot])
         cols.append(st.row_count[d_slot])
         return cols, ops, vis
+
+    def _call_persist_width(self, j: int) -> int:
+        """Columns one agg call contributes to the durable state row."""
+        return (2 * self.minput_k + 1) if self._retractable[j] else 1
 
     def recover(self, barrier_epoch: int) -> None:
         """Rebuild device state from the state table (recovery path)."""
@@ -451,16 +514,34 @@ class HashAggExecutor(Executor):
         assert int(n_un) == 0
         st = self._empty_state(self.capacity)
         agg_states = []
+        off = nk
         for j, spec in enumerate(self.specs):
-            vals = jnp.asarray(np.asarray([r[nk + j] for r in rows]))
-            agg_states.append(
-                st.agg_states[j].at[slots].set(vals.astype(st.agg_states[j].dtype)))
-        counts = jnp.asarray(np.asarray([r[nk + len(self.specs)] for r in rows],
+            if self._retractable[j]:
+                K = self.minput_k
+                e_vals, e_cnts, e_lossy = st.agg_states[j]
+                vals = np.asarray([[r[off + k] for k in range(K)]
+                                   for r in rows])
+                cnts = np.asarray([[r[off + K + k] for k in range(K)]
+                                   for r in rows], dtype=np.int32)
+                lossy = np.asarray([bool(r[off + 2 * K]) for r in rows])
+                agg_states.append((
+                    e_vals.at[slots].set(
+                        jnp.asarray(vals, dtype=spec.state_dtype)),
+                    e_cnts.at[slots].set(jnp.asarray(cnts)),
+                    e_lossy.at[slots].set(jnp.asarray(lossy)),
+                ))
+                off += 2 * K + 1
+            else:
+                vals = jnp.asarray(np.asarray([r[off] for r in rows]))
+                agg_states.append(st.agg_states[j].at[slots].set(
+                    vals.astype(st.agg_states[j].dtype)))
+                off += 1
+        counts = jnp.asarray(np.asarray([r[off] for r in rows],
                                         dtype=np.int64))
         emits = tuple(
             st.prev_emit[j].at[slots].set(
-                spec.emit(agg_states[j])[slots])
-            for j, spec in enumerate(self.specs))
+                self._call_emit(j, agg_states[j])[slots])
+            for j in range(len(self.specs)))
         self.state = AggState(
             table=table,
             agg_states=tuple(agg_states),

@@ -16,7 +16,7 @@ from risingwave_tpu.common.chunk import (
     OP_DELETE, OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT, StreamChunk,
 )
 from risingwave_tpu.common.epoch import EpochPair
-from risingwave_tpu.expr.agg import AggCall, AggKind, agg_max, agg_sum, count_star
+from risingwave_tpu.expr.agg import AggCall, AggKind, agg_max, agg_min, agg_sum, count_star
 from risingwave_tpu.state import MemoryStateStore, StateTable
 from risingwave_tpu.stream import Barrier, BarrierKind, HashAggExecutor
 from risingwave_tpu.stream.executor import Executor
@@ -156,9 +156,131 @@ async def test_max_append_only():
     assert chunks[1].to_rows() == []
 
 
-async def test_retractable_max_rejected():
-    with pytest.raises(NotImplementedError):
-        HashAggExecutor(ScriptSource(SCHEMA, []), [0], [agg_max(1)])
+async def test_retractable_max_deletes_flip_extremum():
+    """Deletes recompute max from the materialized-input buffer
+    (reference minput.rs): removing the current max falls back to the
+    next-best tracked value."""
+    msgs = [
+        barrier(1, 0, BarrierKind.INITIAL),
+        chunk([(OP_INSERT, 1, 10), (OP_INSERT, 1, 30), (OP_INSERT, 1, 20)]),
+        barrier(2, 1),
+        chunk([(OP_DELETE, 1, 30)]),
+        barrier(3, 2),
+        chunk([(OP_DELETE, 1, 20), (OP_INSERT, 1, 5)]),
+        barrier(4, 3),
+    ]
+    agg, out = await run_agg(msgs, [agg_max(1)], capacity=64)
+    got = emitted_rows(out)
+    assert got == [
+        (OP_INSERT, (1, 30)),
+        (OP_UPDATE_DELETE, (1, 30)), (OP_UPDATE_INSERT, (1, 20)),
+        (OP_UPDATE_DELETE, (1, 20)), (OP_UPDATE_INSERT, (1, 10)),
+    ]
+
+
+async def test_retractable_min_duplicates():
+    """Duplicate values carry multiplicity: deleting one instance keeps
+    the extremum until the last instance goes."""
+    msgs = [
+        barrier(1, 0, BarrierKind.INITIAL),
+        chunk([(OP_INSERT, 7, 4), (OP_INSERT, 7, 4), (OP_INSERT, 7, 9)]),
+        barrier(2, 1),
+        chunk([(OP_DELETE, 7, 4)]),
+        barrier(3, 2),            # min still 4 (one instance left)
+        chunk([(OP_DELETE, 7, 4)]),
+        barrier(4, 3),            # min now 9
+    ]
+    agg, out = await run_agg(msgs, [agg_min(1)], capacity=64)
+    got = emitted_rows(out)
+    assert got == [
+        (OP_INSERT, (7, 4)),
+        (OP_UPDATE_DELETE, (7, 4)), (OP_UPDATE_INSERT, (7, 9)),
+    ]
+
+
+async def test_retractable_max_golden_random():
+    """Randomized insert/delete stream vs a python multiset model."""
+    rng = np.random.default_rng(11)
+    live: dict[int, list[int]] = {}
+    msgs = [barrier(1, 0, BarrierKind.INITIAL)]
+    ep = 2
+    for _ in range(5):
+        rows = []
+        for _ in range(25):
+            k = int(rng.integers(0, 6))
+            vs = live.setdefault(k, [])
+            if vs and rng.random() < 0.4:
+                v = vs.pop(int(rng.integers(0, len(vs))))
+                rows.append((OP_DELETE, k, v))
+            else:
+                v = int(rng.integers(0, 50))
+                vs.append(v)
+                rows.append((OP_INSERT, k, v))
+        msgs.append(chunk(rows, cap=32))
+        msgs.append(barrier(ep, ep - 1))
+        ep += 1
+    agg, out = await run_agg(msgs, [agg_max(1)], capacity=64)
+    mv = {}
+    for op, row in emitted_rows(out):
+        if op in (OP_INSERT, OP_UPDATE_INSERT):
+            mv[row[0]] = row[1]
+        elif op == OP_DELETE:
+            mv.pop(row[0], None)
+    want = {k: max(vs) for k, vs in live.items() if vs}
+    assert mv == want
+
+
+async def test_retractable_max_persist_recover():
+    store = MemoryStateStore()
+    K = 4
+
+    def make_table():
+        fields = [("k", DataType.INT64)]
+        fields += [(f"v{k}", DataType.INT64) for k in range(K)]
+        fields += [(f"c{k}", DataType.INT64) for k in range(K)]
+        fields += [("lossy", DataType.INT64), ("_row_count", DataType.INT64)]
+        return StateTable(store, table_id=21, schema=schema(*fields),
+                          pk_indices=[0])
+
+    msgs = [barrier(1, 0, BarrierKind.INITIAL),
+            chunk([(OP_INSERT, 1, 10), (OP_INSERT, 1, 30)]),
+            barrier(2, 1)]
+    src = ScriptSource(SCHEMA, msgs)
+    agg = HashAggExecutor(src, [0], [agg_max(1)], capacity=64,
+                          state_table=make_table(), minput_k=K)
+    async for _ in agg.execute():
+        pass
+    store.sync(1)
+
+    msgs2 = [barrier(3, 2, BarrierKind.INITIAL),
+             chunk([(OP_DELETE, 1, 30)]),
+             barrier(4, 3)]
+    agg2 = HashAggExecutor(ScriptSource(SCHEMA, msgs2), [0], [agg_max(1)],
+                           capacity=64, state_table=make_table(), minput_k=K)
+    out = []
+    async for m in agg2.execute():
+        out.append(m)
+    got = emitted_rows(out)
+    # recovered buffer knows 10 is next: update 30 -> 10, no underflow
+    assert got == [(OP_UPDATE_DELETE, (1, 30)), (OP_UPDATE_INSERT, (1, 10))]
+
+
+async def test_retractable_underflow_fail_stop():
+    """K=2 buffer, 3 distinct values: the spill marks the group lossy;
+    deleting all tracked values with rows remaining must fail-stop, not
+    emit a wrong extremum."""
+    msgs = [
+        barrier(1, 0, BarrierKind.INITIAL),
+        chunk([(OP_INSERT, 1, 10), (OP_INSERT, 1, 20), (OP_INSERT, 1, 30)]),
+        barrier(2, 1),
+        chunk([(OP_DELETE, 1, 30), (OP_DELETE, 1, 20)]),
+        barrier(3, 2),
+    ]
+    src = ScriptSource(SCHEMA, msgs)
+    agg = HashAggExecutor(src, [0], [agg_max(1)], capacity=64, minput_k=2)
+    with pytest.raises(RuntimeError, match="overflow"):
+        async for _ in agg.execute():
+            pass
 
 
 async def test_barrier_time_growth():
