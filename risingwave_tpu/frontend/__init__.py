@@ -1,0 +1,3 @@
+from .session import Catalog, MvDef, Session, SourceDef
+from .sql import SqlError, parse
+from .binder import BindError

@@ -1,0 +1,408 @@
+"""Binder + streaming planner: SQL AST -> fragment-graph IR.
+
+Reference: src/frontend binder/ + planner/ + stream_fragmenter (AST ->
+bound algebra -> stream plan -> StreamFragmentGraph cut at exchanges).
+This thin version binds names against the catalog, lowers expressions onto
+the engine's Expr IR, and emits a `StreamGraph` directly:
+
+  FROM source            -> source fragment
+  TUMBLE(...)            -> + project appending window_start/window_end
+  HOP(...)               -> + hop_window node
+  JOIN ... ON            -> two upstream fragments + hash_join fragment
+                            (equi conjunctions become key columns, the
+                            rest becomes the non-equi condition)
+  WHERE                  -> filter node
+  GROUP BY + aggregates  -> pre-project (group keys + agg args), hash_agg
+                            fragment hash-dispatched on the keys, post-
+                            project for SELECT order / AVG = SUM/COUNT
+  plain SELECT           -> project (+ row_id for the MV pk)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..common.types import DataType, Schema
+from ..expr.agg import AggCall, AggKind
+from ..expr.ir import Expr, call, col, lit
+from ..plan import Exchange, Fragment, Node, StreamGraph
+from . import sql as ast
+
+AGG_FUNCS = {"count", "sum", "min", "max", "avg"}
+
+
+class BindError(Exception):
+    pass
+
+
+@dataclass
+class Scope:
+    """Visible columns: (qualifier, name) -> (index, dtype)."""
+
+    schema: Schema
+    names: dict = field(default_factory=dict)
+
+    @classmethod
+    def of(cls, schema: Schema, qualifier: Optional[str]) -> "Scope":
+        s = cls(schema)
+        for i, f in enumerate(schema):
+            s.names.setdefault((None, f.name), (i, f.data_type))
+            if qualifier:
+                s.names[(qualifier, f.name)] = (i, f.data_type)
+        return s
+
+    @classmethod
+    def join(cls, left: "Scope", right: "Scope") -> "Scope":
+        fields = tuple(left.schema) + tuple(right.schema)
+        s = cls(Schema(fields))
+        off = len(left.schema)
+        for (q, n), (i, t) in left.names.items():
+            s.names.setdefault((q, n), (i, t))
+        for (q, n), (i, t) in right.names.items():
+            if (q, n) in s.names and q is None:
+                # ambiguous unqualified name: drop it
+                del s.names[(q, n)]
+                continue
+            s.names[(q, n)] = (i + off, t)
+        return s
+
+    def resolve(self, ref: ast.ColRef) -> tuple[int, DataType]:
+        # a qualified name must match its qualifier exactly — falling back
+        # to the unqualified name would silently bind b.x inside a's scope
+        key = (ref.qualifier, ref.name)
+        if key in self.names:
+            return self.names[key]
+        raise BindError(f"unknown column {ref.qualifier or ''}.{ref.name}")
+
+
+def bind_scalar(e, scope: Scope) -> Expr:
+    """SQL expression AST -> engine Expr IR (no aggregates allowed)."""
+    if isinstance(e, ast.Lit):
+        return lit(e.value)
+    if isinstance(e, ast.ColRef):
+        i, t = scope.resolve(e)
+        return col(i, t)
+    if isinstance(e, ast.UnOp):
+        return call(e.op, bind_scalar(e.arg, scope))
+    if isinstance(e, ast.BinOp):
+        return call(e.op, bind_scalar(e.left, scope),
+                    bind_scalar(e.right, scope))
+    if isinstance(e, ast.Func):
+        if e.name in AGG_FUNCS:
+            raise BindError(f"aggregate {e.name} not allowed here")
+        return call(e.name, *[bind_scalar(a, scope) for a in e.args])
+    raise BindError(f"cannot bind {e!r}")
+
+
+def contains_agg(e) -> bool:
+    if isinstance(e, ast.Func):
+        return e.name in AGG_FUNCS or any(contains_agg(a) for a in e.args)
+    if isinstance(e, ast.BinOp):
+        return contains_agg(e.left) or contains_agg(e.right)
+    if isinstance(e, ast.UnOp):
+        return contains_agg(e.arg)
+    return False
+
+
+@dataclass
+class BoundPlan:
+    graph: StreamGraph
+    mv_fragment: int            # the fragment whose root will materialize
+    schema: Schema
+    pk_indices: tuple
+
+
+class StreamPlanner:
+    def __init__(self, catalog):
+        self.catalog = catalog
+        self.graph = StreamGraph()
+        self._next_fid = 1
+
+    def fid(self) -> int:
+        f = self._next_fid
+        self._next_fid = f + 1
+        return f
+
+    # ----------------------------------------------------------- relations
+    def plan_rel(self, rel) -> tuple[int, Scope]:
+        """Returns (fragment id, scope over its output)."""
+        if isinstance(rel, ast.TableRel):
+            src = self.catalog.source(rel.name)
+            node = Node("nexmark_source", dict(src.options))
+            f = self.graph.add(Fragment(self.fid(), node,
+                                        dispatch="broadcast"))
+            return f.fid, Scope.of(src.schema, rel.alias or rel.name)
+        if isinstance(rel, ast.WindowRel):
+            src = self.catalog.source(rel.inner.name)
+            scope = Scope.of(src.schema, None)
+            i, t = scope.resolve(ast.ColRef(rel.time_col))
+            src_node = Node("nexmark_source", dict(src.options))
+            if rel.kind == "tumble":
+                exprs = [col(j, f.data_type)
+                         for j, f in enumerate(src.schema)]
+                exprs.append(call("tumble_start", col(i, t), lit(rel.size)))
+                exprs.append(call("tumble_end", col(i, t), lit(rel.size)))
+                names = list(src.schema.names) + ["window_start",
+                                                  "window_end"]
+                W = rel.size
+                node = Node("project", dict(
+                    exprs=exprs, names=names,
+                    watermark_transforms={
+                        i: (len(names) - 2, lambda v, W=W: v - v % W)}),
+                    inputs=(src_node,))
+                f = self.graph.add(Fragment(self.fid(), node,
+                                            dispatch="broadcast"))
+                out_schema = Schema(tuple(
+                    list(src.schema)
+                    + [type(src.schema[0])("window_start", t),
+                       type(src.schema[0])("window_end", t)]))
+            else:
+                node = Node("hop_window", dict(
+                    time_col=i, slide_us=rel.slide, size_us=rel.size),
+                    inputs=(src_node,))
+                f = self.graph.add(Fragment(self.fid(), node,
+                                            dispatch="broadcast"))
+                from ..common.types import Field
+                out_schema = Schema(tuple(
+                    list(src.schema) + [Field("window_start", t),
+                                        Field("window_end", t)]))
+            return f.fid, Scope.of(out_schema, rel.alias or rel.inner.name)
+        if isinstance(rel, ast.JoinRel):
+            lf, ls = self.plan_rel(rel.left)
+            rf, rs = self.plan_rel(rel.right)
+            jscope = Scope.join(ls, rs)
+            lkeys, rkeys, residue = [], [], []
+            for conj in split_conjuncts(rel.on):
+                pair = equi_pair(conj, ls, rs)
+                if pair is not None:
+                    lkeys.append(pair[0])
+                    rkeys.append(pair[1])
+                else:
+                    residue.append(conj)
+            if not lkeys:
+                raise BindError("join needs at least one equi condition")
+            cond = None
+            if residue:
+                e = residue[0]
+                for r in residue[1:]:
+                    e = ast.BinOp("and", e, r)
+                cond = bind_scalar(e, jscope)
+            node = Node("hash_join", dict(
+                left_key_indices=lkeys, right_key_indices=rkeys,
+                left_pk_indices=list(range(len(ls.schema))),
+                right_pk_indices=list(range(len(rs.schema))),
+                condition=cond, match_factor=64),
+                inputs=(Exchange(lf), Exchange(rf)))
+            f = self.graph.add(Fragment(self.fid(), node,
+                                        dispatch="broadcast"))
+            return f.fid, jscope
+        raise BindError(f"cannot plan relation {rel!r}")
+
+    # -------------------------------------------------------------- select
+    def plan_select(self, sel: ast.Select) -> BoundPlan:
+        fid, scope = self.plan_rel(sel.rel)
+        frag = self.graph.fragments[fid]
+        sel = ast.Select(expand_star(sel.items, scope.schema), sel.rel,
+                         sel.where, sel.group_by)
+
+        if sel.where is not None:
+            pred = bind_scalar(sel.where, scope)
+            frag.root = Node("filter", dict(predicate=pred),
+                             inputs=(frag.root,))
+
+        has_agg = bool(sel.group_by) or any(
+            contains_agg(it.expr) for it in sel.items)
+        if not has_agg:
+            exprs, names = [], []
+            for j, it in enumerate(sel.items):
+                exprs.append(bind_scalar(it.expr, scope))
+                names.append(it.alias or auto_name(it.expr, j))
+            frag.root = Node("project", dict(exprs=exprs, names=names),
+                             inputs=(frag.root,))
+            frag.root = Node("row_id_gen", {}, inputs=(frag.root,))
+            mv = self.graph.add(Fragment(self.fid(), Node(
+                "materialize", dict(pk_indices=[len(exprs)]),
+                inputs=(Exchange(fid),))))
+            from ..common.types import Field
+            out = Schema(tuple(
+                [Field(n, e.ret_type) for n, e in zip(names, exprs)]
+                + [Field("_row_id", DataType.SERIAL)]))
+            return BoundPlan(self.graph, mv.fid, out, (len(exprs),))
+
+        return self._plan_agg(sel, fid, scope)
+
+    def _plan_agg(self, sel: ast.Select, fid: int, scope: Scope) -> BoundPlan:
+        from ..common.types import Field
+        frag = self.graph.fragments[fid]
+        # pre-project: group keys then agg args
+        keys = [bind_scalar(g, scope) for g in sel.group_by]
+        key_names = [auto_name(g, j) for j, g in enumerate(sel.group_by)]
+        agg_specs = []           # (kind, pre_col or None)
+        pre_exprs = list(keys)
+        pre_names = list(key_names)
+
+        def add_arg(e) -> int:
+            pre_exprs.append(bind_scalar(e, scope))
+            pre_names.append(f"a{len(pre_exprs)}")
+            return len(pre_exprs) - 1
+
+        # map SELECT items onto (group key | agg output) slots
+        items_plan = []          # per item: ("key", idx) | ("agg", idx) | ("avg", s, c)
+        agg_calls: list[AggCall] = []
+
+        def add_call(kind: AggKind, arg: Optional[int],
+                     ret: DataType) -> int:
+            agg_calls.append(AggCall(kind, arg, ret))
+            return len(agg_calls) - 1
+
+        for it in sel.items:
+            e = it.expr
+            if isinstance(e, ast.Func) and e.name in AGG_FUNCS:
+                if e.name == "count":
+                    idx = add_call(AggKind.COUNT,
+                                   None if e.star else add_arg(e.args[0]),
+                                   DataType.INT64)
+                    items_plan.append(("agg", idx))
+                elif e.name == "avg":
+                    a = add_arg(e.args[0])
+                    s = add_call(AggKind.SUM, a, DataType.FLOAT64)
+                    c = add_call(AggKind.COUNT, a, DataType.INT64)
+                    items_plan.append(("avg", s, c))
+                elif e.name == "sum":
+                    a = add_arg(e.args[0])
+                    at = pre_exprs[a].ret_type
+                    ret = (DataType.FLOAT64
+                           if at in (DataType.FLOAT64, DataType.FLOAT32)
+                           else DataType.INT64)
+                    items_plan.append(("agg", add_call(AggKind.SUM, a, ret)))
+                else:
+                    a = add_arg(e.args[0])
+                    kind = AggKind.MIN if e.name == "min" else AggKind.MAX
+                    at = pre_exprs[a].ret_type
+                    items_plan.append(("agg", add_call(kind, a, at)))
+            else:
+                # must be one of the group-by expressions
+                bound = bind_scalar(e, scope)
+                for kj, ke in enumerate(keys):
+                    if repr(ke) == repr(bound):
+                        items_plan.append(("key", kj))
+                        break
+                else:
+                    raise BindError(
+                        f"{it.alias or e}: non-aggregate SELECT item must "
+                        f"appear in GROUP BY")
+
+        frag.root = Node("project", dict(exprs=pre_exprs, names=pre_names),
+                         inputs=(frag.root,))
+        if keys:
+            frag.dispatch = "hash"
+            frag.dist_key_indices = tuple(range(len(keys)))
+            agg = self.graph.add(Fragment(self.fid(), Node(
+                "hash_agg", dict(group_key_indices=list(range(len(keys))),
+                                 agg_calls=agg_calls),
+                inputs=(Exchange(fid),)),
+                dispatch="hash",
+                dist_key_indices=tuple(range(len(keys)))))
+        else:
+            # global aggregation: a singleton SimpleAgg fragment
+            # (reference: DistId::Singleton, simple_agg.rs)
+            frag.dispatch = "simple"
+            agg = self.graph.add(Fragment(self.fid(), Node(
+                "simple_agg", dict(agg_calls=agg_calls),
+                inputs=(Exchange(fid),)),
+                dispatch="simple"))
+
+        # post-project: SELECT order, AVG division
+        nk = len(keys)
+        post, names = [], []
+        for j, (it, plan) in enumerate(zip(sel.items, items_plan)):
+            name = it.alias or auto_name(it.expr, j)
+            names.append(name)
+            if plan[0] == "key":
+                post.append(col(plan[1],
+                                keys[plan[1]].ret_type))
+            elif plan[0] == "agg":
+                c0 = agg_calls[plan[1]]
+                post.append(col(nk + plan[1], c0.ret_type))
+            else:
+                _, s, c = plan
+                post.append(call("divide",
+                                 col(nk + s, DataType.FLOAT64),
+                                 col(nk + c, DataType.INT64)))
+        # MV pk = the group keys, which must survive projection: append any
+        # key not already selected
+        pk = []
+        for kj in range(nk):
+            found = None
+            for j, plan in enumerate(items_plan):
+                if plan[0] == "key" and plan[1] == kj:
+                    found = j
+                    break
+            if found is None:
+                post.append(col(kj, keys[kj].ret_type))
+                names.append(f"_key{kj}")
+                found = len(post) - 1
+            pk.append(found)
+        agg.root = Node("project", dict(exprs=post, names=names),
+                        inputs=(agg.root,))
+        mv = self.graph.add(Fragment(self.fid(), Node(
+            "materialize", dict(pk_indices=pk),
+            inputs=(Exchange(agg.fid),))))
+        out = Schema(tuple(Field(n, e.ret_type)
+                           for n, e in zip(names, post)))
+        return BoundPlan(self.graph, mv.fid, out, tuple(pk))
+
+
+def split_conjuncts(e) -> list:
+    if isinstance(e, ast.BinOp) and e.op == "and":
+        return split_conjuncts(e.left) + split_conjuncts(e.right)
+    return [e]
+
+
+def equi_pair(e, ls: Scope, rs: Scope) -> Optional[tuple[int, int]]:
+    """col_of_left = col_of_right -> (left_idx, right_idx)."""
+    if not (isinstance(e, ast.BinOp) and e.op == "equal"):
+        return None
+    a, b = e.left, e.right
+    if not (isinstance(a, ast.ColRef) and isinstance(b, ast.ColRef)):
+        return None
+
+    def side(ref):
+        try:
+            return ("l", ls.resolve(ref)[0])
+        except BindError:
+            pass
+        try:
+            return ("r", rs.resolve(ref)[0])
+        except BindError:
+            return None
+
+    sa, sb = side(a), side(b)
+    if sa is None or sb is None or sa[0] == sb[0]:
+        return None
+    if sa[0] == "l":
+        return (sa[1], sb[1])
+    return (sb[1], sa[1])
+
+
+def expand_star(items, schema) -> list:
+    """SELECT * -> one item per schema column (aliases = column names),
+    skipping internal columns like _row_id."""
+    out = []
+    for it in items:
+        if isinstance(it.expr, ast.ColRef) and it.expr.name == "*":
+            for f in schema:
+                if not f.name.startswith("_"):
+                    out.append(ast.SelectItem(ast.ColRef(f.name), f.name))
+        else:
+            out.append(it)
+    return out
+
+
+def auto_name(e, j: int) -> str:
+    if isinstance(e, ast.ColRef):
+        return e.name
+    if isinstance(e, ast.Func):
+        return e.name
+    return f"expr{j}"

@@ -1,0 +1,100 @@
+"""Numpy interpreter over the Expr IR — the batch/serving evaluator.
+
+Reference: batch expressions evaluate with the same vectorized
+`Expression::eval` as streaming; here the SERVING path deliberately stays
+off the accelerator (results leave the system anyway, and on a tunneled
+TPU any device->host transfer degrades the streaming dataflow sharing the
+process), so the same Expr tree is interpreted over numpy columns.
+Returns (values, valid) pairs with strict NULL propagation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..common.types import GLOBAL_DICT
+from ..expr.ir import Expr, FuncCall, InputRef, Literal
+
+_BINOPS = {
+    "add": np.add, "subtract": np.subtract, "multiply": np.multiply,
+    "equal": np.equal, "not_equal": np.not_equal,
+    "less_than": np.less, "less_than_or_equal": np.less_equal,
+    "greater_than": np.greater, "greater_than_or_equal": np.greater_equal,
+}
+
+
+def eval_numpy(e: Expr, cols: list[np.ndarray]):
+    """-> (values ndarray, valid ndarray bool)."""
+    n = len(cols[0]) if cols else 0
+    if isinstance(e, InputRef):
+        return cols[e.index], np.ones(n, dtype=bool)
+    if isinstance(e, Literal):
+        if e.value is None:
+            return np.zeros(n), np.zeros(n, dtype=bool)
+        v = e.value
+        if isinstance(v, str):
+            v = GLOBAL_DICT.get_or_insert(v)
+        return np.full(n, v), np.ones(n, dtype=bool)
+    if isinstance(e, FuncCall):
+        args = [eval_numpy(a, cols) for a in e.args]
+        name = e.name
+        if name in _BINOPS:
+            (a, av), (b, bv) = args
+            return _BINOPS[name](a, b), av & bv
+        if name == "divide":
+            # match streaming semantics (functions.py _div): integer
+            # division floors; division by zero is NULL
+            (a, av), (b, bv) = args
+            safe = np.where(b == 0, 1, b)
+            if (np.issubdtype(np.asarray(a).dtype, np.integer)
+                    and np.issubdtype(np.asarray(b).dtype, np.integer)):
+                val = np.floor_divide(a, safe)
+            else:
+                val = np.divide(a, safe)
+            return val, av & bv & (b != 0)
+        if name == "modulus":
+            # streaming _mod: x % 0 is NULL
+            (a, av), (b, bv) = args
+            return np.mod(a, np.where(b == 0, 1, b)), av & bv & (b != 0)
+        if name == "neg":
+            (a, av), = args
+            return -a, av
+        if name == "not":
+            (a, av), = args
+            return ~a.astype(bool), av
+        if name == "and":
+            (a, av), (b, bv) = args
+            a = a.astype(bool)
+            b = b.astype(bool)
+            # Kleene: False AND NULL = False
+            val = a & b
+            valid = (av & bv) | (av & ~a) | (bv & ~b)
+            return val, valid
+        if name == "or":
+            (a, av), (b, bv) = args
+            a = a.astype(bool)
+            b = b.astype(bool)
+            val = a | b
+            valid = (av & bv) | (av & a) | (bv & b)
+            return val, valid
+        if name == "abs":
+            (a, av), = args
+            return np.abs(a), av
+        if name == "is_null":
+            (a, av), = args
+            return ~av, np.ones_like(av)
+        if name == "is_not_null":
+            (a, av), = args
+            return av, np.ones_like(av)
+        if name == "coalesce":
+            v, valid = args[0]
+            for (b, bv) in args[1:]:
+                v = np.where(valid, v, b)
+                valid = valid | bv
+            return v, valid
+        if name in ("tumble_start", "tumble_end"):
+            (a, av), (w, _) = args
+            start = a - a % w
+            return (start if name == "tumble_start" else start + w), av
+        raise NotImplementedError(f"numpy eval for {name}")
+    raise NotImplementedError(f"numpy eval for {type(e).__name__}")

@@ -1,0 +1,172 @@
+"""Session — SQL in, materialized views + batch query results out.
+
+Reference: SessionImpl::run_statement (src/frontend/src/session.rs:866) +
+handler::handle dispatching DDL/queries, with the catalog tracking every
+object. One Session owns one state store; each CREATE MATERIALIZED VIEW
+deploys a fragment graph with its own barrier coordinator over that store
+(meta-lite: single process, many dataflows); SELECT over an MV runs the
+batch path (StorageTable committed-snapshot scan + numpy evaluation —
+serving reads stay off the device, which on a tunneled TPU is also the
+only fast option).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..common.types import Schema
+from ..connectors.nexmark import BID_SCHEMA, PERSON_SCHEMA, AUCTION_SCHEMA
+from ..meta.barrier_manager import BarrierCoordinator
+from ..plan import BuildEnv, Deployment, build_graph
+from ..state import MemoryStateStore, StorageTable
+from . import sql as ast
+from .binder import (BindError, Scope, StreamPlanner, bind_scalar,
+                     expand_star)
+from .np_eval import eval_numpy
+
+_NEXMARK_SCHEMAS = {"bid": BID_SCHEMA, "person": PERSON_SCHEMA,
+                    "auction": AUCTION_SCHEMA}
+
+
+@dataclass
+class SourceDef:
+    name: str
+    schema: Schema
+    options: dict      # builder args for the source node
+
+
+@dataclass
+class MvDef:
+    name: str
+    schema: Schema
+    pk_indices: tuple
+    deployment: Deployment
+    coord: BarrierCoordinator
+    mv_fragment: int
+
+    @property
+    def table(self):
+        return self.deployment.roots[self.mv_fragment][0].table
+
+
+class Catalog:
+    def __init__(self):
+        self.sources: dict[str, SourceDef] = {}
+        self.mvs: dict[str, MvDef] = {}
+
+    def source(self, name: str) -> SourceDef:
+        if name not in self.sources:
+            raise BindError(f"unknown source {name!r}")
+        return self.sources[name]
+
+
+class Session:
+    def __init__(self, store=None):
+        self.store = store if store is not None else MemoryStateStore()
+        self.catalog = Catalog()
+        self._next_table_id = 1
+
+    # --------------------------------------------------------------- DDL
+    async def execute(self, sql_text: str):
+        stmt = ast.parse(sql_text)
+        if isinstance(stmt, ast.CreateSource):
+            return self._create_source(stmt)
+        if isinstance(stmt, ast.CreateMV):
+            return await self._create_mv(stmt)
+        if isinstance(stmt, ast.Select):
+            return self.query_select(stmt)
+        raise BindError(f"unsupported statement {stmt!r}")
+
+    def _create_source(self, stmt: ast.CreateSource) -> SourceDef:
+        opts = dict(stmt.options)
+        connector = opts.pop("connector", "nexmark")
+        if connector != "nexmark":
+            raise BindError(f"unknown connector {connector!r}")
+        table = opts.pop("table", stmt.name)
+        if table not in _NEXMARK_SCHEMAS:
+            raise BindError(f"unknown nexmark table {table!r}")
+        args = {"table": table,
+                "chunk_size": int(opts.pop("chunk_size", 4096))}
+        cfg = {}
+        for k in ("inter_event_us", "base_time_us"):
+            if k in opts:
+                cfg[k] = int(opts.pop(k))
+        if cfg:
+            args["cfg"] = cfg
+        if "emit_watermarks" in opts:
+            v = opts.pop("emit_watermarks")
+            args["emit_watermarks"] = v in (True, 1, "1", "true", "t", "on")
+        for k in ("watermark_lag_us", "rate_limit"):
+            if k in opts:
+                args[k] = int(opts.pop(k))
+        src = SourceDef(stmt.name, _NEXMARK_SCHEMAS[table], args)
+        self.catalog.sources[stmt.name] = src
+        return src
+
+    async def _create_mv(self, stmt: ast.CreateMV) -> MvDef:
+        planner = StreamPlanner(self.catalog)
+        plan = planner.plan_select(stmt.select)
+        coord = BarrierCoordinator(self.store)
+        env = BuildEnv(self.store, coord)
+        # table ids must be unique ACROSS deployments on the shared store
+        env._next_table_id = self._next_table_id
+        dep = build_graph(plan.graph, env)
+        self._next_table_id = env._next_table_id
+        dep.spawn()
+        mv = MvDef(stmt.name, plan.schema, plan.pk_indices, dep, coord,
+                   plan.mv_fragment)
+        self.catalog.mvs[stmt.name] = mv
+        # the Initial barrier brings the dataflow up
+        await coord.run_rounds(0)
+        return mv
+
+    # ------------------------------------------------------------ runtime
+    async def tick(self, rounds: int = 1,
+                   interval_s: Optional[float] = None) -> None:
+        """Advance every MV's barrier loop (meta's periodic injection)."""
+        for mv in self.catalog.mvs.values():
+            await mv.coord.run_rounds(rounds, interval_s=interval_s)
+
+    async def drop_all(self) -> None:
+        for mv in self.catalog.mvs.values():
+            await mv.deployment.stop()
+        self.catalog.mvs.clear()
+
+    # -------------------------------------------------------- batch query
+    def query(self, sql_text: str) -> list[tuple]:
+        stmt = ast.parse(sql_text)
+        assert isinstance(stmt, ast.Select), "query() takes SELECT"
+        return self.query_select(stmt)
+
+    def query_select(self, sel: ast.Select) -> list[tuple]:
+        """Serving path: committed-snapshot scan of an MV + numpy eval
+        (reference: batch local execution over StorageTable,
+        scheduler/local.rs + storage_table.rs:646)."""
+        if not isinstance(sel.rel, ast.TableRel):
+            raise BindError("batch queries read one MV")
+        mv = self.catalog.mvs.get(sel.rel.name)
+        if mv is None:
+            raise BindError(f"unknown MV {sel.rel.name!r}")
+        if sel.group_by:
+            raise BindError("batch GROUP BY lands with the batch engine")
+        st = StorageTable.for_state_table(mv.table)
+        cols = st.to_numpy()
+        scope = Scope.of(mv.schema, sel.rel.alias or sel.rel.name)
+        mask = np.ones(len(cols[0]) if cols else 0, dtype=bool)
+        if sel.where is not None:
+            pred = bind_scalar(sel.where, scope)
+            v, valid = eval_numpy(pred, cols)
+            mask &= v.astype(bool) & valid
+        out_cols = []
+        items = expand_star(sel.items, mv.schema)
+        for it in items:
+            e = bind_scalar(it.expr, scope)
+            v, _ = eval_numpy(e, cols)
+            out_cols.append(np.asarray(v)[mask] if np.ndim(v) else
+                            np.full(int(mask.sum()), v))
+        n = len(out_cols[0]) if out_cols else 0
+        return [tuple(c[i].item() for c in out_cols) for i in range(n)]

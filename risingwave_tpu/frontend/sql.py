@@ -1,0 +1,384 @@
+"""SQL tokenizer + recursive-descent parser (thin frontend, layer 3).
+
+Reference: src/sqlparser (a 19.7k-LoC sqlparser-rs fork). This is NOT a
+port — it covers the streaming-SQL subset the engine executes today:
+
+  CREATE SOURCE name WITH (connector='nexmark', table='bid', ...)
+  CREATE MATERIALIZED VIEW name AS SELECT ...
+  SELECT <exprs> FROM <rel> [WHERE e] [GROUP BY cols]
+  <rel> := table | TUMBLE(table, col, N) | HOP(table, col, slide, size)
+         | <rel> JOIN <rel> ON conj
+  exprs: + - * / % comparisons AND OR NOT, literals, idents (qualified),
+         function calls, COUNT(*)/SUM/MIN/MAX/AVG
+
+Produces plain-dataclass ASTs the binder lowers onto the fragment-graph IR.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Union
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "as", "create",
+    "materialized", "view", "source", "with", "join", "on", "and", "or",
+    "not", "tumble", "hop", "count", "sum", "min", "max", "avg", "limit",
+    "order", "desc", "asc", "emit", "table",
+}
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<num>\d+\.\d+|\d+)
+    | (?P<str>'(?:[^']|'')*')
+    | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+    | (?P<op><>|<=|>=|!=|=|<|>|\+|-|\*|/|%|\(|\)|,|\.|\;)
+    )""", re.VERBOSE)
+
+
+@dataclass
+class Tok:
+    kind: str   # num | str | ident | kw | op | eof
+    val: str
+
+
+def tokenize(sql: str) -> list[Tok]:
+    out, pos = [], 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if not m or m.end() == pos:
+            if sql[pos:].strip() == "":
+                break
+            raise SqlError(f"cannot tokenize at: {sql[pos:pos+20]!r}")
+        pos = m.end()
+        if m.group("num"):
+            out.append(Tok("num", m.group("num")))
+        elif m.group("str"):
+            out.append(Tok("str", m.group("str")[1:-1].replace("''", "'")))
+        elif m.group("ident"):
+            low = m.group("ident").lower()
+            out.append(Tok("kw" if low in KEYWORDS else "ident", low))
+        else:
+            out.append(Tok("op", m.group("op")))
+    out.append(Tok("eof", ""))
+    return out
+
+
+class SqlError(Exception):
+    pass
+
+
+# ----------------------------------------------------------------- AST
+
+@dataclass
+class Lit:
+    value: object
+
+
+@dataclass
+class ColRef:
+    name: str
+    qualifier: Optional[str] = None
+
+
+@dataclass
+class Func:
+    name: str
+    args: list
+    star: bool = False      # COUNT(*)
+
+
+@dataclass
+class BinOp:
+    op: str
+    left: object
+    right: object
+
+
+@dataclass
+class UnOp:
+    op: str
+    arg: object
+
+
+@dataclass
+class SelectItem:
+    expr: object
+    alias: Optional[str]
+
+
+@dataclass
+class TableRel:
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass
+class WindowRel:
+    kind: str               # "tumble" | "hop"
+    inner: TableRel
+    time_col: str
+    size: int
+    slide: Optional[int] = None
+    alias: Optional[str] = None
+
+
+@dataclass
+class JoinRel:
+    left: object
+    right: object
+    on: object
+
+
+@dataclass
+class Select:
+    items: list[SelectItem]
+    rel: object
+    where: Optional[object] = None
+    group_by: list = field(default_factory=list)
+
+
+@dataclass
+class CreateSource:
+    name: str
+    options: dict
+
+
+@dataclass
+class CreateMV:
+    name: str
+    select: Select
+
+
+# --------------------------------------------------------------- parser
+
+class Parser:
+    def __init__(self, sql: str):
+        self.toks = tokenize(sql)
+        self.i = 0
+
+    def peek(self) -> Tok:
+        return self.toks[self.i]
+
+    def next(self) -> Tok:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept(self, kind: str, val: Optional[str] = None) -> Optional[Tok]:
+        t = self.peek()
+        if t.kind == kind and (val is None or t.val == val):
+            return self.next()
+        return None
+
+    def expect(self, kind: str, val: Optional[str] = None) -> Tok:
+        t = self.accept(kind, val)
+        if t is None:
+            raise SqlError(f"expected {val or kind}, got {self.peek().val!r}")
+        return t
+
+    # ------------------------------------------------------- statements
+    def parse_statement(self):
+        stmt = self._statement()
+        if self.peek().kind != "eof":
+            raise SqlError(f"unexpected trailing input at "
+                           f"{self.peek().val!r} (unsupported clause?)")
+        return stmt
+
+    def _statement(self):
+        if self.accept("kw", "create"):
+            if self.accept("kw", "source") or self.accept("kw", "table"):
+                return self._create_source()
+            self.expect("kw", "materialized")
+            self.expect("kw", "view")
+            name = self.expect("ident").val
+            self.expect("kw", "as")
+            sel = self._select()
+            self.accept("op", ";")
+            return CreateMV(name, sel)
+        sel = self._select()
+        self.accept("op", ";")
+        return sel
+
+    def _create_source(self) -> CreateSource:
+        name = self.expect("ident").val
+        self.expect("kw", "with")
+        self.expect("op", "(")
+        opts = {}
+        while True:
+            k = self.next().val
+            self.expect("op", "=")
+            t = self.next()
+            opts[k] = int(t.val) if t.kind == "num" else t.val
+            if not self.accept("op", ","):
+                break
+        self.expect("op", ")")
+        self.accept("op", ";")
+        return CreateSource(name, opts)
+
+    def _select(self) -> Select:
+        self.expect("kw", "select")
+        items = [self._select_item()]
+        while self.accept("op", ","):
+            items.append(self._select_item())
+        self.expect("kw", "from")
+        rel = self._relation()
+        where = None
+        if self.accept("kw", "where"):
+            where = self._expr()
+        group_by = []
+        if self.accept("kw", "group"):
+            self.expect("kw", "by")
+            group_by.append(self._expr())
+            while self.accept("op", ","):
+                group_by.append(self._expr())
+        return Select(items, rel, where, group_by)
+
+    def _select_item(self) -> SelectItem:
+        if self.accept("op", "*"):
+            return SelectItem(ColRef("*"), None)
+        e = self._expr()
+        alias = None
+        if self.accept("kw", "as"):
+            alias = self.next().val
+        elif self.peek().kind == "ident":
+            alias = self.next().val
+        return SelectItem(e, alias)
+
+    def _relation(self):
+        rel = self._rel_primary()
+        while self.accept("kw", "join"):
+            right = self._rel_primary()
+            self.expect("kw", "on")
+            on = self._expr()
+            rel = JoinRel(rel, right, on)
+        return rel
+
+    def _rel_primary(self):
+        for kind in ("tumble", "hop"):
+            if self.accept("kw", kind):
+                self.expect("op", "(")
+                inner = TableRel(self.expect("ident").val)
+                self.expect("op", ",")
+                time_col = self.expect("ident").val
+                self.expect("op", ",")
+                a = int(self.expect("num").val)
+                b = None
+                if self.accept("op", ","):
+                    b = int(self.expect("num").val)
+                self.expect("op", ")")
+                alias = None
+                if self.accept("kw", "as"):
+                    alias = self.next().val
+                elif self.peek().kind == "ident":
+                    alias = self.next().val
+                if kind == "hop":
+                    if b is None:
+                        raise SqlError("HOP needs (table, col, slide, size)")
+                    return WindowRel("hop", inner, time_col, size=b,
+                                     slide=a, alias=alias)
+                return WindowRel("tumble", inner, time_col, size=a,
+                                 alias=alias)
+        if self.accept("op", "("):
+            rel = self._relation()
+            self.expect("op", ")")
+            return rel
+        name = self.expect("ident").val
+        alias = None
+        if self.accept("kw", "as"):
+            alias = self.next().val
+        elif self.peek().kind == "ident" and self.peek().val not in KEYWORDS:
+            alias = self.next().val
+        return TableRel(name, alias)
+
+    # ------------------------------------------------------ expressions
+    def _expr(self):
+        return self._or()
+
+    def _or(self):
+        e = self._and()
+        while self.accept("kw", "or"):
+            e = BinOp("or", e, self._and())
+        return e
+
+    def _and(self):
+        e = self._not()
+        while self.accept("kw", "and"):
+            e = BinOp("and", e, self._not())
+        return e
+
+    def _not(self):
+        if self.accept("kw", "not"):
+            return UnOp("not", self._not())
+        return self._cmp()
+
+    def _cmp(self):
+        e = self._add()
+        t = self.peek()
+        if t.kind == "op" and t.val in ("=", "<>", "!=", "<", "<=", ">", ">="):
+            self.next()
+            op = {"=": "equal", "<>": "not_equal", "!=": "not_equal",
+                  "<": "less_than", "<=": "less_than_or_equal",
+                  ">": "greater_than", ">=": "greater_than_or_equal"}[t.val]
+            return BinOp(op, e, self._add())
+        return e
+
+    def _add(self):
+        e = self._mul()
+        while True:
+            if self.accept("op", "+"):
+                e = BinOp("add", e, self._mul())
+            elif self.accept("op", "-"):
+                e = BinOp("subtract", e, self._mul())
+            else:
+                return e
+
+    def _mul(self):
+        e = self._unary()
+        while True:
+            if self.accept("op", "*"):
+                e = BinOp("multiply", e, self._unary())
+            elif self.accept("op", "/"):
+                e = BinOp("divide", e, self._unary())
+            elif self.accept("op", "%"):
+                e = BinOp("modulus", e, self._unary())
+            else:
+                return e
+
+    def _unary(self):
+        if self.accept("op", "-"):
+            return UnOp("neg", self._unary())
+        return self._primary()
+
+    def _primary(self):
+        t = self.next()
+        if t.kind == "num":
+            return Lit(float(t.val) if "." in t.val else int(t.val))
+        if t.kind == "str":
+            return Lit(t.val)
+        if t.kind == "op" and t.val == "(":
+            e = self._expr()
+            self.expect("op", ")")
+            return e
+        if t.kind in ("ident", "kw"):
+            name = t.val
+            if self.accept("op", "("):
+                if name == "count" and self.accept("op", "*"):
+                    self.expect("op", ")")
+                    return Func("count", [], star=True)
+                args = []
+                if not self.accept("op", ")"):
+                    args.append(self._expr())
+                    while self.accept("op", ","):
+                        args.append(self._expr())
+                    self.expect("op", ")")
+                return Func(name, args)
+            if self.accept("op", "."):
+                col = self.next().val
+                return ColRef(col, qualifier=name)
+            return ColRef(name)
+        raise SqlError(f"unexpected token {t.val!r}")
+
+
+def parse(sql: str):
+    return Parser(sql).parse_statement()

@@ -3,3 +3,4 @@ from .state_table import StateTable, StateTableError
 from .serde import RowSerde, encode_memcomparable, decode_memcomparable
 from .hummock import HummockStateStore
 from .object_store import ObjectStore, InMemObjectStore, LocalFsObjectStore
+from .storage_table import StorageTable

@@ -88,8 +88,13 @@ class HummockStateStore(StateStore):
                 return v
         return None
 
-    def iter_range(self, start: bytes, end: bytes
+    def iter_range(self, start: bytes, end: bytes,
+                   committed_only: bool = False
                    ) -> Iterator[tuple[bytes, bytes]]:
+        """committed_only=True reads the COMMITTED snapshot (SSTs under the
+        manifest), excluding the uncommitted shared buffer — the batch/
+        serving read isolation (reference: StorageTable::batch_iter at a
+        pinned snapshot epoch, batch_table/storage_table.rs:646)."""
         merged: dict[bytes, Optional[bytes]] = {}
         if self._l1 is not None:
             for k, v in self._l1.iter_range(start, end):
@@ -97,10 +102,11 @@ class HummockStateStore(StateStore):
         for sst in reversed(self._l0):           # oldest -> newest overlay
             for k, v in sst.iter_range(start, end):
                 merged[k] = v
-        for epoch in sorted(self._shared):
-            for k, v in self._shared[epoch].items():
-                if start <= k and (not end or k < end):
-                    merged[k] = v
+        if not committed_only:
+            for epoch in sorted(self._shared):
+                for k, v in self._shared[epoch].items():
+                    if start <= k and (not end or k < end):
+                        merged[k] = v
         for k in sorted(merged):
             if merged[k] is not None:
                 yield k, merged[k]

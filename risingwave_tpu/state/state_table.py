@@ -82,6 +82,25 @@ class StateTable:
         return encode_table_key(
             self.table_id, vnode, encode_memcomparable(pk, self._pk_types, self.pk_descending))
 
+    def vnode_of_pk(self, pk: tuple) -> int:
+        """Vnode for a pk tuple (requires dist_key ⊆ pk, the reference's
+        batch point-get precondition)."""
+        if not self.dist_key_indices:
+            return 0
+        pos = [self.pk_indices.index(i) for i in self.dist_key_indices]
+        cols = [np.asarray([pk[p]]).astype(
+            self.schema[i].data_type.np_dtype)
+            for p, i in zip(pos, self.dist_key_indices)]
+        return int(compute_vnodes_numpy(cols)[0])
+
+    def vnode_key_range(self, vnode: int) -> tuple[bytes, bytes]:
+        """[start, end) covering one vnode of this table."""
+        start = encode_table_key(self.table_id, vnode, b"")
+        end = (encode_table_key(self.table_id, vnode + 1, b"")
+               if vnode + 1 < VNODE_COUNT
+               else (self.table_id + 1).to_bytes(4, "big"))
+        return start, end
+
     # ------------------------------------------------------------ writes
     def init_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -149,9 +168,7 @@ class StateTable:
 
     def iter_vnode(self, vnode: int) -> Iterator[tuple[bytes, tuple]]:
         """All rows of one vnode, pk order, mem-table merged (:1255)."""
-        start = encode_table_key(self.table_id, vnode, b"")
-        end = encode_table_key(self.table_id, vnode + 1, b"") if vnode + 1 < VNODE_COUNT \
-            else (self.table_id + 1).to_bytes(4, "big")
+        start, end = self.vnode_key_range(vnode)
         merged: dict[bytes, Optional[tuple]] = {}
         for k, v in self.store.iter_range(start, end):
             merged[k] = self._serde.decode(v)

@@ -1,0 +1,97 @@
+"""StorageTable — the batch/serving read path over an MV's committed state.
+
+Reference: src/storage/src/table/batch_table/storage_table.rs:56,646-661 —
+batch queries point-get and range-scan a materialized table at a pinned
+snapshot epoch (the Hummock version meta committed), never seeing
+uncommitted streaming writes.
+
+TPU build: reads are HOST-side (serving pulls rows out of the system, so
+there is nothing to gain — and on a tunneled TPU much to lose — from
+routing them through the device). Snapshot isolation comes from the
+store's `committed_only` read mode: Hummock serves only SSTs under the
+manifest; streaming epochs still in the shared buffer are invisible. Key
+construction is DELEGATED to a StateTable (one copy of the
+`table_id ++ vnode ++ memcomparable(pk)` layout), so batch reads always
+find streaming writes.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from ..common.types import Schema
+from ..common.vnode import VNODE_COUNT
+from .serde import RowSerde
+from .state_table import StateTable
+from .store import StateStore
+
+
+class StorageTable:
+    """Read-only batch access to a (materialized) table's committed state."""
+
+    def __init__(self, store: StateStore, table_id: int, schema: Schema,
+                 pk_indices: Sequence[int],
+                 dist_key_indices: Optional[Sequence[int]] = None,
+                 pk_descending: Optional[Sequence[bool]] = None):
+        # a private StateTable carries the key layout; its mem-table is
+        # never written (reads here are store-only, committed snapshot)
+        self._layout = StateTable(
+            store, table_id=table_id, schema=schema, pk_indices=pk_indices,
+            dist_key_indices=dist_key_indices, pk_descending=pk_descending)
+        self.store = store
+        self.table_id = table_id
+        self.schema = schema
+        self.pk_indices = tuple(pk_indices)
+        self._serde = RowSerde(schema)
+
+    @classmethod
+    def for_state_table(cls, t: StateTable) -> "StorageTable":
+        """Batch-read view of an existing StateTable (same key layout)."""
+        return cls(t.store, t.table_id, t.schema, t.pk_indices,
+                   dist_key_indices=t.dist_key_indices,
+                   pk_descending=t.pk_descending)
+
+    # --------------------------------------------------------------- reads
+    def get_row(self, pk: tuple) -> Optional[tuple]:
+        """Committed point lookup by primary key
+        (storage_table.rs point-get path)."""
+        pk = tuple(pk)
+        key = self._layout.key_of_pk(pk, self._layout.vnode_of_pk(pk))
+        for _, row in self._iter_keyrange(key, key + b"\xff"):
+            return row
+        return None
+
+    def _iter_keyrange(self, start: bytes, end: bytes
+                       ) -> Iterator[tuple[bytes, tuple]]:
+        for k, v in self.store.iter_range(start, end, committed_only=True):
+            yield k, self._serde.decode(v)
+
+    def batch_iter_vnode(self, vnode: int) -> Iterator[tuple]:
+        """Committed rows of one vnode in pk order
+        (storage_table.rs:646 batch_iter_vnode)."""
+        start, end = self._layout.vnode_key_range(vnode)
+        for _, row in self._iter_keyrange(start, end):
+            yield row
+
+    def batch_iter(self, vnode_bitmap: Optional[np.ndarray] = None
+                   ) -> Iterator[tuple]:
+        """Full committed scan (optionally restricted to a vnode subset —
+        the distributed-scan unit the batch scheduler hands each task)."""
+        vnodes = (range(VNODE_COUNT) if vnode_bitmap is None
+                  else np.flatnonzero(vnode_bitmap))
+        for vn in vnodes:
+            yield from self.batch_iter_vnode(int(vn))
+
+    def to_numpy(self, vnode_bitmap: Optional[np.ndarray] = None
+                 ) -> list[np.ndarray]:
+        """Whole committed table as one numpy column set (RowSeqScan's
+        chunk form, the input to batch expression evaluation)."""
+        rows = list(self.batch_iter(vnode_bitmap))
+        if not rows:
+            return [np.empty(0, dtype=f.data_type.np_dtype)
+                    for f in self.schema]
+        return [np.asarray([r[j] for r in rows],
+                           dtype=f.data_type.np_dtype)
+                for j, f in enumerate(self.schema)]

@@ -38,7 +38,12 @@ class StateStore:
     def get(self, key: bytes) -> Optional[bytes]:
         raise NotImplementedError
 
-    def iter_range(self, start: bytes, end: bytes) -> Iterator[tuple[bytes, bytes]]:
+    def iter_range(self, start: bytes, end: bytes,
+                   committed_only: bool = False
+                   ) -> Iterator[tuple[bytes, bytes]]:
+        """committed_only=True restricts to the committed snapshot where
+        the store can distinguish (Hummock); in-memory test stores apply
+        writes destructively and serve latest either way."""
         raise NotImplementedError
 
     def ingest_batch(self, batch: WriteBatch) -> None:
@@ -63,7 +68,8 @@ class MemoryStateStore(StateStore):
     def get(self, key: bytes) -> Optional[bytes]:
         return self._vals.get(key)
 
-    def iter_range(self, start: bytes, end: bytes):
+    def iter_range(self, start: bytes, end: bytes,
+                   committed_only: bool = False):
         i = bisect.bisect_left(self._keys, start)
         while i < len(self._keys) and self._keys[i] < end:
             k = self._keys[i]

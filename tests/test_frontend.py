@@ -1,0 +1,157 @@
+"""SQL frontend e2e: CREATE SOURCE / CREATE MATERIALIZED VIEW / SELECT.
+
+Reference shape: e2e_test/ sqllogictest suites — SQL in, MV content out,
+checked against a host recount of the deterministic Nexmark stream.
+"""
+
+import asyncio
+from collections import Counter
+
+import numpy as np
+
+from risingwave_tpu.connectors import NexmarkGenerator
+from risingwave_tpu.frontend import Session
+
+
+async def test_create_mv_project_filter_and_query():
+    s = Session()
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=256)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW discounted AS "
+        "SELECT auction, bidder, price * 2 AS dprice FROM bid "
+        "WHERE auction % 2 = 0")
+    await s.tick(3)
+    rows = s.query("SELECT auction, dprice FROM discounted")
+    assert rows, "MV is empty after 3 ticks"
+    assert all(a % 2 == 0 for a, _ in rows)
+    # golden: replay generator
+    gen = NexmarkGenerator("bid", chunk_size=256)
+    want = []
+    while len(want) < len(rows):
+        c = gen.next_chunk()
+        au = np.asarray(c.columns[0].data)
+        pr = np.asarray(c.columns[2].data)
+        for a, p in zip(au, pr):
+            if a % 2 == 0:
+                want.append((int(a), int(p) * 2))
+    assert sorted(rows) == sorted(want[:len(rows)])
+    # WHERE on the batch path
+    top = s.query("SELECT auction FROM discounted WHERE dprice > 1000000")
+    assert all(r[0] % 2 == 0 for r in top)
+    await s.drop_all()
+
+
+async def test_create_mv_group_by_count_sum():
+    s = Session()
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=512)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW per_key AS "
+        "SELECT bidder % 8 AS k, count(*) AS n, sum(price) AS total "
+        "FROM bid GROUP BY bidder % 8")
+    await s.tick(3)
+    rows = s.query("SELECT k, n, total FROM per_key")
+    assert rows and len(rows) <= 8
+    total_n = sum(r[1] for r in rows)
+    # golden recount over the same volume (whole chunks per barrier)
+    gen = NexmarkGenerator("bid", chunk_size=512)
+    cnt = Counter()
+    tot = Counter()
+    seen = 0
+    while seen < total_n:
+        c = gen.next_chunk()
+        bd = np.asarray(c.columns[1].data)
+        pr = np.asarray(c.columns[2].data)
+        for b, p in zip(bd, pr):
+            cnt[int(b) % 8] += 1
+            tot[int(b) % 8] += int(p)
+        seen += 512
+    assert seen == total_n
+    got = {r[0]: (r[1], r[2]) for r in rows}
+    assert got == {k: (cnt[k], tot[k]) for k in cnt}
+    await s.drop_all()
+
+
+async def test_create_mv_tumble_window_max():
+    s = Session()
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=256, inter_event_us=1000)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW wmax AS "
+        "SELECT window_end, max(price) AS maxprice "
+        "FROM TUMBLE(bid, date_time, 1000000) "
+        "GROUP BY window_end")
+    await s.tick(3)
+    rows = s.query("SELECT window_end, maxprice FROM wmax")
+    assert rows
+    gen = NexmarkGenerator("bid", chunk_size=256)
+    # recount max per window over the produced volume
+    import collections
+    wmax = collections.defaultdict(int)
+    seen_windows = {r[0] for r in rows}
+    n_chunks = 0
+    got = {r[0]: r[1] for r in rows}
+    while n_chunks < 64:
+        c = gen.next_chunk()
+        ts = np.asarray(c.columns[5].data)
+        pr = np.asarray(c.columns[2].data)
+        for t, p in zip(ts, pr):
+            w = (t - t % 1000000) + 1000000
+            wmax[int(w)] = max(wmax[int(w)], int(p))
+        n_chunks += 1
+        if set(wmax) >= seen_windows and all(
+                wmax[w] >= got[w] for w in seen_windows):
+            break
+    assert all(got[w] == wmax[w] for w in got if w in wmax and
+               max(wmax) > w)  # closed windows match exactly
+    await s.drop_all()
+
+
+async def test_mv_join_sql():
+    s = Session()
+    # rate-limited source (FlowControl): a free-running self-join would
+    # produce quadratic match volume between ticks
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=128, rate_limit=128)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW j AS "
+        "SELECT a.auction AS x, b.bidder AS y "
+        "FROM bid AS a JOIN bid AS b "
+        "ON a.bidder = b.bidder AND a.date_time = b.date_time")
+    await s.tick(2)
+    rows = s.query("SELECT x, y FROM j")
+    assert rows  # self-join matched (same stream joins itself)
+    await s.drop_all()
+
+
+async def test_global_agg_and_select_star():
+    s = Session()
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=256)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW totals AS "
+        "SELECT count(*) AS n, sum(price) AS total FROM bid")
+    await s.tick(2)
+    rows = s.query("SELECT * FROM totals")
+    assert len(rows) == 1 and rows[0][0] > 0 and rows[0][0] % 256 == 0
+    await s.drop_all()
+
+
+async def test_reject_unsupported_clause():
+    s = Session()
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid')")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW m AS SELECT auction FROM bid")
+    await s.tick(1)
+    import pytest
+    from risingwave_tpu.frontend import SqlError
+    with pytest.raises(SqlError, match="trailing"):
+        s.query("SELECT auction FROM m ORDER BY auction")
+    await s.drop_all()
