@@ -143,10 +143,12 @@ async def test_plan_self_join_dual_exchange():
     g.add(Fragment(1, Node("project", dict(
         exprs=[col(0), col(2), call("add", col(0), lit(1))],
         names=["k", "price", "k_plus_1"]),
-        inputs=(Node("nexmark_source", dict(table="bid", chunk_size=128)),)),
+        inputs=(Node("nexmark_source", dict(table="bid", chunk_size=128,
+                                            rate_limit=256)),)),
         dispatch="broadcast"))
-    # selective join (auction == auction+1 never matches itself densely):
-    # this test is about channel independence + 2-input alignment
+    # selective join (auction == auction+1 never matches itself densely) on
+    # a rate-limited source (bounded volume per barrier regardless of host
+    # speed): this test is about channel independence + 2-input alignment
     g.add(Fragment(2, Node("hash_join", dict(
         left_key_indices=[0], right_key_indices=[2],
         left_pk_indices=[0, 1], right_pk_indices=[0, 1],
