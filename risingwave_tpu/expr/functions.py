@@ -298,14 +298,262 @@ def _promote(types) -> DataType:
     return best
 
 
+_FLOAT_FNS = {"sqrt", "cbrt", "exp", "ln", "log10", "sin", "cos", "tan",
+              "atan", "pow"}
+_EXTRACT_FNS = {"extract_epoch", "extract_year", "extract_month",
+                "extract_day", "extract_hour", "extract_minute",
+                "extract_second", "extract_dow"}
+
+
 def infer_ret_type(name: str, args) -> DataType:
     if name in _CMP_FNS or name in _BOOL_FNS:
         return DataType.BOOLEAN
-    if name in ("tumble_start", "tumble_end"):
+    if name in ("tumble_start", "tumble_end") or name.startswith("date_trunc_"):
         return DataType.TIMESTAMP
-    if name == "extract_epoch":
+    if name in _EXTRACT_FNS:
         return DataType.INT64
+    if name in _FLOAT_FNS:
+        return DataType.FLOAT64
     if name == "divide":
         t = _promote([a.ret_type for a in args])
         return t
     return _promote([a.ret_type for a in args])
+
+
+# ------------------------------------------------- numeric breadth
+# (reference impl/src/scalar/{arithmetic_op,round,exp,pow,trigonometric}.rs)
+
+@register("floor")
+@strict
+def _floor(node, a):
+    return jnp.floor(a).astype(node.ret_type.jnp_dtype)
+
+
+@register("ceil")
+@strict
+def _ceil(node, a):
+    return jnp.ceil(a).astype(node.ret_type.jnp_dtype)
+
+
+@register("round")
+@strict
+def _round(node, a):
+    # PG/reference round halves AWAY from zero (round.rs); jnp.round is
+    # banker's half-to-even
+    return jnp.trunc(a + jnp.where(a >= 0, 0.5, -0.5)).astype(
+        node.ret_type.jnp_dtype)
+
+
+@register("trunc")
+@strict
+def _trunc(node, a):
+    return jnp.trunc(a).astype(node.ret_type.jnp_dtype)
+
+
+@register("sign")
+@strict
+def _sign(node, a):
+    return jnp.sign(a).astype(node.ret_type.jnp_dtype)
+
+
+@register("pow")
+@strict
+def _pow(node, a, b):
+    return jnp.power(a.astype(jnp.float64), b).astype(node.ret_type.jnp_dtype)
+
+
+@register("sqrt")
+@strict
+def _sqrt(node, a):
+    return jnp.sqrt(a.astype(jnp.float64))
+
+
+@register("cbrt")
+@strict
+def _cbrt(node, a):
+    return jnp.cbrt(a.astype(jnp.float64))
+
+
+@register("exp")
+@strict
+def _exp(node, a):
+    return jnp.exp(a.astype(jnp.float64))
+
+
+@register("ln")
+@strict
+def _ln(node, a):
+    return jnp.log(a.astype(jnp.float64))
+
+
+@register("log10")
+@strict
+def _log10(node, a):
+    return jnp.log10(a.astype(jnp.float64))
+
+
+@register("sin")
+@strict
+def _sin(node, a):
+    return jnp.sin(a.astype(jnp.float64))
+
+
+@register("cos")
+@strict
+def _cos(node, a):
+    return jnp.cos(a.astype(jnp.float64))
+
+
+@register("tan")
+@strict
+def _tan(node, a):
+    return jnp.tan(a.astype(jnp.float64))
+
+
+@register("atan")
+@strict
+def _atan(node, a):
+    return jnp.arctan(a.astype(jnp.float64))
+
+
+@register("bitwise_and")
+@strict
+def _bit_and(node, a, b):
+    return a & b
+
+
+@register("bitwise_or")
+@strict
+def _bit_or(node, a, b):
+    return a | b
+
+
+@register("bitwise_xor")
+@strict
+def _bit_xor(node, a, b):
+    return a ^ b
+
+
+@register("bitwise_not")
+@strict
+def _bit_not(node, a):
+    return jnp.invert(a)
+
+
+@register("bitwise_shift_left")
+@strict
+def _shl(node, a, b):
+    return jnp.left_shift(a, b)
+
+
+@register("bitwise_shift_right")
+@strict
+def _shr(node, a, b):
+    return jnp.right_shift(a, b)
+
+
+# ------------------------------------------------- datetime breadth
+# Timestamps are int64 microseconds since the unix epoch (common/types.py);
+# calendar fields use the branchless civil-from-days algorithm (Howard
+# Hinnant's date algorithms — pure integer arithmetic, vectorizes on TPU).
+# Reference: impl/src/scalar/{extract,date_trunc,tumble}.rs.
+
+_US_PER_DAY = 86_400_000_000
+
+
+def _civil_from_days(z):
+    """days since epoch -> (year, month, day), vectorized int math."""
+    z = z + 719_468
+    # floor_divide already floors toward -inf; Hinnant's (z - 146096)
+    # adjustment is only for TRUNCATING division and would double-correct
+    era = jnp.floor_divide(z, 146_097)
+    doe = z - era * 146_097
+    yoe = jnp.floor_divide(
+        doe - jnp.floor_divide(doe, 1460) + jnp.floor_divide(doe, 36_524)
+        - jnp.floor_divide(doe, 146_096), 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + jnp.floor_divide(yoe, 4)
+                 - jnp.floor_divide(yoe, 100))
+    mp = jnp.floor_divide(5 * doy + 2, 153)
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def _days_and_us(ts):
+    days = jnp.floor_divide(ts, _US_PER_DAY)
+    return days, ts - days * _US_PER_DAY
+
+
+@register("extract_year")
+@strict
+def _extract_year(node, ts):
+    y, _, _ = _civil_from_days(_days_and_us(ts)[0])
+    return y.astype(jnp.int64)
+
+
+@register("extract_month")
+@strict
+def _extract_month(node, ts):
+    _, m, _ = _civil_from_days(_days_and_us(ts)[0])
+    return m.astype(jnp.int64)
+
+
+@register("extract_day")
+@strict
+def _extract_day(node, ts):
+    _, _, d = _civil_from_days(_days_and_us(ts)[0])
+    return d.astype(jnp.int64)
+
+
+@register("extract_hour")
+@strict
+def _extract_hour(node, ts):
+    return jnp.floor_divide(_days_and_us(ts)[1],
+                            3_600_000_000).astype(jnp.int64)
+
+
+@register("extract_minute")
+@strict
+def _extract_minute(node, ts):
+    return jnp.mod(jnp.floor_divide(_days_and_us(ts)[1], 60_000_000),
+                   60).astype(jnp.int64)
+
+
+@register("extract_second")
+@strict
+def _extract_second(node, ts):
+    return jnp.mod(jnp.floor_divide(_days_and_us(ts)[1], 1_000_000),
+                   60).astype(jnp.int64)
+
+
+@register("extract_dow")
+@strict
+def _extract_dow(node, ts):
+    # 1970-01-01 was a Thursday (dow 4, Sunday = 0)
+    days = _days_and_us(ts)[0]
+    return jnp.mod(days + 4, 7).astype(jnp.int64)
+
+
+_TRUNC_US = {
+    "second": 1_000_000,
+    "minute": 60_000_000,
+    "hour": 3_600_000_000,
+    "day": _US_PER_DAY,
+    "week": 7 * _US_PER_DAY,
+}
+
+
+@register("date_trunc_second")
+@register("date_trunc_minute")
+@register("date_trunc_hour")
+@register("date_trunc_day")
+@register("date_trunc_week")
+def _date_trunc(node, cols):
+    unit = node.name.rsplit("_", 1)[1]
+    us = _TRUNC_US[unit]
+    ts = cols[0]
+    off = 3 * _US_PER_DAY if unit == "week" else 0  # weeks start Monday
+    data = (jnp.floor_divide(ts.data + off, us)) * us - off
+    return Column(data.astype(node.ret_type.jnp_dtype), ts.valid)

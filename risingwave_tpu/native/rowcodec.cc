@@ -1,0 +1,70 @@
+// Native host kernels for the state-persistence hot path.
+//
+// The reference implements row serde / hashing in Rust (src/common/src/
+// row/, util/memcmp_encoding.rs, hash/); the TPU build keeps device
+// compute in XLA and gives the HOST runtime the same native treatment:
+// batch memcomparable key encoding, value-row encoding, and the crc32
+// vnode hash, each vectorized over whole column batches instead of
+// per-row Python. Byte formats are bit-identical to state/serde.py and
+// common/vnode.py (golden-tested from tests/test_native.py).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// memcomparable for non-null ascending int64 fields:
+// field = 0x01 ++ bigendian(v XOR sign-flip). out stride = k * 9 bytes.
+void mc_encode_i64(const int64_t* vals, int64_t n, int64_t k,
+                   uint8_t* out) {
+    for (int64_t r = 0; r < n; ++r) {
+        uint8_t* p = out + r * k * 9;
+        for (int64_t c = 0; c < k; ++c) {
+            uint64_t u = (uint64_t)vals[r * k + c] ^ 0x8000000000000000ull;
+            *p++ = 0x01;
+            for (int b = 7; b >= 0; --b) *p++ = (uint8_t)(u >> (8 * b));
+        }
+    }
+}
+
+// value encoding for all-int64 rows with no nulls:
+// row = null bitmap (nb bytes, zero) ++ k * int64 little-endian
+void row_encode_i64(const int64_t* vals, int64_t n, int64_t k,
+                    int64_t nb, uint8_t* out) {
+    const int64_t stride = nb + 8 * k;
+    for (int64_t r = 0; r < n; ++r) {
+        uint8_t* p = out + r * stride;
+        std::memset(p, 0, (size_t)nb);
+        std::memcpy(p + nb, vals + r * k, (size_t)(8 * k));
+    }
+}
+
+// crc32 (poly 0xEDB88320) over the LE bytes of k int64 columns per row,
+// column-major in argument order — bit-identical to vnode.crc32_numpy
+void crc32_i64_cols(const int64_t* vals /* n*k row-major */, int64_t n,
+                    int64_t k, uint32_t* out) {
+    static uint32_t table[256];
+    static bool init = false;
+    if (!init) {
+        for (uint32_t i = 0; i < 256; ++i) {
+            uint32_t c = i;
+            for (int j = 0; j < 8; ++j)
+                c = (c & 1) ? (c >> 1) ^ 0xEDB88320u : c >> 1;
+            table[i] = c;
+        }
+        init = true;
+    }
+    for (int64_t r = 0; r < n; ++r) {
+        uint32_t crc = 0xFFFFFFFFu;
+        for (int64_t c = 0; c < k; ++c) {
+            uint64_t u = (uint64_t)vals[r * k + c];
+            for (int b = 0; b < 8; ++b) {
+                uint32_t byte = (uint32_t)((u >> (8 * b)) & 0xFF);
+                crc = (crc >> 8) ^ table[(crc ^ byte) & 0xFF];
+            }
+        }
+        out[r] = crc ^ 0xFFFFFFFFu;
+    }
+}
+
+}  // extern "C"

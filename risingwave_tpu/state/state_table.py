@@ -58,9 +58,14 @@ class StateTable:
         self.check_consistency = check_consistency
         self._pk_types = tuple(schema[i].data_type for i in self.pk_indices)
         self._serde = RowSerde(schema)
-        # mem-table: full key -> (op, row|None); op in {+1 put, -1 delete}
-        self._mem: dict[bytes, tuple[int, Optional[tuple]]] = {}
+        # mem-table: full key -> (op, row|None, enc|None); op in {+1 put,
+        # -1 delete}. Batch writes store pre-ENCODED values (native codec)
+        # and decode lazily on read-through.
+        self._mem: dict[bytes, tuple[int, Optional[tuple], Optional[bytes]]] = {}
         self.epoch: Optional[int] = None
+        self._all_i64 = all(
+            np.dtype(f.data_type.np_dtype).kind in "i" and
+            np.dtype(f.data_type.np_dtype).itemsize == 8 for f in schema)
 
     # ------------------------------------------------------------- keys
     def _vnode_of(self, row: tuple) -> int:
@@ -110,18 +115,18 @@ class StateTable:
         prev = self._mem.get(k)
         if self.check_consistency and prev is not None and prev[0] > 0:
             raise StateTableError(f"double insert for key {row!r} in table {self.table_id}")
-        self._mem[k] = (1, tuple(row))
+        self._mem[k] = (1, tuple(row), None)
 
     def delete(self, row: tuple) -> None:
         # Always record a tombstone: an insert+delete within one epoch must
         # still delete any version of the key from a PRIOR epoch in the store
         # (cancelling the put alone would resurrect the old row).
-        self._mem[self._key_of(row)] = (-1, None)
+        self._mem[self._key_of(row)] = (-1, None, None)
 
     def update(self, old_row: tuple, new_row: tuple) -> None:
         ko, kn = self._key_of(old_row), self._key_of(new_row)
         if ko == kn:
-            self._mem[kn] = (1, tuple(new_row))
+            self._mem[kn] = (1, tuple(new_row), None)
         else:
             self.delete(old_row)
             self.insert(new_row)
@@ -137,9 +142,9 @@ class StateTable:
         for (op, row), vn in zip(rows, vnodes):
             k = self.key_of_pk(tuple(row[i] for i in self.pk_indices), int(vn))
             if op in (OP_INSERT, OP_UPDATE_INSERT):
-                self._mem[k] = (1, tuple(row))
+                self._mem[k] = (1, tuple(row), None)
             else:
-                self._mem[k] = (-1, None)
+                self._mem[k] = (-1, None, None)
 
     def _vnodes_of_batch(self, rows: Sequence[tuple]) -> np.ndarray:
         if not self.dist_key_indices:
@@ -149,6 +154,61 @@ class StateTable:
             for i in self.dist_key_indices
         ]
         return compute_vnodes_numpy(cols)
+
+    def write_chunk_columns(self, ops: np.ndarray, cols: Sequence[np.ndarray],
+                            vis: np.ndarray) -> None:
+        """Columnar batch write — the per-barrier persistence hot path.
+
+        For all-int64 schemas with ascending pk, key and value encoding run
+        in the native C++ codec (risingwave_tpu/native) over the whole
+        batch; otherwise falls back to the per-row path. `ops` uses chunk
+        Op encoding; rows with vis False are skipped."""
+        from ..common.chunk import OP_INSERT, OP_UPDATE_INSERT
+        ops = np.asarray(ops)
+        vis = np.asarray(vis, dtype=bool)
+        idx = np.flatnonzero(vis)
+        if idx.size == 0:
+            return
+        native_ok = (self._all_i64 and self.pk_descending is None)
+        enc_keys = enc_vals = None
+        if native_ok:
+            from ..native import crc32_i64_batch, mc_encode_i64_batch,                 row_encode_i64_batch
+            pk_mat = np.stack([np.asarray(cols[i], dtype=np.int64)[idx]
+                               for i in self.pk_indices], axis=1)
+            mc = mc_encode_i64_batch(pk_mat)
+            if mc is not None:
+                if self.dist_key_indices:
+                    dist = np.stack(
+                        [np.asarray(cols[i], dtype=np.int64)[idx]
+                         for i in self.dist_key_indices], axis=1)
+                    vns = (crc32_i64_batch(dist)
+                           & np.uint32(VNODE_COUNT - 1)).astype(np.uint8)
+                else:
+                    vns = np.zeros(idx.size, dtype=np.uint8)
+                prefix = np.frombuffer(
+                    self.table_id.to_bytes(4, "big"), dtype=np.uint8)
+                enc_keys = np.concatenate([
+                    np.broadcast_to(prefix, (idx.size, 4)),
+                    vns[:, None], mc], axis=1)
+                all_mat = np.stack(
+                    [np.asarray(c, dtype=np.int64)[idx] for c in cols],
+                    axis=1)
+                enc_vals = row_encode_i64_batch(
+                    all_mat, self._serde._nbytes_nulls)
+        if enc_keys is not None:
+            ops_v = ops[idx]
+            put = (ops_v == OP_INSERT) | (ops_v == OP_UPDATE_INSERT)
+            for r in range(idx.size):
+                k = enc_keys[r].tobytes()
+                if put[r]:
+                    self._mem[k] = (1, None, enc_vals[r].tobytes())
+                else:
+                    self._mem[k] = (-1, None, None)
+            return
+        rows = [(int(ops[i]), tuple(
+            np.asarray(cols[j])[i].item() for j in range(len(cols))))
+            for i in idx]
+        self.write_chunk_rows(rows)
 
     # ------------------------------------------------------------- reads
     def get_row(self, pk: tuple, dist_values: Optional[tuple] = None) -> Optional[tuple]:
@@ -161,8 +221,10 @@ class StateTable:
                 row_for_vnode[i] = dist_values[j]
         k = self._key_of(tuple(row_for_vnode))
         if k in self._mem:
-            op, row = self._mem[k]
-            return row if op > 0 else None
+            op, row, enc = self._mem[k]
+            if op <= 0:
+                return None
+            return row if row is not None else self._serde.decode(enc)
         v = self.store.get(k)
         return self._serde.decode(v) if v is not None else None
 
@@ -172,9 +234,13 @@ class StateTable:
         merged: dict[bytes, Optional[tuple]] = {}
         for k, v in self.store.iter_range(start, end):
             merged[k] = self._serde.decode(v)
-        for k, (op, row) in self._mem.items():
+        for k, (op, row, enc) in self._mem.items():
             if start <= k < end:
-                merged[k] = row if op > 0 else None
+                if op <= 0:
+                    merged[k] = None
+                else:
+                    merged[k] = (row if row is not None
+                                 else self._serde.decode(enc))
         for k in sorted(merged):
             if merged[k] is not None:
                 yield k, merged[k]
@@ -189,8 +255,11 @@ class StateTable:
         Returns number of kv writes."""
         assert self.epoch is not None, "init_epoch not called"
         puts: dict[bytes, Optional[bytes]] = {}
-        for k, (op, row) in self._mem.items():
-            puts[k] = self._serde.encode(row) if op > 0 else None
+        for k, (op, row, enc) in self._mem.items():
+            if op <= 0:
+                puts[k] = None
+            else:
+                puts[k] = enc if enc is not None else self._serde.encode(row)
         n = len(puts)
         if puts:
             self.store.ingest_batch(WriteBatch(self.table_id, self.epoch, puts))

@@ -423,15 +423,11 @@ class HashAggExecutor(Executor):
             return
         if self._applied_since_flush:
             cols, ops, vis = self._flush_persist_view()
-            # rows: group key + raw agg states + hidden row_count
-            n = int(np.asarray(vis.sum()))
-            if n:
-                cols_np = [np.asarray(c)[np.asarray(vis)] for c in cols]
-                ops_np = np.asarray(ops)[np.asarray(vis)]
-                rows = []
-                for r in range(n):
-                    rows.append((int(ops_np[r]), tuple(c[r].item() for c in cols_np)))
-                self.state_table.write_chunk_rows(rows)
+            # columnar batch write: key/value encoding runs in the native
+            # C++ codec for all-int64 schemas (state_table.py)
+            self.state_table.write_chunk_columns(
+                np.asarray(ops), [np.asarray(c) for c in cols],
+                np.asarray(vis))
         if (self.cleaning_watermark_key is not None
                 and self._pending_clean_wm is not None):
             # evicted groups leave the durable table in the SAME epoch their

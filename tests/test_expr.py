@@ -79,3 +79,66 @@ def test_agg_specs():
     st = mx.init_state((2,))
     st = mx.combine(st, p)
     np.testing.assert_array_equal(np.asarray(mx.emit(st)), [3, 2])
+
+
+def test_numeric_breadth():
+    import numpy as np
+    from risingwave_tpu.common.chunk import Column
+    import jax.numpy as jnp
+    from risingwave_tpu.expr import call, col
+    from risingwave_tpu.common.types import DataType
+    cols = (Column(jnp.asarray([4.0, 9.0, 2.25])),)
+    r = call("sqrt", col(0, DataType.FLOAT64)).eval(cols)
+    np.testing.assert_allclose(np.asarray(r.data), [2.0, 3.0, 1.5])
+    r = call("pow", col(0, DataType.FLOAT64), 2).eval(cols)
+    np.testing.assert_allclose(np.asarray(r.data), [16.0, 81.0, 5.0625])
+    icols = (Column(jnp.asarray([12, 10, 7], dtype=jnp.int64)),)
+    r = call("bitwise_and", col(0), 6).eval(icols)
+    assert list(np.asarray(r.data)) == [4, 2, 6]
+
+
+def test_datetime_extract_golden():
+    """Civil-from-days vs python datetime over random timestamps."""
+    import datetime
+    import numpy as np
+    import jax.numpy as jnp
+    from risingwave_tpu.common.chunk import Column
+    from risingwave_tpu.common.types import DataType
+    from risingwave_tpu.expr import call, col
+
+    rng = np.random.default_rng(3)
+    # 1905..2105 covering pre-epoch, leap years, century rules
+    secs = rng.integers(-2_051_222_400, 4_262_304_000, size=200)
+    ts = secs * 1_000_000
+    cols = (Column(jnp.asarray(ts, dtype=jnp.int64)),)
+    got = {}
+    for f in ("year", "month", "day", "hour", "minute", "second", "dow"):
+        got[f] = np.asarray(
+            call(f"extract_{f}", col(0, DataType.TIMESTAMP)).eval(cols).data)
+    for i, s in enumerate(secs):
+        dt = datetime.datetime(1970, 1, 1,
+                               tzinfo=datetime.timezone.utc) + \
+            datetime.timedelta(seconds=int(s))
+        assert got["year"][i] == dt.year, (i, dt)
+        assert got["month"][i] == dt.month
+        assert got["day"][i] == dt.day
+        assert got["hour"][i] == dt.hour
+        assert got["minute"][i] == dt.minute
+        assert got["second"][i] == dt.second
+        assert got["dow"][i] == (dt.isoweekday() % 7)
+
+
+def test_date_trunc():
+    import numpy as np
+    import jax.numpy as jnp
+    from risingwave_tpu.common.chunk import Column
+    from risingwave_tpu.common.types import DataType
+    from risingwave_tpu.expr import call, col
+    ts = 1_700_000_000_123_456  # some Tue in Nov 2023
+    cols = (Column(jnp.asarray([ts], dtype=jnp.int64)),)
+    hour = int(np.asarray(call("date_trunc_hour",
+                               col(0, DataType.TIMESTAMP)).eval(cols).data)[0])
+    assert hour % 3_600_000_000 == 0 and ts - hour < 3_600_000_000
+    day = int(np.asarray(call("date_trunc_day",
+                              col(0, DataType.TIMESTAMP)).eval(cols).data)[0])
+    assert day % 86_400_000_000 == 0 and ts - day < 86_400_000_000
