@@ -22,3 +22,4 @@ __all__ = [
     "VNODE_COUNT", "compute_vnodes", "compute_vnodes_numpy", "crc32_columns",
     "EpochPair", "next_epoch", "INVALID_EPOCH",
 ]
+from .config import RwConfig, StreamingConfig, StorageConfig, SystemParams

@@ -1,0 +1,112 @@
+"""Configuration system — typed sections, TOML files, env overrides.
+
+Reference: three tiers (src/common/src/config.rs `RwConfig` TOML with
+server/streaming/storage sections; `ALTER SYSTEM` mutable system params in
+system_param/mod.rs with `barrier_interval_ms=1000`,
+`checkpoint_frequency=1`; per-session vars). Collapsed here to the two
+tiers the engine uses: `RwConfig` (TOML/dict + `RW_`-prefixed env
+overrides) and `SystemParams` (runtime-mutable, the ALTER SYSTEM
+analogue).
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field, fields
+from typing import Optional
+
+
+def _coerce(current, raw):
+    """Coerce a dict/env value to the field's type; bools parse strings
+    ('false' must not be truthy)."""
+    if isinstance(current, bool):
+        if isinstance(raw, bool):
+            return raw
+        return str(raw).lower() in ("1", "true", "t", "on", "yes")
+    return type(current)(raw)
+
+
+@dataclass
+class StreamingConfig:
+    barrier_interval_ms: int = 1000
+    checkpoint_frequency: int = 1
+    chunk_size: int = 8192
+    channel_capacity: int = 64
+    max_inflight_chunks: int = 16
+
+
+@dataclass
+class StorageConfig:
+    l0_compact_threshold: int = 8
+    object_store_root: str = "./state"
+
+
+@dataclass
+class ServerConfig:
+    metrics_enabled: bool = True
+
+
+@dataclass
+class RwConfig:
+    streaming: StreamingConfig = field(default_factory=StreamingConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    server: ServerConfig = field(default_factory=ServerConfig)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RwConfig":
+        cfg = cls()
+        for section_field in fields(cls):
+            sec = getattr(cfg, section_field.name)
+            for k, v in d.get(section_field.name, {}).items():
+                if not hasattr(sec, k):
+                    raise ValueError(
+                        f"unknown config key {section_field.name}.{k}")
+                cur = getattr(sec, k)
+                setattr(sec, k, _coerce(cur, v))
+        return cfg
+
+    @classmethod
+    def from_toml(cls, path: str) -> "RwConfig":
+        with open(path, "rb") as f:
+            return cls.from_dict(tomllib.load(f))
+
+    def apply_env(self, environ=None) -> "RwConfig":
+        """RW_<SECTION>_<KEY>=value overrides (highest precedence)."""
+        environ = environ if environ is not None else os.environ
+        for section_field in fields(type(self)):
+            sec = getattr(self, section_field.name)
+            for f in fields(type(sec)):
+                env_key = f"RW_{section_field.name.upper()}_{f.name.upper()}"
+                if env_key in environ:
+                    setattr(sec, f.name,
+                            _coerce(getattr(sec, f.name), environ[env_key]))
+        return self
+
+
+class SystemParams:
+    """Cluster-wide runtime-mutable params (ALTER SYSTEM analogue);
+    observers are notified on change (the notification-service shape)."""
+
+    MUTABLE = {"barrier_interval_ms", "checkpoint_frequency"}
+
+    def __init__(self, config: Optional[RwConfig] = None):
+        cfg = config or RwConfig()
+        self._values = {
+            "barrier_interval_ms": cfg.streaming.barrier_interval_ms,
+            "checkpoint_frequency": cfg.streaming.checkpoint_frequency,
+        }
+        self._observers = []
+
+    def get(self, name: str):
+        return self._values[name]
+
+    def set(self, name: str, value) -> None:
+        if name not in self.MUTABLE:
+            raise ValueError(f"system param {name!r} is not mutable")
+        self._values[name] = value
+        for fn in self._observers:
+            fn(name, value)
+
+    def subscribe(self, fn) -> None:
+        self._observers.append(fn)

@@ -339,7 +339,10 @@ def _ceil(node, a):
 @strict
 def _round(node, a):
     # PG/reference round halves AWAY from zero (round.rs); jnp.round is
-    # banker's half-to-even
+    # banker's half-to-even. Integers round to themselves (a float64
+    # round-trip would corrupt values above 2^53).
+    if jnp.issubdtype(a.dtype, jnp.integer):
+        return a.astype(node.ret_type.jnp_dtype)
     return jnp.trunc(a + jnp.where(a >= 0, 0.5, -0.5)).astype(
         node.ret_type.jnp_dtype)
 

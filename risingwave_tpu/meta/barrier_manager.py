@@ -48,6 +48,11 @@ class BarrierCoordinator:
         self.committed_epochs: list[int] = []
         self._stopped = False
         self._failure: Optional[tuple] = None
+        # headline health metric (reference meta_barrier_latency,
+        # grafana/risingwave-dev-dashboard.dashboard.py:894)
+        from ..utils.metrics import GLOBAL_METRICS
+        self._metrics_latency = GLOBAL_METRICS.histogram(
+            "meta_barrier_latency_seconds")
 
     # -------------------------------------------------------- registration
     def register_source(self, queue: asyncio.Queue) -> None:
@@ -106,7 +111,9 @@ class BarrierCoordinator:
         if barrier.kind is BarrierKind.CHECKPOINT and barrier.epoch.prev != INVALID_EPOCH:
             self.store.sync(barrier.epoch.prev)
             self.committed_epochs.append(barrier.epoch.prev)
-        self.latencies_ns.append(time.monotonic_ns() - barrier.inject_time_ns)
+        lat_ns = time.monotonic_ns() - barrier.inject_time_ns
+        self.latencies_ns.append(lat_ns)
+        self._metrics_latency.observe(lat_ns / 1e9)
         del self._epochs[barrier.epoch.curr]
 
     async def run_rounds(self, n: int, interval_s: Optional[float] = None) -> None:

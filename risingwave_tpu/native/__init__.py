@@ -24,16 +24,25 @@ _SRC = os.path.join(os.path.dirname(__file__), "rowcodec.cc")
 @lru_cache(maxsize=1)
 def lib() -> Optional[ctypes.CDLL]:
     so = os.path.join(os.path.dirname(__file__), "_rowcodec.so")
+
+    def build() -> None:
+        with tempfile.TemporaryDirectory() as td:
+            tmp = os.path.join(td, "rowcodec.so")
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+                check=True, capture_output=True)
+            os.replace(tmp, so)
+
     try:
         if (not os.path.exists(so)
                 or os.path.getmtime(so) < os.path.getmtime(_SRC)):
-            with tempfile.TemporaryDirectory() as td:
-                tmp = os.path.join(td, "rowcodec.so")
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
-                    check=True, capture_output=True)
-                os.replace(tmp, so)
-        l = ctypes.CDLL(so)
+            build()
+        try:
+            l = ctypes.CDLL(so)
+        except OSError:
+            # stale or foreign-arch artifact: rebuild for THIS machine
+            build()
+            l = ctypes.CDLL(so)
         l.mc_encode_i64.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p]
         l.row_encode_i64.argtypes = [

@@ -20,6 +20,7 @@ from .hop_window import HopWindowExecutor
 from .dedup import AppendOnlyDedupExecutor
 from .simple_agg import SimpleAggExecutor, StatelessSimpleAggExecutor
 from .top_n import GroupTopNExecutor, top_n
+from .sort import SortExecutor
 from .misc import (
     ExpandExecutor, FlowControlExecutor, NoOpExecutor, UnionExecutor,
     ValuesExecutor, WatermarkFilterExecutor,

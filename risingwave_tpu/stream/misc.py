@@ -136,6 +136,12 @@ class FlowControlExecutor(Executor):
             if isinstance(msg, StreamChunk) and self.limit is not None:
                 need = msg.num_rows_host()
                 while True:
+                    if self.limit == 0:
+                        # rate 0 pauses the stream IN PLACE (barriers wait
+                        # behind the chunk; to pause without stalling
+                        # checkpoints use a PauseMutation at the source)
+                        await asyncio.sleep(0.05)
+                        continue
                     now = time.monotonic()
                     tokens = min(tokens + (now - last) * self.limit,
                                  float(max(self.limit, need)))
@@ -176,9 +182,12 @@ class WatermarkFilterExecutor(Executor):
 
     def _step_impl(self, chunk: StreamChunk, cur_max):
         ts = chunk.columns[self.time_col].data
+        # filter against the watermark BEFORE this chunk, then advance:
+        # in-chunk disorder must not retroactively drop rows the emitted
+        # watermark still admits (reference filters at the current wm)
+        keep = chunk.vis & (ts >= cur_max - self.lag_us)
         seen = jnp.where(chunk.vis, ts, cur_max)
         new_max = jnp.maximum(cur_max, jnp.max(seen))
-        keep = chunk.vis & (ts >= new_max - self.lag_us)
         return StreamChunk(chunk.columns, chunk.ops, keep,
                            chunk.schema), new_max
 

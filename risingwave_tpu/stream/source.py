@@ -64,6 +64,10 @@ class SourceExecutor(Executor):
         # executed: one token per emitted chunk bounds TOTAL pipeline depth.
         self.max_inflight_chunks = max_inflight_chunks
         self._tokens: deque = deque()
+        # reference stream_source_output_rows_counts (streaming_stats.rs:214)
+        from ..utils.metrics import GLOBAL_METRICS
+        self._rows_metric = GLOBAL_METRICS.counter(
+            "stream_source_output_rows_counts", source_id=str(source_id))
 
     async def _acquire_credit(self) -> None:
         # Block (in a worker thread, keeping the event loop live) rather
@@ -132,6 +136,11 @@ class SourceExecutor(Executor):
             await self._acquire_credit()
             chunk = self.connector.next_chunk()
             self._tokens.append(chunk.columns[0].data)
+            # counted as padded capacity: visible-row counts need a d2h
+            # sync per chunk (forbidden in the steady state on tunneled
+            # TPUs) and generator chunks are always full; a connector with
+            # partial chunks overstates this series by its padding
+            self._rows_metric.inc(chunk.capacity)
             if self.rate_limit is not None:
                 # visible rows, not padded capacity (device sync is fine here:
                 # throttled sources are not the hot path)

@@ -1,0 +1,1 @@
+from .metrics import GLOBAL_METRICS, MetricsRegistry

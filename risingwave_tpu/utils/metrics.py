@@ -1,0 +1,149 @@
+"""Metrics registry — counters, gauges, histograms with labels.
+
+Reference: Prometheus metrics everywhere (`StreamingMetrics` ~150 series,
+src/stream/src/executor/monitor/streaming_stats.rs; `MetricLevel` gating;
+docs/metrics.md defines barrier latency as THE health metric). This is the
+same shape without a Prometheus dependency: a process-local registry whose
+`snapshot()`/`render()` can feed any scraper, plus the headline series
+pre-registered (source throughput, barrier latency histogram, actor rows).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+
+class Counter:
+    def __init__(self):
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._v += amount
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+
+class Gauge:
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+
+class Histogram:
+    """Fixed-bucket histogram (Prometheus semantics: cumulative buckets)."""
+
+    DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0,
+                       2.5, 5.0, 10.0)
+
+    def __init__(self, buckets: Optional[Sequence[float]] = None):
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            i = bisect.bisect_left(self.buckets, v)
+            self.counts[i] += 1
+            self.sum += v
+            self.n += 1
+
+    def percentile(self, p: float) -> float:
+        """Approximate percentile from bucket boundaries."""
+        if self.n == 0:
+            return 0.0
+        target = p * self.n
+        acc = 0
+        for i, c in enumerate(self.counts):
+            acc += c
+            if acc >= target:
+                return (self.buckets[i] if i < len(self.buckets)
+                        else self.buckets[-1])
+        return self.buckets[-1]
+
+
+@dataclass
+class MetricsRegistry:
+    counters: dict = field(default_factory=dict)
+    gauges: dict = field(default_factory=dict)
+    histograms: dict = field(default_factory=dict)
+
+    def counter(self, name: str, **labels) -> Counter:
+        key = (name, tuple(sorted(labels.items())))
+        if key not in self.counters:
+            self.counters[key] = Counter()
+        return self.counters[key]
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        key = (name, tuple(sorted(labels.items())))
+        if key not in self.gauges:
+            self.gauges[key] = Gauge()
+        return self.gauges[key]
+
+    def histogram(self, name: str,
+                  buckets: Optional[Sequence[float]] = None,
+                  **labels) -> Histogram:
+        key = (name, tuple(sorted(labels.items())))
+        if key not in self.histograms:
+            self.histograms[key] = Histogram(buckets)
+        return self.histograms[key]
+
+    # ------------------------------------------------------------ export
+    def snapshot(self) -> dict:
+        out = {}
+        for (name, labels), c in self.counters.items():
+            out.setdefault(name, []).append(
+                {"labels": dict(labels), "value": c.value})
+        for (name, labels), g in self.gauges.items():
+            out.setdefault(name, []).append(
+                {"labels": dict(labels), "value": g.value})
+        for (name, labels), h in self.histograms.items():
+            out.setdefault(name, []).append(
+                {"labels": dict(labels), "count": h.n, "sum": h.sum,
+                 "p50": h.percentile(0.5), "p99": h.percentile(0.99)})
+        return out
+
+    def render(self) -> str:
+        """Prometheus text exposition (scraper-compatible)."""
+        lines = []
+
+        def fmt_labels(labels):
+            if not labels:
+                return ""
+            inner = ",".join(f'{k}="{v}"' for k, v in labels)
+            return "{" + inner + "}"
+
+        for (name, labels), c in sorted(self.counters.items()):
+            lines.append(f"{name}{fmt_labels(labels)} {c.value}")
+        for (name, labels), g in sorted(self.gauges.items()):
+            lines.append(f"{name}{fmt_labels(labels)} {g.value}")
+        for (name, labels), h in sorted(self.histograms.items()):
+            acc = 0
+            for b, cnt in zip(h.buckets, h.counts):
+                acc += cnt
+                lab = dict(labels)
+                lab["le"] = b
+                lines.append(
+                    f"{name}_bucket{fmt_labels(sorted(lab.items()))} {acc}")
+            lab = dict(labels)
+            lab["le"] = "+Inf"   # required by histogram_quantile
+            lines.append(
+                f"{name}_bucket{fmt_labels(sorted(lab.items()))} {h.n}")
+            lines.append(f"{name}_sum{fmt_labels(labels)} {h.sum}")
+            lines.append(f"{name}_count{fmt_labels(labels)} {h.n}")
+        return "\n".join(lines) + "\n"
+
+
+# the process-default registry (reference GLOBAL_METRICS_REGISTRY)
+GLOBAL_METRICS = MetricsRegistry()

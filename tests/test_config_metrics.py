@@ -1,0 +1,67 @@
+"""Config system + metrics registry tests."""
+
+import pytest
+
+from risingwave_tpu.common.config import RwConfig, SystemParams
+from risingwave_tpu.utils.metrics import MetricsRegistry
+
+
+def test_config_dict_env_precedence():
+    cfg = RwConfig.from_dict({"streaming": {"barrier_interval_ms": 500}})
+    assert cfg.streaming.barrier_interval_ms == 500
+    assert cfg.streaming.checkpoint_frequency == 1
+    cfg.apply_env({"RW_STREAMING_BARRIER_INTERVAL_MS": "250",
+                   "RW_SERVER_METRICS_ENABLED": "false"})
+    assert cfg.streaming.barrier_interval_ms == 250
+    assert cfg.server.metrics_enabled is False
+
+
+def test_config_rejects_unknown_key():
+    with pytest.raises(ValueError, match="unknown config key"):
+        RwConfig.from_dict({"streaming": {"nope": 1}})
+
+
+def test_system_params_mutability():
+    sp = SystemParams()
+    seen = []
+    sp.subscribe(lambda k, v: seen.append((k, v)))
+    sp.set("barrier_interval_ms", 100)
+    assert sp.get("barrier_interval_ms") == 100 and seen == [
+        ("barrier_interval_ms", 100)]
+    with pytest.raises(ValueError):
+        sp.set("chunk_size", 1)
+
+
+def test_metrics_registry_and_render():
+    reg = MetricsRegistry()
+    reg.counter("rows", source="1").inc(5)
+    reg.counter("rows", source="1").inc(2)
+    h = reg.histogram("latency", buckets=(0.1, 1.0))
+    for v in (0.05, 0.05, 0.5, 2.0):
+        h.observe(v)
+    snap = reg.snapshot()
+    assert snap["rows"][0]["value"] == 7
+    assert snap["latency"][0]["count"] == 4
+    assert h.percentile(0.5) == 0.1
+    text = reg.render()
+    assert 'rows{source="1"} 7' in text and "latency_count 4" in text
+
+
+async def test_engine_emits_headline_metrics():
+    import asyncio
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.utils.metrics import GLOBAL_METRICS
+    s = Session()
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=256)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW m AS SELECT auction FROM bid")
+    await s.tick(2)
+    await s.drop_all()
+    snap = GLOBAL_METRICS.snapshot()
+    rows = sum(e["value"] for e in
+               snap.get("stream_source_output_rows_counts", []))
+    assert rows > 0
+    lat = snap["meta_barrier_latency_seconds"]
+    assert any(e["count"] > 0 for e in lat)

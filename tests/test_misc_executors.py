@@ -123,3 +123,53 @@ async def test_watermark_filter_drops_late_rows():
     assert (3, 50) not in rows and (4, 210) in rows
     wms = [m for m in out if isinstance(m, Watermark)]
     assert wms and wms[-1].val == 110  # max 210 - lag 100
+
+
+async def test_sort_eowc_emits_in_order():
+    from risingwave_tpu.stream import SortExecutor
+    msgs = [barrier(1, 0, BarrierKind.INITIAL),
+            chunk([(OP_INSERT, 1, 300), (OP_INSERT, 2, 100)]),
+            chunk([(OP_INSERT, 3, 200), (OP_INSERT, 4, 400)]),
+            Watermark(1, DataType.INT64, 250),
+            barrier(2, 1),
+            chunk([(OP_INSERT, 5, 260)]),
+            Watermark(1, DataType.INT64, 500),
+            barrier(3, 2),
+            barrier(4, 3, mutation=StopMutation(frozenset({0})))]
+    srt = SortExecutor(ScriptSource(SCHEMA, msgs), sort_col=1, capacity=64)
+    out = await drive(srt)
+    chunks = [m for m in out if isinstance(m, StreamChunk)]
+    emitted = [r for c in chunks for _, r in c.to_rows()]
+    # epoch 2 flushes keys <= 250 in order; epoch 3 flushes the rest,
+    # sorted within the flush: 260 < 300 < 400
+    assert emitted == [(2, 100), (3, 200), (5, 260), (1, 300), (4, 400)]
+
+
+async def test_sort_persist_recover():
+    from risingwave_tpu.state import MemoryStateStore, StateTable
+    from risingwave_tpu.stream import SortExecutor
+
+    store = MemoryStateStore()
+
+    def make_table():
+        return StateTable(store, table_id=31, schema=SCHEMA,
+                          pk_indices=(0,))
+
+    msgs = [barrier(1, 0, BarrierKind.INITIAL),
+            chunk([(OP_INSERT, 1, 300), (OP_INSERT, 2, 100)]),
+            barrier(2, 1)]
+    srt = SortExecutor(ScriptSource(SCHEMA, msgs), sort_col=1, capacity=64,
+                       state_table=make_table())
+    await drive(srt)
+    store.sync(1)
+
+    msgs2 = [barrier(3, 2, BarrierKind.INITIAL),
+             Watermark(1, DataType.INT64, 500),
+             barrier(4, 3),
+             barrier(5, 4, mutation=StopMutation(frozenset({0})))]
+    srt2 = SortExecutor(ScriptSource(SCHEMA, msgs2), sort_col=1,
+                        capacity=64, state_table=make_table())
+    out = await drive(srt2)
+    emitted = [r for m in out if isinstance(m, StreamChunk)
+               for _, r in m.to_rows()]
+    assert emitted == [(2, 100), (1, 300)]  # buffered rows survived
