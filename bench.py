@@ -323,10 +323,10 @@ async def bench_q7(progress: dict) -> None:
     )
 
     W = 10_000_000          # 10s tumble window, microseconds
-    # join-apply XLA compile time scales superlinearly with chunk capacity
-    # (measured: 8s at 4k rows, 230s at 32k) — q7 uses smaller chunks, and
-    # a small agg table so the barrier flush chunk (2*capacity) stays small
-    chunk_size = 8192
+    # (join-apply compile at 32k chunks is ~30s since multi-key sorts
+    # became iterated stable argsorts; a small agg table keeps the barrier
+    # flush chunk (2*capacity) cheap on the join's right side)
+    chunk_size = 32768
     cfg = NexmarkConfig(inter_event_us=250)
     store = MemoryStateStore()
     barrier_q = asyncio.Queue()

@@ -1,0 +1,112 @@
+"""`python -m risingwave_tpu` — the playground (reference: the multicall
+binary's `playground` mode, src/cmd_all/src/bin/risingwave.rs:126): an
+all-in-one single-process deployment with an interactive SQL shell.
+
+    $ python -m risingwave_tpu [--data DIR] [--tick-ms 1000]
+
+DDL and queries run immediately; materialized views advance continuously
+on the barrier interval in the background. With --data, state lives in a
+durable Hummock store under DIR and survives restarts. Meta commands:
+    \\tick [n]    advance n barrier rounds now
+    \\mvs         list materialized views
+    \\metrics     dump the metrics registry
+    \\q           quit
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+
+async def repl(args) -> None:
+    from risingwave_tpu.frontend import Session, SqlError
+    from risingwave_tpu.frontend.binder import BindError
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+    from risingwave_tpu.utils.metrics import GLOBAL_METRICS
+
+    store = None
+    if args.data:
+        store = HummockStateStore(LocalFsObjectStore(args.data))
+        print(f"durable state: {args.data} "
+              f"(committed epoch {store.committed_epoch()})")
+    session = Session(store=store)
+
+    stop = asyncio.Event()
+
+    async def ticker():
+        while not stop.is_set():
+            try:
+                await asyncio.wait_for(stop.wait(), args.tick_ms / 1000)
+            except asyncio.TimeoutError:
+                pass
+            if stop.is_set():
+                return
+            try:
+                await session.tick(1)
+            except Exception as e:  # surfaced failures stop the clock
+                print(f"barrier loop error: {e}", file=sys.stderr)
+                return
+
+    tick_task = asyncio.create_task(ticker())
+    print("risingwave_tpu playground — SQL statements end with ';', "
+          "\\q quits")
+    loop = asyncio.get_event_loop()
+    buf = ""
+    while True:
+        try:
+            line = await loop.run_in_executor(
+                None, lambda: input("rw> " if not buf else "  > "))
+        except (EOFError, KeyboardInterrupt):
+            break
+        cmd = line.strip()
+        if not buf and cmd.startswith("\\"):
+            parts = cmd.split()
+            if parts[0] == "\\q":
+                break
+            if parts[0] == "\\tick":
+                n = int(parts[1]) if len(parts) > 1 else 1
+                await session.tick(n)
+                print(f"advanced {n} round(s)")
+            elif parts[0] == "\\mvs":
+                for name, mv in session.catalog.mvs.items():
+                    print(f"  {name}: {', '.join(mv.schema.names)}")
+            elif parts[0] == "\\metrics":
+                print(GLOBAL_METRICS.render())
+            else:
+                print(f"unknown meta command {parts[0]}")
+            continue
+        buf += (" " if buf else "") + line
+        if ";" not in buf:
+            continue
+        stmt, buf = buf.split(";", 1)
+        buf = buf.strip()
+        try:
+            result = await session.execute(stmt)
+        except (SqlError, BindError, Exception) as e:
+            print(f"error: {e}")
+            continue
+        if isinstance(result, list):
+            for row in result:
+                print("  " + " | ".join(str(v) for v in row))
+            print(f"({len(result)} rows)")
+        elif result is not None:
+            kind = type(result).__name__.replace("Def", "").upper()
+            print(f"CREATE {kind} ok")
+    stop.set()
+    await tick_task
+    await session.drop_all()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(prog="risingwave_tpu")
+    p.add_argument("--data", default=None,
+                   help="durable state directory (default: in-memory)")
+    p.add_argument("--tick-ms", type=int, default=1000,
+                   help="barrier interval (reference barrier_interval_ms)")
+    asyncio.run(repl(p.parse_args()))
+
+
+if __name__ == "__main__":
+    main()

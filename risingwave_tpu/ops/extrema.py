@@ -28,6 +28,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .hash_table import stable_lexsort, stable_lexsort_rows
+
 
 def _order_key(vals, is_max):
     if not is_max:
@@ -62,7 +64,7 @@ def extrema_update(state: tuple, values, valid_in, signs, seg, C: int,
 
     # ---- net delta per (group, value) run ----
     okey = _order_key(values, is_max)
-    order = jnp.lexsort((okey, sseg))
+    order = stable_lexsort((okey, sseg))
     o_seg = sseg[order]
     o_val = values[order]
     o_sign = sgs[order]
@@ -123,7 +125,7 @@ def extrema_update(state: tuple, values, valid_in, signs, seg, C: int,
     m_vals = jnp.concatenate([vals, cand_vals], axis=1)
     m_cnts = jnp.concatenate([cnts, cand_cnts], axis=1)
     m_valid = m_cnts != 0
-    sort_idx = jnp.lexsort((_order_key(m_vals, is_max), ~m_valid), axis=1)
+    sort_idx = stable_lexsort_rows((_order_key(m_vals, is_max), ~m_valid))
     s_vals = jnp.take_along_axis(m_vals, sort_idx, axis=1)
     s_cnts = jnp.take_along_axis(m_cnts, sort_idx, axis=1)
     s_valid = jnp.take_along_axis(m_valid, sort_idx, axis=1)
@@ -139,7 +141,7 @@ def extrema_update(state: tuple, values, valid_in, signs, seg, C: int,
     err_neg = jnp.sum((neg & ~lossy2[:, None]).astype(jnp.int32))
     s_valid = s_valid & (s_cnts > 0)
     # resort (combined zeros / negatives drop out), keep best K
-    sort2 = jnp.lexsort((_order_key(s_vals, is_max), ~s_valid), axis=1)
+    sort2 = stable_lexsort_rows((_order_key(s_vals, is_max), ~s_valid))
     f_vals = jnp.take_along_axis(s_vals, sort2, axis=1)
     f_cnts = jnp.take_along_axis(s_cnts, sort2, axis=1)
     f_valid = jnp.take_along_axis(s_valid, sort2, axis=1)

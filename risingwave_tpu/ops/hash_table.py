@@ -43,6 +43,29 @@ from ..common.vnode import crc32_columns
 # Slots per bucket. 16 keeps the two-choice overflow probability negligible
 # at the 0.7 rebuild threshold while the [N, 2S] compare stays one small
 # vectorized gather per chunk.
+
+def stable_lexsort(keys):
+    """np.lexsort semantics (last key primary) as ITERATED single-key
+    stable argsorts. jnp.lexsort lowers to one variadic sort whose XLA
+    compile time explodes with key count and length (measured: 42s for a
+    3-key sort of 32k rows on TPU vs 8s total for this form); K successive
+    stable sorts are the textbook definition and compile linearly."""
+    order = jnp.argsort(keys[0], stable=True)
+    for k in keys[1:]:
+        order = order[jnp.argsort(k[order], stable=True)]
+    return order
+
+
+def stable_lexsort_rows(keys):
+    """Per-row (axis=1) variant for [C, K] buffers."""
+    order = jnp.argsort(keys[0], axis=1, stable=True)
+    for k in keys[1:]:
+        step = jnp.argsort(jnp.take_along_axis(k, order, axis=1), axis=1,
+                           stable=True)
+        order = jnp.take_along_axis(order, step, axis=1)
+    return order
+
+
 BUCKET_SLOTS = 16
 
 
@@ -169,7 +192,7 @@ def lookup_or_insert(table: HashTable, key_cols: Sequence[jnp.ndarray],
     for k in key_cols:
         sort_keys.append(k)
     sort_keys.append(~miss)                       # primary: missing first
-    order = jnp.lexsort(tuple(sort_keys))
+    order = stable_lexsort(tuple(sort_keys))
     s_miss = miss[order]
     same = s_miss[1:] & s_miss[:-1]
     for k in key_cols:
@@ -183,7 +206,7 @@ def lookup_or_insert(table: HashTable, key_cols: Sequence[jnp.ndarray],
     # ---- sort 2: rank leaders within their chosen bucket ----
     B_sentinel = C // S                            # non-leaders sort last
     rank_key = jnp.where(is_leader, s_bucket, B_sentinel)
-    order2 = jnp.lexsort((jnp.arange(N, dtype=jnp.int32), rank_key))
+    order2 = stable_lexsort((jnp.arange(N, dtype=jnp.int32), rank_key))
     r_bucket = rank_key[order2]
     new_bucket = jnp.concatenate(
         [jnp.array([True]), r_bucket[1:] != r_bucket[:-1]])

@@ -89,6 +89,16 @@ class ActorCtx:
     vnode_bitmap: Optional[np.ndarray]
     table_ids: dict           # node id -> table id (shared across actors)
 
+    def table_id(self, key) -> int:
+        """Stable table id per plan node, shared by a fragment's actors.
+        NOT dict.setdefault(key, alloc()) — that evaluates alloc() even on
+        hits, burning ids per actor and making the id sequence depend on
+        PARALLELISM, which breaks recovery/rescale (a rebuilt graph must
+        find its tables at the same ids)."""
+        if key not in self.table_ids:
+            self.table_ids[key] = self.env.alloc_table_id()
+        return self.table_ids[key]
+
 
 @dataclass
 class Deployment:
@@ -228,7 +238,7 @@ def _build_source(args, inputs, ctx: ActorCtx, key):
     ctx.env.coord.register_source(barrier_q)
     st = None
     if args.get("durable"):
-        tid = ctx.table_ids.setdefault(key, ctx.env.alloc_table_id())
+        tid = ctx.table_id(key)
         st = ctx.env.state_table(
             tid, Schema((SchemaField("source_id", DataType.INT64),
                          SchemaField("offset", DataType.INT64))), (0,))
@@ -286,7 +296,7 @@ def _build_hash_agg(args, inputs, ctx: ActorCtx, key):
         gk = tuple(args["group_key_indices"])
         sch = _agg_state_schema(inputs[0].schema, gk, args["agg_calls"],
                                 minput_k)
-        tid = ctx.table_ids.setdefault(key, ctx.env.alloc_table_id())
+        tid = ctx.table_id(key)
         st = ctx.env.state_table(tid, sch, tuple(range(len(gk))),
                                  vnode_bitmap=ctx.vnode_bitmap)
     return HashAggExecutor(
@@ -305,7 +315,7 @@ def _build_hash_join(args, inputs, ctx: ActorCtx, key):
     if args.get("durable"):
         tabs = []
         for s, inp in enumerate(inputs):
-            tid = ctx.table_ids.setdefault((key, s), ctx.env.alloc_table_id())
+            tid = ctx.table_id((key, s))
             pk = tuple(args["left_pk_indices" if s == 0 else "right_pk_indices"])
             tabs.append(ctx.env.state_table(
                 tid, inp.schema, pk, vnode_bitmap=ctx.vnode_bitmap))
@@ -330,7 +340,7 @@ def _build_hash_join(args, inputs, ctx: ActorCtx, key):
 def _build_top_n(args, inputs, ctx: ActorCtx, key):
     st = None
     if args.get("durable"):
-        tid = ctx.table_ids.setdefault(key, ctx.env.alloc_table_id())
+        tid = ctx.table_id(key)
         gk = tuple(args.get("group_key_indices", ()))
         pk = gk + (args["order_col"],) + tuple(inputs[0].pk_indices)
         st = ctx.env.state_table(tid, inputs[0].schema,
@@ -349,7 +359,7 @@ def _build_top_n(args, inputs, ctx: ActorCtx, key):
 def _build_dedup(args, inputs, ctx: ActorCtx, key):
     st = None
     if args.get("durable"):
-        tid = ctx.table_ids.setdefault(key, ctx.env.alloc_table_id())
+        tid = ctx.table_id(key)
         gk = tuple(args["dedup_key_indices"])
         sch = Schema(tuple(inputs[0].schema[i] for i in gk))
         st = ctx.env.state_table(tid, sch, tuple(range(len(gk))),
@@ -369,7 +379,7 @@ def _build_simple_agg(args, inputs, ctx: ActorCtx, key):
         fields += [SchemaField(f"state{j}", c.ret_type)
                    for j, c in enumerate(calls)]
         fields.append(SchemaField("_row_count", DataType.INT64))
-        tid = ctx.table_ids.setdefault(key, ctx.env.alloc_table_id())
+        tid = ctx.table_id(key)
         st = ctx.env.state_table(tid, Schema(tuple(fields)), (0,))
     return SimpleAggExecutor(inputs[0], args["agg_calls"], state_table=st,
                              combine_partials=args.get("combine_partials",
@@ -388,7 +398,7 @@ def _build_row_id(args, inputs, ctx: ActorCtx, key):
 
 @register_builder("materialize")
 def _build_materialize(args, inputs, ctx: ActorCtx, key):
-    tid = ctx.table_ids.setdefault(key, ctx.env.alloc_table_id())
+    tid = ctx.table_id(key)
     st = ctx.env.state_table(tid, inputs[0].schema,
                              tuple(args.get("pk_indices",
                                             inputs[0].pk_indices)),

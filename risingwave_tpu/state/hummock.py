@@ -114,6 +114,14 @@ class HummockStateStore(StateStore):
     def committed_epoch(self) -> int:
         return self._committed_epoch
 
+    def reset_uncommitted(self) -> None:
+        """Drop the shared buffer — the recovery entry point (reference:
+        recovery resumes at the last committed Hummock version; anything
+        newer was never externally visible). A process restart gets this
+        for free; an in-process restart (rescale, failover tests) must
+        call it or stale uncommitted epochs would leak into new ones."""
+        self._shared.clear()
+
     # -------------------------------------------------------------- writes
     def ingest_batch(self, batch: WriteBatch) -> None:
         self._shared.setdefault(batch.epoch, {}).update(batch.puts)

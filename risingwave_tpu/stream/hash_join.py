@@ -41,7 +41,8 @@ from ..common.chunk import (
     Column, StreamChunk, OP_DELETE, OP_INSERT, op_sign,
 )
 from ..common.types import Field, Schema
-from ..ops.hash_table import HashTable, lookup, lookup_or_insert
+from ..ops.hash_table import (HashTable, lookup, lookup_or_insert,
+                              stable_lexsort)
 from ..state.state_table import StateTable
 from .align import LEFT, RIGHT, barrier_align
 from .executor import Executor
@@ -273,7 +274,7 @@ class HashJoinExecutor(Executor):
         for p in pk_idx:
             sort_keys.append(chunk.columns[p].data)
         sort_keys.append(~active)                    # inactive rows last
-        order = jnp.lexsort(tuple(sort_keys))
+        order = stable_lexsort(tuple(sort_keys))
         s_act = active[order]
         same = s_act[1:] & s_act[:-1]
         for p in pk_idx:

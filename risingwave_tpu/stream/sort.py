@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..common.chunk import Column, StreamChunk, OP_INSERT, op_sign
+from ..ops.hash_table import stable_lexsort
 from ..state.state_table import StateTable
 from .executor import Executor, StatefulUnaryExecutor
 from .message import Barrier, Watermark
@@ -83,7 +84,7 @@ class SortExecutor(StatefulUnaryExecutor):
         key = rows[self.sort_col]
         ripe = live & (key <= wm)
         # order ripe rows by key (stable), invalid last
-        order = jnp.lexsort((jnp.arange(C), key, ~ripe))
+        order = stable_lexsort((jnp.arange(C), key, ~ripe))
         out_cols = tuple(r[order] for r in rows)
         out_vis = ripe[order]
         keep = live & ~ripe

@@ -38,7 +38,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..common.chunk import Column, StreamChunk, OP_DELETE, OP_INSERT, op_sign
-from ..ops.hash_table import HashTable, lookup_or_insert
+from ..ops.hash_table import (HashTable, lookup_or_insert,
+                              stable_lexsort, stable_lexsort_rows)
 from ..state.state_table import StateTable
 from .executor import Executor, StatefulUnaryExecutor
 from .message import Barrier, Watermark
@@ -123,7 +124,7 @@ class GroupTopNExecutor(StatefulUnaryExecutor):
                     else order_vals)
         # in-chunk rank within group; inactive rows sort last via ~ok key
         row_ids = jnp.arange(N, dtype=jnp.int32)
-        order = jnp.lexsort((row_ids, rank_key, seg))
+        order = stable_lexsort((row_ids, rank_key, seg))
         sseg = seg[order]
         new_run = jnp.concatenate([jnp.array([True]), sseg[1:] != sseg[:-1]])
         pos = jnp.arange(N, dtype=jnp.int32)
@@ -143,7 +144,7 @@ class GroupTopNExecutor(StatefulUnaryExecutor):
         merged_valid = jnp.concatenate([valid, cand_valid[:C]], axis=1)
         mk = jnp.invert(merged_keys) if self.descending else merged_keys
         # lexsort axis=1: primary = invalid-last, secondary = order key
-        sort_idx = jnp.lexsort((mk, ~merged_valid), axis=1)[:, :K]
+        sort_idx = stable_lexsort_rows((mk, ~merged_valid))[:, :K]
         new_sorted = jnp.take_along_axis(merged_keys, sort_idx, axis=1)
         new_valid = jnp.take_along_axis(merged_valid, sort_idx, axis=1)
         new_payload = []

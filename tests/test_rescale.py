@@ -1,0 +1,106 @@
+"""Elastic scaling (offline reschedule): a durable stateful fragment
+rebuilt at a different parallelism recovers per-actor vnode slices and
+continues exactly.
+
+Reference: ScaleController::reschedule_actors (src/meta/src/stream/
+scale.rs:370) recomputes vnode mappings and moves state; the TPU build's
+state already lives keyed by vnode in the durable store, so a reschedule
+is: drain + checkpoint, rebuild the fragment graph with new vnode
+bitmaps over the SAME table ids, recover each actor from its bitmap
+slice. (Online state movement over Update mutations is the follow-up;
+the vnode-sliced recovery below is the state-movement mechanism.)
+"""
+
+import asyncio
+from collections import Counter
+
+import numpy as np
+
+from risingwave_tpu.common import DataType
+from risingwave_tpu.connectors import NexmarkGenerator
+from risingwave_tpu.expr import call, col, lit
+from risingwave_tpu.expr.agg import count_star
+from risingwave_tpu.meta import BarrierCoordinator
+from risingwave_tpu.plan import (
+    BuildEnv, Exchange, Fragment, Node, StreamGraph, build_graph,
+)
+from risingwave_tpu.state import HummockStateStore, InMemObjectStore
+
+
+def make_graph(parallelism: int, start_offset: int = 0):
+    g = StreamGraph()
+    g.add(Fragment(1, Node("project", dict(
+        exprs=[call("modulus", col(0), lit(16)), col(2)],
+        names=["k", "price"]),
+        inputs=(Node("nexmark_source",
+                     dict(table="bid", chunk_size=256, durable=True)),)),
+        dispatch="hash", dist_key_indices=(0,)))
+    g.add(Fragment(2, Node("hash_agg", dict(
+        group_key_indices=[0], agg_calls=[count_star()], capacity=64,
+        durable=True),
+        inputs=(Exchange(1),)),
+        dispatch="hash", dist_key_indices=(0,), parallelism=parallelism))
+    g.add(Fragment(3, Node("materialize", dict(pk_indices=[0]),
+                           inputs=(Exchange(2),))))
+    return g
+
+
+async def run_incarnation(store, parallelism, rounds):
+    # in-process "restart": discard uncommitted shared-buffer epochs the
+    # way a real process death would (recovery reads the committed version)
+    store.reset_uncommitted()
+    coord = BarrierCoordinator(store)
+    env = BuildEnv(store, coord)
+    dep = build_graph(make_graph(parallelism), env)
+    dep.spawn()
+    await coord.run_rounds(rounds)
+    await dep.stop()
+    rows = [row for _, row in dep.roots[3][0].table.iter_all()]
+    return rows
+
+
+async def test_offline_rescale_1_to_2_actors():
+    store = HummockStateStore(InMemObjectStore())
+    rows1 = await run_incarnation(store, parallelism=1, rounds=3)
+    total1 = sum(r[1] for r in rows1)
+    assert total1 > 0 and total1 % 256 == 0
+
+    # rescale: same table ids (allocation order is deterministic), state
+    # recovered per vnode bitmap by TWO agg actors now. NOTE: total2 vs
+    # total1 is not monotone — incarnation 1's in-memory view includes its
+    # final UNCOMMITTED epoch, which a restart correctly discards; the
+    # golden recount below is the real invariant.
+    rows2 = await run_incarnation(store, parallelism=2, rounds=3)
+    total2 = sum(r[1] for r in rows2)
+    assert total2 > 0 and total2 % 256 == 0
+
+    # golden: recount the full generated volume
+    gen = NexmarkGenerator("bid", chunk_size=256)
+    want = Counter()
+    seen = 0
+    while seen < total2:
+        c = gen.next_chunk()
+        for a in np.asarray(c.columns[0].data):
+            want[int(a) % 16] += 1
+        seen += 256
+    assert seen == total2  # offsets resumed exactly (no gaps/dups)
+    got = {r[0]: r[1] for r in rows2}
+    assert got == dict(want)
+
+
+async def test_rescale_2_to_1_actor():
+    store = HummockStateStore(InMemObjectStore())
+    await run_incarnation(store, parallelism=2, rounds=3)
+    rows2 = await run_incarnation(store, parallelism=1, rounds=2)
+    total2 = sum(r[1] for r in rows2)
+    assert total2 > 0 and total2 % 256 == 0
+    gen = NexmarkGenerator("bid", chunk_size=256)
+    want = Counter()
+    seen = 0
+    while seen < total2:
+        c = gen.next_chunk()
+        for a in np.asarray(c.columns[0].data):
+            want[int(a) % 16] += 1
+        seen += 256
+    got = {r[0]: r[1] for r in rows2}
+    assert got == dict(want)
