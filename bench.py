@@ -221,7 +221,8 @@ async def bench_q1(progress: dict) -> None:
     from risingwave_tpu.state import MemoryStateStore
     from risingwave_tpu.stream import Actor, ProjectExecutor, SourceExecutor
 
-    chunk_size = 32768
+    # q1 is host-dispatch-bound: large chunks amortize the per-program cost
+    chunk_size = 131072
     store = MemoryStateStore()
     barrier_q = asyncio.Queue()
     gen = NexmarkGenerator("bid", chunk_size=chunk_size)
@@ -251,13 +252,17 @@ async def bench_q5(progress: dict) -> None:
     """q5 core: HOP(2s,10s) + count(*) GROUP BY (auction, window_start) —
     the first stateful device pipeline (BASELINE config 2).
 
-    Capacity 2^18: q5's live group set is bounded by watermark cleaning
-    (windows older than the event-time watermark are evicted every barrier),
-    but with a free-running source an EPOCH's worth of fresh (auction,
-    window) groups lands between rebuild opportunities — the table needs
-    headroom for one epoch of churn, not just the steady-state live set.
-    (Round 1's 2^21 never finished on the CPU backend; 2^16 overflows
-    mid-epoch at full throughput.)
+    Sizing is driven by CHURN PER EPOCH, not the steady-state live set:
+    watermark cleaning purges closed windows at every barrier, so the
+    table must hold the groups born between purges. Measured from the
+    deterministic generator: ~10k distinct auctions per 2s event-window;
+    at ~250M rows/s and 2us event spacing an epoch of `interval_s` wall
+    seconds spans 250M*interval*2us event-seconds => interval*250 slides.
+    At interval 0.2s: 50 event-seconds => (50+6 slides) * 10k ~ 560k peak groups —
+    fits 2^20 under the 0.7 threshold with margin. Larger chunks than 131072 outrun any
+    feasible capacity (the churn grows linearly with throughput), and a
+    too-small table would drop group updates SILENTLY in transfer-free
+    mode, so this config is chosen to keep the recorded number honest.
     """
     from risingwave_tpu.connectors import NexmarkGenerator
     from risingwave_tpu.connectors.nexmark import NexmarkConfig
@@ -268,7 +273,7 @@ async def bench_q5(progress: dict) -> None:
         Actor, HashAggExecutor, HopWindowExecutor, SourceExecutor,
     )
 
-    chunk_size = 32768
+    chunk_size = 131072
     cfg = NexmarkConfig(inter_event_us=2)
     store = MemoryStateStore()
     barrier_q = asyncio.Queue()
@@ -283,7 +288,7 @@ async def bench_q5(progress: dict) -> None:
     # executor's device-side zombie purge at every eviction barrier.
     agg = HashAggExecutor(hop, group_key_indices=[0, hop.window_start_idx],
                           agg_calls=[count_star(append_only=True)],
-                          capacity=1 << 18,
+                          capacity=1 << 20,
                           cleaning_watermark_col=hop.window_start_idx,
                           watchdog_interval=None)
     sink = _DeviceSink(agg)
@@ -291,7 +296,7 @@ async def bench_q5(progress: dict) -> None:
     coord.register_source(barrier_q)
     coord.register_actor(1)
     task = Actor(1, sink, None, coord).spawn()
-    await _measure(coord, gen, sink, progress, MEASURE_S)
+    await _measure(coord, gen, sink, progress, MEASURE_S, interval_s=0.2)
     await coord.stop_all({1})
     await task
 
@@ -326,13 +331,26 @@ async def bench_q7(progress: dict) -> None:
     # (join-apply compile at 32k chunks is ~30s since multi-key sorts
     # became iterated stable argsorts; a small agg table keeps the barrier
     # flush chunk (2*capacity) cheap on the join's right side)
+    #
+    # HONEST THROUGHPUT SIZING: every bid row is INSERTED into the left
+    # row store, and reclamation (watermark eviction + tombstone purge)
+    # runs at barriers only — so the store must hold one epoch of inserts
+    # plus the live lookback window, or rows drop SILENTLY in
+    # transfer-free mode. Row capacity 2^20 (~730k usable at 0.7; the
+    # 2^22 variant faulted the TPU worker) with a 650k rows/barrier source
+    # rate limit; reclamation runs per BARRIER, so the honest rate is
+    # 650k/interval — the 0.05s interval used below bounds it at ~13M
+    # rows/s (measured ~11.8M with barrier overhead). The live 2W lookback
+    # (~80k rows at 250us event spacing) rides inside that budget.
     chunk_size = 32768
+    rate_limit = 650_000
     cfg = NexmarkConfig(inter_event_us=250)
     store = MemoryStateStore()
     barrier_q = asyncio.Queue()
     gen = NexmarkGenerator("bid", chunk_size=chunk_size, cfg=cfg)
     src = SourceExecutor(1, gen, barrier_q, emit_watermarks=True,
-                         watermark_lag_us=2 * W)
+                         watermark_lag_us=2 * W,
+                         rate_limit_rows_per_barrier=rate_limit)
     bid4 = ProjectExecutor(
         src, [col(0), col(1), col(2), col(5, DataType.TIMESTAMP)],
         names=["auction", "bidder", "price", "date_time"])
@@ -362,7 +380,7 @@ async def bench_q7(progress: dict) -> None:
         ChannelInput(ch_l, BID4), agg,
         left_key_indices=[2], right_key_indices=[1],
         left_pk_indices=[0, 1, 2, 3], right_pk_indices=[0],
-        key_capacity=1 << 17, row_capacity=1 << 17, match_factor=2,
+        key_capacity=1 << 19, row_capacity=1 << 20, match_factor=2,
         condition=cond, output_indices=[0, 2, 1, 3],
         clean_watermark_cols=(3, None), watchdog_interval=None)
     sink = _DeviceSink(join)
@@ -372,7 +390,7 @@ async def bench_q7(progress: dict) -> None:
     coord.register_actor(2)
     t1 = Actor(1, bid4, disp, coord).spawn()
     t2 = Actor(2, sink, None, coord).spawn()
-    await _measure(coord, gen, sink, progress, MEASURE_S)
+    await _measure(coord, gen, sink, progress, MEASURE_S, interval_s=0.05)
     await coord.stop_all({1, 2})
     await t1
     await t2

@@ -78,25 +78,31 @@ async def repl(args) -> None:
                 print(f"unknown meta command {parts[0]}")
             continue
         buf += (" " if buf else "") + line
-        if ";" not in buf:
-            continue
-        stmt, buf = buf.split(";", 1)
-        buf = buf.strip()
-        try:
-            result = await session.execute(stmt)
-        except (SqlError, BindError, Exception) as e:
-            print(f"error: {e}")
-            continue
-        if isinstance(result, list):
-            for row in result:
-                print("  " + " | ".join(str(v) for v in row))
-            print(f"({len(result)} rows)")
-        elif result is not None:
-            kind = type(result).__name__.replace("Def", "").upper()
-            print(f"CREATE {kind} ok")
+        while ";" in buf:                     # drain ALL complete statements
+            stmt, buf = buf.split(";", 1)
+            buf = buf.strip()
+            if not stmt.strip():
+                continue
+            try:
+                result = await session.execute(stmt)
+            except Exception as e:            # a shell survives any error
+                print(f"error: {e}")
+                continue
+            if isinstance(result, list):
+                for row in result:
+                    print("  " + " | ".join(str(v) for v in row))
+                print(f"({len(result)} rows)")
+            elif result is not None:
+                kind = type(result).__name__.replace("Def", "").upper()
+                print(f"CREATE {kind} ok")
     stop.set()
     await tick_task
     await session.drop_all()
+    # the stdin executor thread may still be blocked in input(); a normal
+    # interpreter exit would wait for it until the user presses Enter
+    import os
+    sys.stdout.flush()
+    os._exit(0)
 
 
 def main() -> None:

@@ -128,11 +128,12 @@ class Session:
     async def tick(self, rounds: int = 1,
                    interval_s: Optional[float] = None) -> None:
         """Advance every MV's barrier loop (meta's periodic injection)."""
-        for mv in self.catalog.mvs.values():
+        # snapshot: CREATE MV may run concurrently with a background ticker
+        for mv in list(self.catalog.mvs.values()):
             await mv.coord.run_rounds(rounds, interval_s=interval_s)
 
     async def drop_all(self) -> None:
-        for mv in self.catalog.mvs.values():
+        for mv in list(self.catalog.mvs.values()):
             await mv.deployment.stop()
         self.catalog.mvs.clear()
 

@@ -44,6 +44,9 @@ from ..common.vnode import crc32_columns
 # at the 0.7 rebuild threshold while the [N, 2S] compare stays one small
 # vectorized gather per chunk.
 
+BUCKET_SLOTS = 16
+
+
 def stable_lexsort(keys):
     """np.lexsort semantics (last key primary) as ITERATED single-key
     stable argsorts. jnp.lexsort lowers to one variadic sort whose XLA
@@ -64,9 +67,6 @@ def stable_lexsort_rows(keys):
                            stable=True)
         order = jnp.take_along_axis(order, step, axis=1)
     return order
-
-
-BUCKET_SLOTS = 16
 
 
 @jax.tree_util.register_pytree_node_class

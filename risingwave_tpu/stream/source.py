@@ -142,9 +142,13 @@ class SourceExecutor(Executor):
             # partial chunks overstates this series by its padding
             self._rows_metric.inc(chunk.capacity)
             if self.rate_limit is not None:
-                # visible rows, not padded capacity (device sync is fine here:
-                # throttled sources are not the hot path)
-                sent_this_interval += chunk.num_rows_host()
+                # padded capacity, NOT visible rows: counting visible rows
+                # is a per-chunk d2h sync, which poisons tunneled-TPU
+                # dispatch (the bench's honest-throughput rate limits made
+                # this the hot path). Connector chunks are full; partial
+                # chunks OVER-count by their padding, throttling early —
+                # the conservative direction for a limiter.
+                sent_this_interval += chunk.capacity
             yield chunk
             if self.emit_watermarks:
                 wm = self.connector.current_watermark() - self.watermark_lag_us
