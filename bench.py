@@ -104,6 +104,30 @@ def _numpy_q7(chunks, window_us=10_000_000) -> float:
     return time.perf_counter() - t0
 
 
+def _numpy_q8(pchunks, achunks, window_us=10_000_000) -> float:
+    """Vectorized numpy q8: per-window person-id set joined with auction
+    sellers of the same window, incremental across chunks."""
+    t0 = time.perf_counter()
+    persons: dict[int, set] = {}
+    matches = 0
+    for (pcols, pvis), (acols, avis) in zip(pchunks, achunks):
+        pid = pcols[0][pvis]
+        pts = pcols[6][pvis]
+        pw = pts - pts % window_us
+        for w in np.unique(pw):
+            persons.setdefault(int(w), set()).update(
+                pid[pw == w].tolist())
+        seller = acols[7][avis]
+        ats = acols[5][avis]
+        aw = ats - ats % window_us
+        for w in np.unique(aw):
+            ps = persons.get(int(w))
+            if ps:
+                matches += int(np.isin(seller[aw == w],
+                                       np.fromiter(ps, dtype=np.int64)).sum())
+    return time.perf_counter() - t0
+
+
 def _gen_numpy_chunks(kind: str, n_chunks: int, chunk_size: int, cfg=None):
     """Materialize generator output as numpy (host baseline input)."""
     from risingwave_tpu.connectors import NexmarkGenerator
@@ -132,6 +156,14 @@ def _baseline_main(query: str, n_chunks: int, chunk_size: int) -> None:
         cfg = NexmarkConfig(inter_event_us=250)
         chunks = _gen_numpy_chunks("bid", n_chunks, chunk_size, cfg=cfg)
         dt = _numpy_q7(chunks)
+    elif query == "q8":
+        cfg = NexmarkConfig(inter_event_us=100)
+        # rows counted across BOTH sources: halve the per-source volume
+        pch = _gen_numpy_chunks("person", max(1, n_chunks // 2),
+                                chunk_size, cfg=cfg)
+        ach = _gen_numpy_chunks("auction", max(1, n_chunks // 2),
+                                chunk_size, cfg=cfg)
+        dt = _numpy_q8(pch, ach)
     else:
         cfg = NexmarkConfig(inter_event_us=2)
         chunks = _gen_numpy_chunks("bid", n_chunks, chunk_size, cfg=cfg)
@@ -400,7 +432,91 @@ async def bench_q7(progress: dict) -> None:
         "q7", n_chunks, chunk_size)
 
 
-QUERIES = {"q1": bench_q1, "q5": bench_q5, "q7": bench_q7}
+async def bench_q8(progress: dict) -> None:
+    """q8: persons joined with auctions they opened in the same 10s tumble
+    window (BASELINE config 4) — reference workload q8.sql. TWO sources
+    (person, auction) in separate actors, equi-join on (id=seller,
+    window_start=window_start).
+
+    Honest sizing: both sides insert every row; the 2-column sides keep a
+    2^21 row store small, and 650k rows/barrier per source with 0.05s
+    intervals bounds per-side epoch churn at ~650k << 1.46M usable
+    (watermark eviction reclaims at each barrier) and the total rate at
+    ~26M rows/s.
+    """
+    from risingwave_tpu.common import DataType
+    from risingwave_tpu.connectors import NexmarkGenerator
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig
+    from risingwave_tpu.expr import call, col, lit
+    from risingwave_tpu.meta import BarrierCoordinator
+    from risingwave_tpu.state import MemoryStateStore
+    from risingwave_tpu.stream import (
+        Actor, Channel, ChannelInput, HashJoinExecutor, ProjectExecutor,
+        SimpleDispatcher, SourceExecutor,
+    )
+
+    W = 10_000_000
+    chunk_size = 32768
+    rate_limit = 650_000
+    cfg = NexmarkConfig(inter_event_us=100)
+    store = MemoryStateStore()
+    q_p, q_a = asyncio.Queue(), asyncio.Queue()
+    gen_p = NexmarkGenerator("person", chunk_size=chunk_size, cfg=cfg)
+    gen_a = NexmarkGenerator("auction", chunk_size=chunk_size, cfg=cfg)
+    src_p = SourceExecutor(1, gen_p, q_p, emit_watermarks=True,
+                           watermark_lag_us=W,
+                           rate_limit_rows_per_barrier=rate_limit)
+    src_a = SourceExecutor(2, gen_a, q_a, emit_watermarks=True,
+                           watermark_lag_us=W,
+                           rate_limit_rows_per_barrier=rate_limit)
+    # person: (id, window_start); auction: (seller, window_start)
+    pp = ProjectExecutor(
+        src_p, [col(0), call("tumble_start", col(6, DataType.TIMESTAMP),
+                             lit(W))],
+        names=["id", "window_start"],
+        watermark_transforms={6: (1, lambda v: v - v % W)})
+    pa = ProjectExecutor(
+        src_a, [col(7), call("tumble_start", col(5, DataType.TIMESTAMP),
+                             lit(W))],
+        names=["seller", "window_start"],
+        watermark_transforms={5: (1, lambda v: v - v % W)})
+    ch_p, ch_a = Channel(64), Channel(64)
+    join = HashJoinExecutor(
+        ChannelInput(ch_p, pp.schema), ChannelInput(ch_a, pa.schema),
+        left_key_indices=[0, 1], right_key_indices=[0, 1],
+        left_pk_indices=[0, 1], right_pk_indices=[0, 1],
+        key_capacity=1 << 20, row_capacity=1 << 21, match_factor=2,
+        output_indices=[0, 1],
+        clean_watermark_cols=(1, 1), watchdog_interval=None)
+    sink = _DeviceSink(join)
+    coord = BarrierCoordinator(store)
+    coord.register_source(q_p)
+    coord.register_source(q_a)
+    coord.register_actor(1)
+    coord.register_actor(2)
+    coord.register_actor(3)
+    t1 = Actor(1, pp, SimpleDispatcher(ch_p), coord).spawn()
+    t2 = Actor(2, pa, SimpleDispatcher(ch_a), coord).spawn()
+    t3 = Actor(3, sink, None, coord).spawn()
+
+    class _TwoGen:
+        """progress counter over both sources."""
+        @property
+        def offset(self):
+            return gen_p.offset + gen_a.offset
+    await _measure(coord, _TwoGen(), sink, progress, MEASURE_S,
+                   interval_s=0.05)
+    await coord.stop_all({1, 2, 3})
+    for t in (t1, t2, t3):
+        await t
+
+    n_chunks = max(2, min(16, progress["rows"] // chunk_size))
+    progress["baseline_rows_per_sec"] = _measured_baseline(
+        "q8", n_chunks, chunk_size)
+
+
+QUERIES = {"q1": bench_q1, "q5": bench_q5, "q7": bench_q7,
+           "q8": bench_q8}
 
 
 def _emit(query: str, progress: dict, note: str = "") -> None:
