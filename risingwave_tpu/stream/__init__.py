@@ -21,6 +21,7 @@ from .dedup import AppendOnlyDedupExecutor
 from .simple_agg import SimpleAggExecutor, StatelessSimpleAggExecutor
 from .top_n import GroupTopNExecutor, top_n
 from .sort import SortExecutor
+from .over_window import OverWindowExecutor, ROW_NUMBER
 from .misc import (
     ExpandExecutor, FlowControlExecutor, NoOpExecutor, UnionExecutor,
     ValuesExecutor, WatermarkFilterExecutor,

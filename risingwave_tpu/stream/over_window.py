@@ -1,0 +1,239 @@
+"""OverWindow executor (append-only) — per-partition window functions.
+
+Reference: src/stream/src/executor/over_window/ (general.rs keeps a
+per-partition cache over a delta btree; eowc.rs is the emit-on-close
+variant) with window states from expr/core/src/window_function/state/.
+
+TPU re-design (append-only subset): partitions live in the same
+open-addressing HashTable as HashAgg; per-partition state is one scalar
+per window call (row counter for ROW_NUMBER/RANK over arrival order,
+running aggregate for SUM/COUNT/MIN/MAX over the unbounded-preceding
+frame). Applying a chunk is ONE jitted step: slot assignment, in-chunk
+rank within partition (stable sort by slot), output column = partition
+state + in-chunk prefix, then a segment-reduce folds the chunk into the
+state. Rows emit IMMEDIATELY with their window values (append-only
+streams never retract prior outputs, so no flush diffing is needed —
+the reference's general path buffers for exactly the retraction case).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.chunk import Column, StreamChunk, OP_INSERT, op_sign
+from ..common.types import DataType, Field, Schema
+from ..expr.agg import AggCall, AggKind
+from ..ops.hash_table import HashTable, lookup_or_insert, stable_lexsort
+from ..state.state_table import StateTable
+from .executor import Executor, StatefulUnaryExecutor
+from .message import Barrier, Watermark
+
+ROW_NUMBER = "row_number"
+
+
+class OverWindowExecutor(StatefulUnaryExecutor):
+    """Append-only over-window. `calls` is a list of either the string
+    "row_number" or an AggCall (running aggregate over the
+    unbounded-preceding frame, in arrival order). Output schema = input
+    columns ++ one column per call."""
+
+    def __init__(self, input: Executor,
+                 partition_key_indices: Sequence[int],
+                 calls: Sequence,
+                 capacity: int = 1 << 14,
+                 state_table: Optional[StateTable] = None,
+                 watchdog_interval: Optional[int] = 1):
+        self.input = input
+        self.partition_key_indices = tuple(partition_key_indices)
+        self.calls = tuple(calls)
+        in_fields = list(input.schema)
+        out_fields = list(in_fields)
+        self._specs = []
+        for j, c in enumerate(self.calls):
+            if c == ROW_NUMBER:
+                out_fields.append(Field(f"row_number{j}", DataType.INT64))
+                self._specs.append(None)
+            else:
+                assert isinstance(c, AggCall)
+                out_fields.append(Field(f"w{j}", c.ret_type))
+                self._specs.append(c.spec())
+        self.schema = Schema(tuple(out_fields))
+        self.pk_indices = input.pk_indices
+        self.capacity = capacity
+        self.identity = (f"OverWindow(partition={self.partition_key_indices},"
+                         f" calls={len(self.calls)})")
+        self._key_dtypes = tuple(
+            input.schema[i].data_type.jnp_dtype
+            for i in self.partition_key_indices)
+        self.table = HashTable.empty(capacity, self._key_dtypes)
+        self.counts = jnp.zeros(capacity, dtype=jnp.int64)
+        self.agg_states = tuple(
+            (spec.init_state((capacity,)) if spec is not None else None)
+            for spec in self._specs)
+        self._apply = jax.jit(self._apply_impl)
+        self._errs_dev = jnp.zeros((), dtype=jnp.int32)
+        self._init_stateful(state_table, watchdog_interval)
+
+    def fence_tokens(self) -> list:
+        return [self.counts] + super().fence_tokens()
+
+    # --------------------------------------------------------- chunk step
+    def _apply_impl(self, table, counts, agg_states, errs,
+                    chunk: StreamChunk):
+        N = chunk.capacity
+        C = self.capacity
+        active = chunk.vis & (op_sign(chunk.ops) > 0)   # append-only
+        n_viol = jnp.sum((chunk.vis & (op_sign(chunk.ops) < 0))
+                         .astype(jnp.int32))
+        key_cols = [chunk.columns[i].data
+                    for i in self.partition_key_indices]
+        table, slots, n_un = lookup_or_insert(table, key_cols, active)
+        ok = slots >= 0
+        seg = jnp.where(ok, slots, C)
+
+        # arrival rank within partition for this chunk (stable by row id)
+        row_ids = jnp.arange(N, dtype=jnp.int32)
+        order = stable_lexsort((row_ids, seg))
+        sseg = seg[order]
+        new_run = jnp.concatenate([jnp.array([True]),
+                                   sseg[1:] != sseg[:-1]])
+        pos = jnp.arange(N, dtype=jnp.int32)
+        run_start = jax.lax.cummax(jnp.where(new_run, pos, 0))
+        s_rank = pos - run_start
+        rank = jnp.zeros(N, dtype=jnp.int64).at[order].set(
+            s_rank.astype(jnp.int64))
+
+        out_cols = list(chunk.columns)
+        new_agg_states = []
+        for j, (c, spec) in enumerate(zip(self.calls, self._specs)):
+            if spec is None:                      # row_number: 1-based
+                vals = counts[jnp.clip(seg, 0, C - 1)] + rank + 1
+                out_cols.append(Column(jnp.where(ok, vals, 0)))
+                new_agg_states.append(None)
+                continue
+            col = (chunk.columns[c.arg] if c.arg is not None else None)
+            values = (col.data if col is not None
+                      else jnp.zeros(N, dtype=spec.state_dtype))
+            valid_in = (col.valid_mask() if col is not None
+                        else jnp.ones(N, dtype=bool))
+            signs = jnp.where(ok & valid_in, 1, 0).astype(jnp.int32)
+            # running value per row = partition state + in-chunk prefix
+            # INCLUDING the row: segmented inclusive prefix in sorted order
+            sv = values[order].astype(spec.state_dtype)
+            ssigns = signs[order]
+            if c.kind is AggKind.COUNT:
+                contrib = ssigns.astype(jnp.int64)
+            elif c.kind is AggKind.SUM:
+                contrib = jnp.where(ssigns > 0, sv, 0)
+            else:
+                ident = spec.init
+                contrib = jnp.where(ssigns > 0, sv, ident)
+            if c.kind in (AggKind.COUNT, AggKind.SUM):
+                run_base = jnp.cumsum(contrib) - contrib
+                seg_base = run_base[run_start]
+                prefix = run_base - seg_base + contrib
+            else:
+                # segmented min/max scan: reset at run starts by comparing
+                # against the prefix from the run start only
+                def seg_scan(op, x):
+                    def f(a, b):
+                        av, ai = a
+                        bv, bi = b
+                        keep = bi > ai
+                        return (jnp.where(keep, bv, op(av, bv)),
+                                jnp.maximum(ai, bi))
+                    v, _ = jax.lax.associative_scan(
+                        f, (x, run_start.astype(jnp.int32)))
+                    return v
+                prefix = seg_scan(
+                    jnp.minimum if c.kind is AggKind.MIN else jnp.maximum,
+                    contrib)
+            st = agg_states[j]
+            base = st[jnp.clip(sseg, 0, C - 1)]
+            run_vals = spec.combine(base, prefix)
+            out = jnp.zeros(N, dtype=st.dtype).at[order].set(run_vals)
+            out_cols.append(Column(jnp.where(ok, out, 0).astype(
+                c.ret_type.jnp_dtype)))
+            part = spec.partial(values, signs, seg, C + 1)[:C]
+            new_agg_states.append(spec.combine(st, part))
+
+        counts2 = counts + jax.ops.segment_sum(
+            ok.astype(jnp.int64), seg, C + 1)[:C]
+        out_chunk = StreamChunk(tuple(out_cols), chunk.ops,
+                                chunk.vis & ok, self.schema)
+        return (table, counts2, tuple(new_agg_states),
+                errs + n_un + n_viol, out_chunk)
+
+    # -------------------------------------------------------------- hooks
+    def check_watchdog(self) -> None:
+        n = int(np.asarray(self._errs_dev))
+        if n:
+            raise RuntimeError(
+                f"over-window overflow or append-only violation ({n} "
+                f"rows, capacity {self.capacity})")
+
+    def on_chunk(self, chunk: StreamChunk) -> StreamChunk:
+        (self.table, self.counts, self.agg_states, self._errs_dev,
+         out) = self._apply(self.table, self.counts, self.agg_states,
+                            self._errs_dev, chunk)
+        self._dirty_persist = True
+        return out
+
+    def persist(self, barrier: Barrier, flushed) -> None:
+        if self.state_table is None:
+            return
+        if not getattr(self, "_dirty_persist", False):
+            self.state_table.commit(barrier.epoch.curr)
+            return
+        self._dirty_persist = False
+        # snapshot the partition states (keys ++ count ++ agg states);
+        # dirty-slot delta persistence is the follow-up once partition
+        # counts warrant it (sibling hash_agg writes only its flush view)
+        occ = np.asarray(self.table.occupied)
+        idx = np.flatnonzero(occ)
+        if idx.size:
+            keys = [np.asarray(k)[idx] for k in self.table.keys]
+            cnts = np.asarray(self.counts)[idx]
+            aggs = [np.asarray(s)[idx] for s in self.agg_states
+                    if s is not None]
+            rows = []
+            for r in range(idx.size):
+                row = tuple(k[r].item() for k in keys) + (int(cnts[r]),)
+                row += tuple(a[r].item() for a in aggs)
+                rows.append((int(OP_INSERT), row))
+            self.state_table.write_chunk_rows(rows)
+        self.state_table.commit(barrier.epoch.curr)
+
+    def recover_state(self, epoch: int) -> None:
+        rows = [row for _, row in self.state_table.iter_all()]
+        if not rows:
+            return
+        nk = len(self.partition_key_indices)
+        key_cols = [jnp.asarray(np.asarray([r[j] for r in rows]), dtype=dt)
+                    for j, dt in enumerate(self._key_dtypes)]
+        table, slots, n_un = lookup_or_insert(
+            HashTable.empty(self.capacity, self._key_dtypes), key_cols,
+            jnp.ones(len(rows), dtype=bool))
+        assert int(n_un) == 0
+        self.table = table
+        self.counts = self.counts.at[slots].set(
+            jnp.asarray(np.asarray([r[nk] for r in rows],
+                                   dtype=np.int64)))
+        off = nk + 1
+        new_states = []
+        for spec, st in zip(self._specs, self.agg_states):
+            if spec is None:
+                new_states.append(None)
+                continue
+            vals = jnp.asarray(np.asarray([r[off] for r in rows]),
+                               dtype=spec.state_dtype)
+            new_states.append(st.at[slots].set(vals))
+            off += 1
+        self.agg_states = tuple(new_states)
+
+    def map_watermark(self, wm: Watermark) -> Optional[Watermark]:
+        return wm if wm.col_idx < len(self.input.schema) else None
