@@ -1,3 +1,4 @@
 from .nexmark import (
     NexmarkGenerator, NexmarkConfig, BID_SCHEMA, PERSON_SCHEMA, AUCTION_SCHEMA,
 )
+from .datagen import ColumnSpec, DatagenConnector

@@ -1,0 +1,97 @@
+"""Datagen connector — schema-driven synthetic source.
+
+Reference: src/connector/src/source/datagen/ — per-column generator specs
+(sequence or random with min/max) driving a rate-controlled stream; used
+everywhere in tests/demos where Kafka would be.
+
+TPU build: one jitted program per chunk computes every column from the
+row-id counter (counter-based splitmix64 like the Nexmark generator, so
+the stream is deterministic and seekable for exactly-once replay)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..common.chunk import Column, StreamChunk
+from ..common.types import DataType, Field, Schema
+from .nexmark import _splitmix64
+
+
+@dataclass(frozen=True)
+class ColumnSpec:
+    """sequence: start + row_id; random: splitmix64(seed, row_id) in
+    [min, max]; timestamp: base + row_id * interval_us."""
+
+    name: str
+    kind: str                      # "sequence" | "random" | "timestamp"
+    # random spans [min, max] INCLUSIVE (reference datagen treats max as
+    # inclusive)
+    dtype: DataType = DataType.INT64
+    start: int = 0                 # sequence
+    min: int = 0                   # random
+    max: int = 1 << 31
+    base_us: int = 1_500_000_000_000_000   # timestamp
+    interval_us: int = 1000
+
+
+class DatagenConnector:
+    """Deterministic, seekable generator over ColumnSpecs (the Connector
+    protocol SourceExecutor expects)."""
+
+    def __init__(self, columns: Sequence[ColumnSpec], chunk_size: int = 4096,
+                 seed: int = 42, start_offset: int = 0):
+        self.columns = tuple(columns)
+        self.chunk_size = chunk_size
+        self.seed = seed
+        self.offset = start_offset
+        self.schema = Schema(tuple(Field(c.name, c.dtype)
+                                   for c in self.columns))
+        self._vis = jnp.ones(chunk_size, dtype=bool)
+        self._ops = jnp.zeros(chunk_size, dtype=jnp.int8)
+        self._gen = jax.jit(self._gen_impl)
+        # watermark support when a timestamp column exists
+        self._ts_spec = next(
+            (i for i, c in enumerate(self.columns)
+             if c.kind == "timestamp"), None)
+
+    def _gen_impl(self, offset):
+        ids = offset + jnp.arange(self.chunk_size, dtype=jnp.int64)
+        cols = []
+        for i, c in enumerate(self.columns):
+            if c.kind == "sequence":
+                data = (c.start + ids).astype(c.dtype.jnp_dtype)
+            elif c.kind == "timestamp":
+                data = (c.base_us + ids * c.interval_us).astype(
+                    c.dtype.jnp_dtype)
+            else:
+                h = _splitmix64(ids.astype(jnp.uint64)
+                                ^ jnp.uint64(self.seed * 0x9E37 + i))
+                span = jnp.uint64(max(1, c.max - c.min + 1))
+                data = (c.min + (h % span).astype(jnp.int64)).astype(
+                    c.dtype.jnp_dtype)
+            cols.append(data)
+        return tuple(cols)
+
+    def next_chunk(self) -> StreamChunk:
+        cols = self._gen(jnp.int64(self.offset))
+        self.offset += self.chunk_size
+        return StreamChunk(tuple(Column(c) for c in cols), self._ops,
+                           self._vis, self.schema)
+
+    def seek(self, offset: int) -> None:
+        self.offset = offset
+
+    @property
+    def watermark_col(self) -> int:
+        assert self._ts_spec is not None, "no timestamp column"
+        return self._ts_spec
+
+    def current_watermark(self) -> int:
+        assert self._ts_spec is not None, \
+            "datagen watermarks need a timestamp column"
+        c = self.columns[self._ts_spec]
+        return c.base_us + max(0, self.offset - 1) * c.interval_us

@@ -95,9 +95,9 @@ class OverWindowExecutor(StatefulUnaryExecutor):
         ok = slots >= 0
         seg = jnp.where(ok, slots, C)
 
-        # arrival rank within partition for this chunk (stable by row id)
-        row_ids = jnp.arange(N, dtype=jnp.int32)
-        order = stable_lexsort((row_ids, seg))
+        # arrival rank within partition for this chunk: ONE stable sort
+        # by slot preserves row order within each partition
+        order = jnp.argsort(seg, stable=True)
         sseg = seg[order]
         new_run = jnp.concatenate([jnp.array([True]),
                                    sseg[1:] != sseg[:-1]])
