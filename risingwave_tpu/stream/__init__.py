@@ -15,6 +15,8 @@ from .exchange import (
 )
 from .hash_agg import HashAggExecutor
 from .hash_join import HashJoinExecutor
+from .sorted_join import SortedJoinExecutor
+from .sharded_join import ShardedSortedJoinExecutor
 from .align import barrier_align
 from .hop_window import HopWindowExecutor
 from .dedup import AppendOnlyDedupExecutor

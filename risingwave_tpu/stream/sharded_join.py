@@ -1,0 +1,136 @@
+"""Vnode-sharded SortedJoin — the streaming join under shard_map over a mesh.
+
+Reference: a hash-distributed join fragment is N parallel actors, each
+owning a vnode slice, fed by HashDataDispatcher on the JOIN KEY from both
+sides (src/stream/src/executor/hash_join.rs:478 under dispatch.rs:679) —
+matching rows land on the same actor because both dispatchers hash the
+same key values.
+
+On a TPU mesh the dispatcher+merge pair collapses INTO the jitted step
+(same re-design as ShardedHashAggExecutor, sharded_agg.py): each side's
+sorted state lives sharded along the `vnode` mesh axis, input chunks are
+replicated and masked down to each shard's own vnodes (vnode =
+crc32(key) & 255, identical on both sides => co-partitioned probes are
+shard-local), and the per-shard output chunks concatenate along the shard
+axis into one global changelog chunk. `capacity` is PER SHARD.
+
+Inherits ALL semantics (inner/outer, degrees, per-chunk eviction,
+netting) from SortedJoinExecutor — `_apply_impl` / `_evict_impl` run
+unchanged inside shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..common.chunk import StreamChunk
+from ..common.vnode import compute_vnodes
+from ..parallel.mesh import VNODE_AXIS, vnode_to_shard
+from .align import LEFT, RIGHT
+from .executor import Executor
+from .sorted_join import SortedJoinExecutor, SortedSideState, _empty_sorted_side
+
+
+def _scalar_n(state: SortedSideState) -> SortedSideState:
+    return SortedSideState(state.khash, state.cols, state.valids,
+                           state.degree, state.n.reshape(()))
+
+
+def _vec_n(state: SortedSideState) -> SortedSideState:
+    return SortedSideState(state.khash, state.cols, state.valids,
+                           state.degree, state.n.reshape((1,)))
+
+
+class ShardedSortedJoinExecutor(SortedJoinExecutor):
+    def __init__(self, left: Executor, right: Executor, mesh: Mesh,
+                 **kwargs):
+        self.mesh = mesh
+        self.n_shards = mesh.shape[VNODE_AXIS]
+        self._routing = jnp.asarray(vnode_to_shard(self.n_shards))
+        super().__init__(left, right, **kwargs)
+        shard, repl = P(VNODE_AXIS), P()
+
+        def make_apply(side):
+            def apply_sharded(own, other, errs, chunk, wm):
+                my = jax.lax.axis_index(VNODE_AXIS)
+                key_cols = [chunk.columns[i].data
+                            for i in self.key_indices[side]]
+                vn = compute_vnodes(key_cols)
+                mine = chunk.vis & (self._routing[vn] == my)
+                local = StreamChunk(chunk.columns, chunk.ops, mine,
+                                    chunk.schema)
+                out = self._apply_impl(_scalar_n(own), _scalar_n(other),
+                                       errs[0], local, wm, side)
+                own2, odeg, cols, ops, vis, errs2, _ = out
+                return (_vec_n(own2), odeg, cols, ops, vis, errs2[None],
+                        own2.n.reshape((1,)))
+            return jax.jit(jax.shard_map(
+                apply_sharded, mesh=mesh,
+                in_specs=(shard, shard, shard, repl, repl),
+                out_specs=(shard, shard, shard, shard, shard, shard,
+                           shard)))
+
+        applies = {LEFT: make_apply(LEFT), RIGHT: make_apply(RIGHT)}
+        self._apply = (lambda own, other, errs, chunk, wm, side:
+                       applies[side](own, other, errs, chunk, wm))
+
+        def make_evict(side):
+            def evict_sharded(own, wm):
+                return _vec_n(self._evict_impl(_scalar_n(own), wm, side))
+            return jax.jit(jax.shard_map(
+                evict_sharded, mesh=mesh, in_specs=(shard, repl),
+                out_specs=shard))
+
+        evicts = {LEFT: make_evict(LEFT), RIGHT: make_evict(RIGHT)}
+        self._evict = lambda own, wm, side: evicts[side](own, wm)
+
+        # sharded accumulators replace the parent's scalars
+        sharding = NamedSharding(mesh, P(VNODE_AXIS))
+        self._errs_dev = jax.device_put(
+            jnp.zeros((self.n_shards, 3), dtype=jnp.int32), sharding)
+        zero = jax.device_put(
+            jnp.zeros(self.n_shards, dtype=jnp.int32), sharding)
+        self._n_dev = [zero, zero]
+        self.sides = [self._sharded_empty(s) for s in (LEFT, RIGHT)]
+
+    def _sharded_empty(self, side: int) -> SortedSideState:
+        S = self.n_shards
+        local = _empty_sorted_side(self.capacity[side],
+                                   self._col_dtypes[side])
+        sharding = NamedSharding(self.mesh, P(VNODE_AXIS))
+
+        def expand(x):
+            if x.ndim == 0:
+                g = jnp.zeros(S, dtype=x.dtype)
+            else:
+                g = jnp.tile(x, (S,) + (1,) * (x.ndim - 1))
+            return jax.device_put(g, sharding)
+
+        return jax.tree_util.tree_map(expand, local)
+
+    def _empty(self, side: int) -> SortedSideState:
+        # called by the parent constructor before the mesh fields exist;
+        # replaced by _sharded_empty right after
+        return _empty_sorted_side(self.capacity[side],
+                                  self._col_dtypes[side])
+
+    # --------------------------------------------------------- watchdog
+    def _check_watchdog(self) -> None:
+        errs = np.asarray(self._errs_dev).sum(axis=0)
+        n_mo, n_miss, n_ro = (int(x) for x in errs)
+        if n_mo:
+            raise RuntimeError(
+                f"sharded-join match-buffer overflow ({n_mo} dropped)")
+        if n_ro:
+            raise RuntimeError(
+                f"sharded-join state overflow ({n_ro} rows dropped; "
+                f"per-shard capacity {self.capacity})")
+        if n_miss:
+            raise RuntimeError(
+                f"sharded-join changelog inconsistency: {n_miss} deletes "
+                f"matched no stored row")

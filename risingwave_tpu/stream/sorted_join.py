@@ -1,0 +1,580 @@
+"""Sorted-merge streaming join — dense sorted state, no chains, no loops.
+
+Semantics match HashJoinExecutor (the reference's two-sided streaming
+equi-join, src/stream/src/executor/hash_join.rs:478 with the multimap state
+of managed_state/join/mod.rs:238-268): a chunk from one side probes the
+OTHER side's stored rows and emits joined changelog rows, then updates its
+OWN store (update pairs degrade to Delete/Insert, NULL keys never match).
+
+TPU re-design — why not the chained hash multimap of hash_join.py:
+  * The chain walk is a `lax.while_loop` whose trip count is the longest
+    key chain: hot keys turn one chunk into hundreds of tiny dependent
+    kernel launches.
+  * Slots are reclaimed only by a barrier-time rebuild, so the row store
+    must hold A WHOLE EPOCH of inserts on top of the live set. That makes
+    throughput = row_capacity x barrier_rate — the measured q7/q8 ceiling.
+
+Here each side's state is a *dense, sorted* struct-of-arrays: rows
+[0, n) sorted ascending by a 63-bit hash of the join key (exact key
+equality re-checked on every candidate, so hash collisions only cost a
+wasted compare — they can never produce a wrong match). Everything is
+sort / searchsorted / cumsum / gather — static shapes, zero
+data-dependent control flow:
+
+  probe   lo/hi = searchsorted(other.khash, h) — each chunk row's matches
+          are a CONTIGUOUS RANGE. Ranges are expanded into a fixed match
+          buffer [M] with cumsum offsets + one locating searchsorted
+          (no loop, unlike the chain walk).
+  evict   rows with clean-col < watermark are dropped DURING the same
+          merge program that inserts new rows — per chunk, not per
+          barrier. State capacity therefore bounds the LIVE set only;
+          epoch churn is unlimited. This is what lifts the q7/q8 cap.
+  insert  incoming rows are sorted by hash and merged into the kept rows
+          with two searchsorteds (stable: state rows stay before new rows
+          of equal hash) + scatters — O(C + N) bandwidth, no table sort.
+  delete  a retraction finds its victim row via its own side's range +
+          exact (key, pk) compare; one victim per retraction (within-chunk
+          insert/delete runs on the same pk are netted first, exactly like
+          hash_join.py's pk-run resolution).
+
+`append_only=(left, right)` statically removes the retraction machinery
+from a side's program — the common windowed-join case compiles to the
+probe + merge path alone.
+
+Outer joins (join_type left/right/full) follow the reference's degree
+design (managed_state/join/mod.rs:252-261): every stored row carries its
+count of condition-passing matches on the other side. A chunk's probe
+scatter-adds signed deltas into the OTHER side's degree column; rows whose
+degree transitions 0 -> >0 retract their NULL-padded output row, and
+> 0 -> 0 (re-)emit it — computed per chunk as NET transitions (transient
+flips within one chunk cancel, the Delete/Insert degradation the reference
+applies when pairs can't stay adjacent). Unmatched rows on an outer side
+emit their NULL-padded row inline at insert/delete time, including
+NULL-key rows (which can never match). The non-equi condition therefore
+evaluates INSIDE the jitted apply.
+
+v1 scope: device-resident state (state_tables unsupported — the durable
+production join remains HashJoinExecutor).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.chunk import (
+    Column, StreamChunk, OP_DELETE, OP_INSERT, op_sign,
+)
+from ..common.types import Field, Schema
+from ..ops.hash_table import stable_lexsort
+from .align import LEFT, RIGHT, barrier_align
+from .executor import Executor
+from .message import Barrier, BarrierKind, Watermark
+
+# Padding value for khash beyond the live prefix: int64 max keeps
+# searchsorted ranges inside [0, n) (a real 63-bit hash equals it with
+# probability ~2^-63, and even then the exact-key compare rejects the row).
+_HSENTINEL = jnp.iinfo(jnp.int64).max
+# "No watermark yet" eviction threshold — below any real event time.
+NO_WATERMARK = -(1 << 62)
+
+
+def key_hash(key_cols: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """63-bit nonnegative hash of the composite key (splitmix64 chain)."""
+    h = jnp.full(key_cols[0].shape[0], 0x243F6A8885A308D3, dtype=jnp.uint64)
+    for c in key_cols:
+        x = h ^ (c.astype(jnp.uint64) * jnp.uint64(0x9E3779B97F4A7C15))
+        x = x + jnp.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+        h = x ^ (x >> jnp.uint64(31))
+    return (h >> jnp.uint64(1)).astype(jnp.int64)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class SortedSideState:
+    """One side's store: dense prefix [0, n), ascending by khash."""
+
+    khash: jnp.ndarray                 # int64 [C], sentinel beyond n
+    cols: tuple[jnp.ndarray, ...]      # per input column [C]
+    valids: tuple[jnp.ndarray, ...]    # per input column bool [C]
+    degree: jnp.ndarray                # int32 [C] — matches on other side
+    n: jnp.ndarray                     # int32 scalar — live rows
+
+    def tree_flatten(self):
+        return ((self.khash, self.cols, self.valids, self.degree,
+                 self.n), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        kh, cols, valids, degree, n = children
+        return cls(kh, tuple(cols), tuple(valids), degree, n)
+
+    @property
+    def capacity(self) -> int:
+        return self.khash.shape[0]
+
+
+def _empty_sorted_side(capacity: int, col_dtypes: Sequence) -> SortedSideState:
+    return SortedSideState(
+        khash=jnp.full(capacity, _HSENTINEL, dtype=jnp.int64),
+        cols=tuple(jnp.zeros(capacity, dtype=dt) for dt in col_dtypes),
+        valids=tuple(jnp.zeros(capacity, dtype=bool) for _ in col_dtypes),
+        degree=jnp.zeros(capacity, dtype=jnp.int32),
+        n=jnp.int32(0),
+    )
+
+
+def _count_le(sorted_arr: jnp.ndarray, dead_cum: jnp.ndarray,
+              vals: jnp.ndarray, side: str) -> jnp.ndarray:
+    """Count of LIVE entries of `sorted_arr` </<= vals, where `dead_cum`
+    is the inclusive prefix-sum of the dead mask over the same array."""
+    idx = jnp.searchsorted(sorted_arr, vals, side=side)
+    dead_before = jnp.where(idx > 0, dead_cum[jnp.clip(idx - 1, 0)], 0)
+    return (idx - dead_before).astype(jnp.int32)
+
+
+class SortedJoinExecutor(Executor):
+    """Inner equi-join over sorted dense state. Drop-in for
+    HashJoinExecutor (same constructor surface minus state_tables)."""
+
+    def __init__(self, left: Executor, right: Executor,
+                 left_key_indices: Sequence[int],
+                 right_key_indices: Sequence[int],
+                 left_pk_indices: Sequence[int],
+                 right_pk_indices: Sequence[int],
+                 capacity: int = 1 << 17,
+                 match_factor: int = 2,
+                 condition=None,
+                 join_type: str = "inner",
+                 output_indices: Optional[Sequence[int]] = None,
+                 append_only: tuple[bool, bool] = (False, False),
+                 clean_watermark_cols: tuple[Optional[int], Optional[int]] = (None, None),
+                 watchdog_interval: Optional[int] = 1):
+        self.inputs = (left, right)
+        self.key_indices = (tuple(left_key_indices), tuple(right_key_indices))
+        self.pk_indices_side = (tuple(left_pk_indices), tuple(right_pk_indices))
+        assert len(self.key_indices[0]) == len(self.key_indices[1])
+        lt, rt = left.schema, right.schema
+        for li, ri in zip(*self.key_indices):
+            assert lt[li].data_type.np_dtype == rt[ri].data_type.np_dtype, \
+                f"join key dtype mismatch {lt[li]} vs {rt[ri]}"
+            assert np.issubdtype(lt[li].data_type.np_dtype, np.integer), \
+                "sorted join keys must be integer-typed (ints/dict/timestamps)"
+        self._col_dtypes = (
+            tuple(f.data_type.jnp_dtype for f in lt),
+            tuple(f.data_type.jnp_dtype for f in rt),
+        )
+        full_fields = [Field(f"l_{f.name}" if f.name in {g.name for g in rt} else f.name,
+                             f.data_type, f.scale) for f in lt]
+        full_fields += [Field(f"r_{f.name}" if f.name in {g.name for g in lt} else f.name,
+                              f.data_type, f.scale) for f in rt]
+        self.output_indices = (tuple(output_indices) if output_indices is not None
+                               else tuple(range(len(full_fields))))
+        self.schema = Schema(tuple(full_fields[i] for i in self.output_indices))
+        out_pk_full = (tuple(self.pk_indices_side[0])
+                       + tuple(len(lt) + i for i in self.pk_indices_side[1]))
+        self.pk_indices = tuple(self.output_indices.index(i)
+                                for i in out_pk_full if i in self.output_indices)
+        self.capacity = [capacity, capacity]
+        self.match_factor = match_factor
+        self.condition = condition
+        assert join_type in ("inner", "left", "right", "full")
+        # Watermark eviction drops rows WITHOUT probing, so it cannot
+        # maintain the other side's degree column; combining state
+        # cleaning with outer semantics would silently corrupt NULL-row
+        # accounting (an evicted row's matches keep degree>0 forever).
+        # The reference has the same tension (TTL cleaning is documented
+        # as inconsistency-introducing for outer joins); fail loudly.
+        if join_type != "inner":
+            assert clean_watermark_cols == (None, None), \
+                "outer joins do not support watermark state cleaning"
+        self.join_type = join_type
+        # side s "preserves" its unmatched rows (emits NULL-padded output)
+        self._outer = (join_type in ("left", "full"),
+                       join_type in ("right", "full"))
+        self.append_only = tuple(append_only)
+        self.clean_cols = tuple(clean_watermark_cols)
+        self._pending_clean: list[int] = [NO_WATERMARK, NO_WATERMARK]
+        self.identity = (f"SortedJoin(l={self.key_indices[0]}, "
+                         f"r={self.key_indices[1]})")
+        self.sides = [self._empty(s) for s in (LEFT, RIGHT)]
+        self._apply = jax.jit(self._apply_impl, static_argnames=("side",))
+        self._evict = jax.jit(self._evict_impl, static_argnames=("side",))
+        if watchdog_interval not in (None, 1):
+            raise ValueError("watchdog_interval must be 1 or None")
+        self.watchdog_interval = watchdog_interval
+        self.rebuilds = 0
+        # device error accumulator [match_overflow, del_miss, row_overflow];
+        # fetched once per barrier (hash_join.py:546 rationale)
+        self._errs_dev = jnp.zeros(3, dtype=jnp.int32)
+        zero = jnp.zeros((), dtype=jnp.int32)
+        self._n_dev = [zero, zero]
+        self._dirty = [False, False]
+        self._watchdog_pack = jax.jit(
+            lambda errs, nl, nr: jnp.concatenate([errs, jnp.stack([nl, nr])]))
+        self._key_wms: list[dict[int, int]] = [{}, {}]
+        self._emitted_key_wm: dict[int, int] = {}
+
+    def fence_tokens(self) -> list:
+        return [s.n for s in self.sides] + super().fence_tokens()
+
+    def _empty(self, side: int) -> SortedSideState:
+        return _empty_sorted_side(self.capacity[side], self._col_dtypes[side])
+
+    # ------------------------------------------------------------- apply
+    def _apply_impl(self, own: SortedSideState, other: SortedSideState,
+                    errs: jnp.ndarray, chunk: StreamChunk, wm_own, side: int):
+        """Probe `other`, emit matches (+ outer-join NULL rows and degree
+        transitions), evict+update `own` in one program.
+
+        Returns (own', other_degree', out_cols, out_ops, out_vis, errs',
+        n_own). Output rows are laid out in up to three segments:
+        [0, M)       inner matches
+        [M, 2M)      other-side NULL-row transitions   (outer only)
+        [2M, 2M+N)   own-side unmatched NULL rows      (own outer only)
+        """
+        key_idx = self.key_indices[side]
+        pk_idx = self.pk_indices_side[side]
+        N = chunk.capacity
+        C = own.capacity
+        Co = other.capacity
+        M = self.match_factor * N
+        append_only = self.append_only[side]
+
+        key_cols = [chunk.columns[i].data for i in key_idx]
+        key_valid = jnp.ones(N, dtype=bool)
+        for i in key_idx:
+            key_valid &= chunk.columns[i].valid_mask()
+        active = chunk.vis & key_valid               # NULL keys never join
+        signs = op_sign(chunk.ops)
+        row_ids = jnp.arange(N, dtype=jnp.int32)
+        h = key_hash(key_cols)
+
+        # ---- within-chunk pk-run netting (hash_join.py:272 semantics) ----
+        if append_only:
+            is_ins = active
+            is_del = jnp.zeros(N, dtype=bool)
+        else:
+            sort_keys = [row_ids]
+            for p in pk_idx:
+                sort_keys.append(chunk.columns[p].data)
+            sort_keys.append(~active)
+            order = stable_lexsort(tuple(sort_keys))
+            s_act = active[order]
+            same = s_act[1:] & s_act[:-1]
+            for p in pk_idx:
+                d = chunk.columns[p].data[order]
+                same = same & (d[1:] == d[:-1])
+            run_start = jnp.concatenate([jnp.array([True]), ~same])
+            run_end = jnp.concatenate([~same, jnp.array([True])])
+            s_signs = signs[order]
+            eff_del_s = run_start & (s_signs < 0) & s_act
+            eff_ins_s = run_end & (s_signs > 0) & s_act
+            is_del = jnp.zeros(N, dtype=bool).at[order].set(eff_del_s)
+            is_ins = jnp.zeros(N, dtype=bool).at[order].set(eff_ins_s)
+
+        # ---- probe the other side: contiguous hash ranges ----
+        lo = jnp.searchsorted(other.khash, h, side="left").astype(jnp.int32)
+        hi = jnp.searchsorted(other.khash, h, side="right").astype(jnp.int32)
+        # int64 offsets: a hot-key chunk's total candidate-match count can
+        # exceed 2^31 (120k-row key run probed by a 20k-row chunk); an int32
+        # cumsum would wrap negative and silently drop every match while
+        # the overflow counter read zero
+        lens = jnp.where(active, (hi - lo).astype(jnp.int64), 0)
+        offs = jnp.cumsum(lens)
+        total = offs[N - 1]
+        j = jnp.arange(M, dtype=jnp.int64)
+        src = jnp.searchsorted(offs, j, side="right").astype(jnp.int32)
+        srcc = jnp.clip(src, 0, N - 1)
+        prev = jnp.where(srcc > 0, offs[jnp.clip(srcc - 1, 0)], 0)
+        pos = jnp.clip(lo[srcc] + (j - prev), 0, Co - 1)
+        emit = (j < jnp.minimum(total, M)) & (pos < other.n)
+        # exact key equality (hash collisions rejected here)
+        for kc, oi in zip(key_cols, self.key_indices[1 - side]):
+            emit &= other.cols[oi][pos] == kc[srcc].astype(other.cols[oi].dtype)
+        n_match_overflow = jnp.maximum(total - M, 0)
+
+        # ---- match-segment assembly: own row (from chunk) ++ other row ----
+        own_cols = [Column(jnp.take(c.data, srcc, axis=0),
+                           jnp.take(c.valid_mask(), srcc, axis=0))
+                    for c in chunk.columns]
+        oth_cols = [Column(r[pos], v[pos])
+                    for r, v in zip(other.cols, other.valids)]
+        cols = own_cols + oth_cols if side == LEFT else oth_cols + own_cols
+        if self.condition is not None:
+            pred = self.condition.eval(cols)
+            emit &= pred.data.astype(bool) & pred.valid_mask()
+        ops_out = jnp.where(jnp.take(signs, srcc) > 0,
+                            OP_INSERT, OP_DELETE).astype(jnp.int8)
+
+        outer_own = self._outer[side]
+        outer_other = self._outer[1 - side]
+        any_outer = outer_own or outer_other
+        # condition-passing matches per chunk row (stored as the inserted
+        # row's initial degree; zero => own NULL-row emission when outer)
+        if any_outer:
+            match_cnt = jax.ops.segment_sum(
+                emit.astype(jnp.int32), srcc, num_segments=N)
+        else:
+            match_cnt = None
+
+        if outer_other or outer_own:
+            # signed degree delta onto the OTHER side's rows
+            d_sign = jnp.where(emit, jnp.take(signs, srcc), 0)
+            other_degree = other.degree.at[
+                jnp.where(emit, pos, Co)].add(d_sign, mode="drop")
+        else:
+            other_degree = other.degree
+
+        if outer_other:
+            # NET degree transitions on the other side -> NULL-row flips
+            touched = jnp.zeros(Co, dtype=bool).at[
+                jnp.where(emit, pos, Co)].set(True, mode="drop")
+            o_live = jnp.arange(Co, dtype=jnp.int32) < other.n
+            was0 = other.degree == 0
+            now0 = other_degree == 0
+            t_del = touched & o_live & was0 & ~now0   # retract NULL row
+            t_ins = touched & o_live & ~was0 & now0   # re-emit NULL row
+            t_any = t_del | t_ins
+            trank = jnp.cumsum(t_any.astype(jnp.int32)) - 1
+            # positions of transition rows compacted into a [M] buffer
+            tsel = jnp.zeros(M, dtype=jnp.int32).at[
+                jnp.where(t_any & (trank < M), trank, M)].set(
+                jnp.arange(Co, dtype=jnp.int32), mode="drop")
+            n_trans = jnp.sum(t_any.astype(jnp.int32))
+            t_vis = jnp.arange(M, dtype=jnp.int32) < jnp.minimum(n_trans, M)
+            t_ops = jnp.where(t_del[tsel], OP_DELETE, OP_INSERT).astype(
+                jnp.int8)
+            n_match_overflow = n_match_overflow + jnp.maximum(n_trans - M, 0)
+        else:
+            tsel = t_vis = t_ops = None
+
+        if outer_own:
+            # own rows with no condition-passing match (incl. NULL keys)
+            zerom = (active & (match_cnt == 0)) | (chunk.vis & ~key_valid)
+            z_ops = jnp.where(signs > 0, OP_INSERT, OP_DELETE).astype(
+                jnp.int8)
+        else:
+            zerom = z_ops = None
+
+        if any_outer:
+            # full output: [M matches][M transitions][N own-unmatched]
+            def seg_col(match_c: Column, oth_row=None, oth_valid=None,
+                        own_chunk_col=None, own_side_seg=True):
+                parts_d = [match_c.data]
+                parts_v = [match_c.valid_mask()]
+                if outer_other:
+                    if own_side_seg:       # own-side columns: NULL padding
+                        parts_d.append(jnp.zeros(M, dtype=match_c.data.dtype))
+                        parts_v.append(jnp.zeros(M, dtype=bool))
+                    else:                  # other-side columns: real values
+                        parts_d.append(oth_row[tsel])
+                        parts_v.append(oth_valid[tsel])
+                if outer_own:
+                    if own_side_seg:       # own columns: chunk values
+                        parts_d.append(own_chunk_col.data)
+                        parts_v.append(own_chunk_col.valid_mask())
+                    else:                  # other columns: NULL padding
+                        parts_d.append(jnp.zeros(N, dtype=match_c.data.dtype))
+                        parts_v.append(jnp.zeros(N, dtype=bool))
+                return Column(jnp.concatenate(parts_d),
+                              jnp.concatenate(parts_v))
+
+            own_full = [seg_col(mc, own_chunk_col=cc, own_side_seg=True)
+                        for mc, cc in zip(own_cols, chunk.columns)]
+            oth_full = [seg_col(mc, oth_row=r, oth_valid=v,
+                                own_side_seg=False)
+                        for mc, r, v in zip(oth_cols, other.cols,
+                                            other.valids)]
+            cols = (own_full + oth_full if side == LEFT
+                    else oth_full + own_full)
+            ops_parts = [ops_out]
+            vis_parts = [emit]
+            if outer_other:
+                ops_parts.append(t_ops)
+                vis_parts.append(t_vis)
+            if outer_own:
+                ops_parts.append(z_ops)
+                vis_parts.append(zerom)
+            ops_out = jnp.concatenate(ops_parts)
+            emit = jnp.concatenate(vis_parts)
+
+        # ---- own-side update: evict + delete + merge-insert ----
+        live = jnp.arange(C, dtype=jnp.int32) < own.n
+        if self.clean_cols[side] is not None:
+            cc = self.clean_cols[side]
+            keep = live & ~(own.cols[cc] < wm_own)
+        else:
+            keep = live
+
+        if not append_only:
+            dlo = jnp.searchsorted(own.khash, h, side="left").astype(jnp.int32)
+            dhi = jnp.searchsorted(own.khash, h, side="right").astype(jnp.int32)
+            dlens = jnp.where(is_del, (dhi - dlo).astype(jnp.int64), 0)
+            doffs = jnp.cumsum(dlens)
+            dtot = doffs[N - 1]
+            dsrc = jnp.searchsorted(doffs, j, side="right").astype(jnp.int32)
+            dsrcc = jnp.clip(dsrc, 0, N - 1)
+            dprev = jnp.where(dsrcc > 0, doffs[jnp.clip(dsrcc - 1, 0)], 0)
+            dpos = jnp.clip(dlo[dsrcc] + (j - dprev), 0, C - 1)
+            cand = (j < jnp.minimum(dtot, M)) & keep[dpos]
+            for kc, ki in zip(key_cols, key_idx):
+                cand &= own.cols[ki][dpos] == kc[dsrcc].astype(own.cols[ki].dtype)
+            for p in pk_idx:
+                cand &= (own.cols[p][dpos]
+                         == chunk.columns[p].data[dsrcc].astype(own.cols[p].dtype))
+            # one victim per retraction: the lowest matching state pos
+            victim = jnp.full(N, C, dtype=jnp.int32).at[
+                jnp.where(cand, dsrcc, N)].min(dpos, mode="drop")
+            found = victim < C
+            keep = keep.at[jnp.where(found, victim, C)].set(False, mode="drop")
+            n_del_miss = jnp.sum((is_del & ~found).astype(jnp.int32))
+        else:
+            n_del_miss = jnp.int32(0)
+
+        # merge: kept state rows + new rows, both in hash order
+        ins_h = jnp.where(is_ins, h, _HSENTINEL)
+        iorder = jnp.argsort(ins_h, stable=True)          # new rows first
+        nh = ins_h[iorder]                                 # [N] sorted
+        n_new = jnp.sum(is_ins.astype(jnp.int32))
+        dead_cum = jnp.cumsum((~keep).astype(jnp.int32))
+        kept_rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        n_kept = kept_rank[C - 1] + 1
+        # state row t -> kept_rank + (# new rows with hash < khash[t])
+        new_lt = jnp.searchsorted(nh, own.khash, side="left").astype(jnp.int32)
+        pos_t = kept_rank + new_lt
+        # new row r -> r + (# kept state rows with hash <= nh[r])
+        kept_le = _count_le(own.khash, dead_cum, nh, side="right")
+        rr = jnp.arange(N, dtype=jnp.int32)
+        pos_r = rr + kept_le
+        new_ok = rr < n_new
+        n_after = n_kept + n_new
+        n_row_overflow = jnp.maximum(n_after - C, 0)
+        n_after = jnp.minimum(n_after, C)
+
+        tgt_t = jnp.where(keep & (pos_t < C), pos_t, C)
+        tgt_r = jnp.where(new_ok & (pos_r < C), pos_r, C)
+        new_khash = jnp.full(C, _HSENTINEL, dtype=jnp.int64)
+        new_khash = new_khash.at[tgt_t].set(own.khash, mode="drop")
+        new_khash = new_khash.at[tgt_r].set(nh, mode="drop")
+        out_cols = []
+        out_valids = []
+        for ci, (sc, sv) in enumerate(zip(own.cols, own.valids)):
+            col = chunk.columns[ci]
+            c2 = jnp.zeros(C, dtype=sc.dtype).at[tgt_t].set(sc, mode="drop")
+            c2 = c2.at[tgt_r].set(col.data[iorder].astype(sc.dtype), mode="drop")
+            v2 = jnp.zeros(C, dtype=bool).at[tgt_t].set(sv, mode="drop")
+            v2 = v2.at[tgt_r].set(col.valid_mask()[iorder], mode="drop")
+            out_cols.append(c2)
+            out_valids.append(v2)
+        degree = jnp.zeros(C, dtype=jnp.int32).at[tgt_t].set(
+            own.degree, mode="drop")
+        if any_outer:
+            degree = degree.at[tgt_r].set(match_cnt[iorder], mode="drop")
+        own2 = SortedSideState(new_khash, tuple(out_cols), tuple(out_valids),
+                               degree, n_after.astype(jnp.int32))
+        errs = errs + jnp.stack(
+            [n_match_overflow, n_del_miss, n_row_overflow]).astype(jnp.int32)
+        return own2, other_degree, tuple(cols), ops_out, emit, errs, own2.n
+
+    # ------------------------------------------------------------- evict
+    def _evict_impl(self, own: SortedSideState, wm, side: int):
+        """Barrier-time eviction for a side that saw no chunks (the apply
+        path evicts inline)."""
+        C = own.capacity
+        cc = self.clean_cols[side]
+        live = jnp.arange(C, dtype=jnp.int32) < own.n
+        keep = live & ~(own.cols[cc] < wm)
+        rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        tgt = jnp.where(keep, rank, C)
+        kh = jnp.full(C, _HSENTINEL, dtype=jnp.int64).at[tgt].set(
+            own.khash, mode="drop")
+        cols = tuple(jnp.zeros(C, dtype=c.dtype).at[tgt].set(c, mode="drop")
+                     for c in own.cols)
+        valids = tuple(jnp.zeros(C, dtype=bool).at[tgt].set(v, mode="drop")
+                       for v in own.valids)
+        degree = jnp.zeros(C, dtype=jnp.int32).at[tgt].set(own.degree,
+                                                           mode="drop")
+        n2 = jnp.sum(keep.astype(jnp.int32))
+        return SortedSideState(kh, cols, valids, degree, n2)
+
+    # --------------------------------------------------------- watchdog
+    def _check_watchdog(self) -> None:
+        vals = np.asarray(self._watchdog_pack(
+            self._errs_dev, self._n_dev[LEFT], self._n_dev[RIGHT]))
+        n_mo, n_miss, n_ro = (int(x) for x in vals[:3])
+        if n_mo:
+            raise RuntimeError(
+                f"sorted-join match-buffer overflow ({n_mo} matches "
+                f"dropped; raise match_factor)")
+        if n_ro:
+            raise RuntimeError(
+                f"sorted-join state overflow ({n_ro} rows dropped; "
+                f"capacity {self.capacity})")
+        if n_miss:
+            raise RuntimeError(
+                f"sorted-join changelog inconsistency: {n_miss} deletes "
+                f"matched no stored row")
+
+    # ----------------------------------------------------------- stream
+    async def execute(self):
+        first = True
+        async for kind, s, msg in barrier_align(*self.inputs):
+            if kind == "chunk":
+                wm = jnp.int64(self._pending_clean[s])
+                (self.sides[s], oth_degree, cols, ops, vis, self._errs_dev,
+                 self._n_dev[s]) = self._apply(
+                    self.sides[s], self.sides[1 - s], self._errs_dev, msg,
+                    wm, side=s)
+                o = self.sides[1 - s]
+                self.sides[1 - s] = SortedSideState(
+                    o.khash, o.cols, o.valids, oth_degree, o.n)
+                self._dirty[s] = True
+                yield StreamChunk(
+                    tuple(cols[i] for i in self.output_indices), ops, vis,
+                    self.schema)
+            elif kind == "barrier":
+                barrier: Barrier = msg
+                if first or barrier.kind is BarrierKind.INITIAL:
+                    first = False
+                    yield barrier
+                    continue
+                stopping = barrier.mutation is not None and barrier.is_stop_any()
+                dirty_any = any(self._dirty)
+                # idle sides still clean by watermark at barriers
+                for s2 in (LEFT, RIGHT):
+                    if (self.clean_cols[s2] is not None
+                            and self._pending_clean[s2] != NO_WATERMARK
+                            and not self._dirty[s2]):
+                        self.sides[s2] = self._evict(
+                            self.sides[s2],
+                            jnp.int64(self._pending_clean[s2]), side=s2)
+                    self._dirty[s2] = False
+                if self.watchdog_interval and (stopping or dirty_any):
+                    self._check_watchdog()
+                yield barrier
+            else:
+                wm: Watermark = msg
+                if self.clean_cols[s] is not None and wm.col_idx == self.clean_cols[s]:
+                    self._pending_clean[s] = wm.val
+                if wm.col_idx in self.key_indices[s]:
+                    kpos = self.key_indices[s].index(wm.col_idx)
+                    self._key_wms[s][kpos] = wm.val
+                    other_wm = self._key_wms[1 - s].get(kpos)
+                    if other_wm is not None:
+                        val = min(wm.val, other_wm)
+                        if self._emitted_key_wm.get(kpos) != val:
+                            self._emitted_key_wm[kpos] = val
+                            n_left = len(self.inputs[LEFT].schema)
+                            for full_idx in (self.key_indices[LEFT][kpos],
+                                             n_left + self.key_indices[RIGHT][kpos]):
+                                if full_idx in self.output_indices:
+                                    yield Watermark(
+                                        self.output_indices.index(full_idx),
+                                        wm.data_type, val)

@@ -1,0 +1,476 @@
+"""SortedJoinExecutor: changelog semantics vs a golden model AND a
+differential run against HashJoinExecutor on identical scripted inputs.
+
+The sorted join must be behaviorally indistinguishable from the chained
+hash join (reference semantics: hash_join.rs into_stream) — same multiset
+of emitted change rows for any interleaving of inserts/deletes/update
+pairs, NULL keys, and watermark cleaning.
+"""
+
+import asyncio
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.common import DataType, schema
+from risingwave_tpu.common.chunk import (
+    OP_DELETE, OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT, StreamChunk,
+)
+from risingwave_tpu.common.epoch import EpochPair
+from risingwave_tpu.stream import Barrier, BarrierKind, Watermark
+from risingwave_tpu.stream.executor import Executor
+from risingwave_tpu.stream.hash_join import HashJoinExecutor
+from risingwave_tpu.stream.sorted_join import SortedJoinExecutor
+
+L_SCHEMA = schema(("k", DataType.INT64), ("lv", DataType.INT64))
+R_SCHEMA = schema(("k", DataType.INT64), ("rv", DataType.INT64))
+
+
+class ScriptSource(Executor):
+    def __init__(self, sch, messages):
+        self.schema = sch
+        self.messages = messages
+        self.identity = "ScriptSource"
+
+    async def execute(self):
+        for m in self.messages:
+            yield m
+            await asyncio.sleep(0)
+
+
+def chunk(sch, rows, cap=16):
+    ops = np.asarray([r[0] for r in rows], dtype=np.int8)
+    cols = [np.asarray([r[1 + i] for r in rows], dtype=np.int64)
+            for i in range(len(sch))]
+    return StreamChunk.from_numpy(sch, cols, ops=ops, capacity=cap)
+
+
+def barrier(curr, prev, kind=BarrierKind.CHECKPOINT):
+    return Barrier(EpochPair(curr, prev), kind)
+
+
+async def run_sorted(l_msgs, r_msgs, **kw):
+    kw.setdefault("capacity", 64)
+    join = SortedJoinExecutor(
+        ScriptSource(L_SCHEMA, l_msgs), ScriptSource(R_SCHEMA, r_msgs),
+        left_key_indices=[0], right_key_indices=[0],
+        left_pk_indices=[1], right_pk_indices=[1], **kw)
+    out = []
+    async for m in join.execute():
+        out.append(m)
+    return join, out
+
+
+def changelog_counter(out):
+    """Multiset of (sign, row) over all emitted chunks — op-pair encoding
+    degrades to Delete/Insert in both joins, so compare by sign."""
+    c = Counter()
+    for m in out:
+        if isinstance(m, StreamChunk):
+            for op, vals in m.to_rows():
+                sign = 1 if op in (OP_INSERT, OP_UPDATE_INSERT) else -1
+                c[(sign, vals)] += 1
+    return c
+
+
+def test_inner_join_basic():
+    async def go():
+        l = [barrier(1, 0, BarrierKind.INITIAL),
+             chunk(L_SCHEMA, [(OP_INSERT, 1, 10), (OP_INSERT, 2, 20)]),
+             barrier(2, 1)]
+        r = [barrier(1, 0, BarrierKind.INITIAL),
+             chunk(R_SCHEMA, [(OP_INSERT, 1, 100), (OP_INSERT, 3, 300)]),
+             barrier(2, 1)]
+        _, out = await run_sorted(l, r)
+        got = changelog_counter(out)
+        assert got == Counter({(1, (1, 10, 1, 100)): 1})
+    asyncio.run(go())
+
+
+def test_retraction_and_update_pair():
+    async def go():
+        l = [barrier(1, 0, BarrierKind.INITIAL),
+             chunk(L_SCHEMA, [(OP_INSERT, 1, 10)]),
+             barrier(2, 1),
+             chunk(L_SCHEMA, [(OP_UPDATE_DELETE, 1, 10),
+                              (OP_UPDATE_INSERT, 1, 11)]),
+             chunk(L_SCHEMA, [(OP_DELETE, 1, 11)]),
+             barrier(3, 2)]
+        r = [barrier(1, 0, BarrierKind.INITIAL),
+             chunk(R_SCHEMA, [(OP_INSERT, 1, 100)]),
+             barrier(2, 1),
+             barrier(3, 2)]
+        _, out = await run_sorted(l, r)
+        got = changelog_counter(out)
+        # insert 10 -> +, retract 10 -> -, insert 11 -> +, delete 11 -> -
+        assert got == Counter({
+            (1, (1, 10, 1, 100)): 1, (-1, (1, 10, 1, 100)): 1,
+            (1, (1, 11, 1, 100)): 1, (-1, (1, 11, 1, 100)): 1,
+        })
+    asyncio.run(go())
+
+
+def test_null_keys_never_match():
+    async def go():
+        lcols = [np.asarray([1, 1], dtype=np.int64),
+                 np.asarray([10, 11], dtype=np.int64)]
+        lc = StreamChunk.from_numpy(
+            L_SCHEMA, lcols, ops=np.zeros(2, dtype=np.int8), capacity=16,
+            valids=[np.asarray([True, False]), None])
+        l = [barrier(1, 0, BarrierKind.INITIAL), lc, barrier(2, 1)]
+        r = [barrier(1, 0, BarrierKind.INITIAL),
+             chunk(R_SCHEMA, [(OP_INSERT, 1, 100)]),
+             barrier(2, 1)]
+        _, out = await run_sorted(l, r)
+        got = changelog_counter(out)
+        assert got == Counter({(1, (1, 10, 1, 100)): 1})
+    asyncio.run(go())
+
+
+def test_within_chunk_update_pair_same_key():
+    async def go():
+        l = [barrier(1, 0, BarrierKind.INITIAL),
+             chunk(L_SCHEMA, [(OP_INSERT, 7, 1)]),
+             barrier(2, 1)]
+        r = [barrier(1, 0, BarrierKind.INITIAL),
+             chunk(R_SCHEMA, [(OP_INSERT, 7, 50)]),
+             barrier(2, 1),
+             chunk(R_SCHEMA, [(OP_UPDATE_DELETE, 7, 50),
+                              (OP_UPDATE_INSERT, 7, 51)]),
+             barrier(3, 2)]
+        _, out = await run_sorted(l, r)
+        got = changelog_counter(out)
+        assert got == Counter({
+            (1, (7, 1, 7, 50)): 1, (-1, (7, 1, 7, 50)): 1,
+            (1, (7, 1, 7, 51)): 1,
+        })
+    asyncio.run(go())
+
+
+def test_watermark_eviction_inline():
+    """Rows below the clean watermark must be evicted by the NEXT apply on
+    that side (not only at barriers) — the property that removes the
+    epoch-churn capacity cap."""
+    async def go():
+        l = [barrier(1, 0, BarrierKind.INITIAL),
+             chunk(L_SCHEMA, [(OP_INSERT, 1, 10)]),
+             Watermark(1, DataType.INT64, 1000),   # evict lv < 1000
+             chunk(L_SCHEMA, [(OP_INSERT, 2, 2000)]),
+             barrier(2, 1)]
+        r = [barrier(1, 0, BarrierKind.INITIAL),
+             barrier(2, 1),
+             chunk(R_SCHEMA, [(OP_INSERT, 1, 100), (OP_INSERT, 2, 200)]),
+             barrier(3, 2)]
+        l += [barrier(3, 2)]
+        join, out = await run_sorted(
+            l, r, clean_watermark_cols=(1, None))
+        got = changelog_counter(out)
+        # (1, 10) was evicted before the right chunk probed: only (2,2000)
+        assert got == Counter({(1, (2, 2000, 2, 200)): 1})
+        assert int(np.asarray(join.sides[0].n)) == 1
+    asyncio.run(go())
+
+
+def test_differential_vs_hash_join_random():
+    """Randomized differential test: identical scripted message streams
+    through SortedJoinExecutor and HashJoinExecutor must yield identical
+    changelog multisets."""
+    rng = np.random.default_rng(7)
+    live = [dict(), dict()]   # pk -> key, per side
+    next_pk = [0, 1_000_000]
+
+    def random_chunk(side):
+        sch = L_SCHEMA if side == 0 else R_SCHEMA
+        rows = []
+        for _ in range(int(rng.integers(1, 8))):
+            if live[side] and rng.random() < 0.35:
+                pk = int(rng.choice(list(live[side].keys())))
+                k = live[side].pop(pk)
+                rows.append((OP_DELETE, k, pk))
+            else:
+                k = int(rng.integers(0, 6))
+                pk = next_pk[side]
+                next_pk[side] += 1
+                live[side][pk] = k
+                rows.append((OP_INSERT, k, pk))
+        return chunk(sch, rows)
+
+    msgs = [[barrier(1, 0, BarrierKind.INITIAL)],
+            [barrier(1, 0, BarrierKind.INITIAL)]]
+    epoch = 2
+    for _ in range(12):
+        for side in (0, 1):
+            for _ in range(int(rng.integers(1, 3))):
+                msgs[side].append(random_chunk(side))
+        msgs[0].append(barrier(epoch, epoch - 1))
+        msgs[1].append(barrier(epoch, epoch - 1))
+        epoch += 1
+
+    def net(counter):
+        """barrier_align interleaves the two sides nondeterministically, and
+        different interleavings legitimately differ in transient +/- pairs —
+        the interleaving-independent invariant is the NET changelog."""
+        acc = Counter()
+        for (sign, row), cnt in counter.items():
+            acc[row] += sign * cnt
+        return {r: c for r, c in acc.items() if c}
+
+    async def go():
+        _, out_s = await run_sorted(list(msgs[0]), list(msgs[1]),
+                                    capacity=256)
+        hj = HashJoinExecutor(
+            ScriptSource(L_SCHEMA, list(msgs[0])),
+            ScriptSource(R_SCHEMA, list(msgs[1])),
+            left_key_indices=[0], right_key_indices=[0],
+            left_pk_indices=[1], right_pk_indices=[1],
+            key_capacity=256, row_capacity=256)
+        out_h = []
+        async for m in hj.execute():
+            out_h.append(m)
+        assert net(changelog_counter(out_s)) == net(changelog_counter(out_h))
+        # every delete must retract a prior insert (no negative prefix)
+        assert all(c > 0 for c in net(changelog_counter(out_s)).values())
+    asyncio.run(go())
+
+
+def test_differential_lockstep_apply():
+    """Deterministic differential: apply the SAME chunk sequence directly
+    through both joins' _apply (no async interleaving) — per-chunk outputs
+    and live state multisets must match exactly."""
+    import jax.numpy as jnp
+    from risingwave_tpu.stream.sorted_join import NO_WATERMARK
+
+    rng = np.random.default_rng(11)
+    live = [dict(), dict()]
+    next_pk = [0, 1_000_000]
+
+    def random_chunk(side):
+        sch = L_SCHEMA if side == 0 else R_SCHEMA
+        rows = []
+        for _ in range(int(rng.integers(1, 8))):
+            if live[side] and rng.random() < 0.4:
+                pk = int(rng.choice(list(live[side].keys())))
+                k = live[side].pop(pk)
+                rows.append((OP_DELETE, k, pk))
+            else:
+                k = int(rng.integers(0, 6))
+                pk = next_pk[side]
+                next_pk[side] += 1
+                live[side][pk] = k
+                rows.append((OP_INSERT, k, pk))
+        return chunk(sch, rows)
+
+    seq = []
+    for _ in range(40):
+        s = int(rng.integers(0, 2))
+        seq.append((s, random_chunk(s)))
+
+    sj = SortedJoinExecutor(
+        ScriptSource(L_SCHEMA, []), ScriptSource(R_SCHEMA, []),
+        left_key_indices=[0], right_key_indices=[0],
+        left_pk_indices=[1], right_pk_indices=[1], capacity=256)
+    hj = HashJoinExecutor(
+        ScriptSource(L_SCHEMA, []), ScriptSource(R_SCHEMA, []),
+        left_key_indices=[0], right_key_indices=[0],
+        left_pk_indices=[1], right_pk_indices=[1],
+        key_capacity=256, row_capacity=256)
+
+    def sj_live(s):
+        st = sj.sides[s]
+        n = int(np.asarray(st.n))
+        c0, c1 = np.asarray(st.cols[0]), np.asarray(st.cols[1])
+        return Counter((int(c0[i]), int(c1[i])) for i in range(n))
+
+    def hj_live(s):
+        st = hj.sides[s]
+        liv = np.asarray(st.live)
+        r0, r1 = np.asarray(st.rows[0]), np.asarray(st.rows[1])
+        return Counter((int(r0[i]), int(r1[i])) for i in np.flatnonzero(liv))
+
+    wm = jnp.int64(NO_WATERMARK)
+    for side, c in seq:
+        (sj.sides[side], _od, cols_s, ops_s, vis_s, sj._errs_dev, _) = sj._apply(
+            sj.sides[side], sj.sides[1 - side], sj._errs_dev, c, wm,
+            side=side)
+        out_s = StreamChunk(tuple(cols_s[i] for i in sj.output_indices),
+                            ops_s, vis_s, sj.schema)
+        (hj.sides[side], cols_h, ops_h, vis_h, hj._errs_dev, _, _) = hj._apply(
+            hj.sides[side], hj.sides[1 - side], hj._errs_dev, c, side=side)
+        out_h = StreamChunk(tuple(cols_h[i] for i in hj.output_indices),
+                            ops_h, vis_h, hj.schema)
+        assert changelog_counter([out_s]) == changelog_counter([out_h])
+        assert sj_live(side) == hj_live(side)
+    assert int(np.asarray(sj._errs_dev).sum()) == 0
+
+
+def test_append_only_fast_path():
+    """append_only sides compile without the retraction machinery but
+    produce the same changelog."""
+    async def go():
+        l = [barrier(1, 0, BarrierKind.INITIAL),
+             chunk(L_SCHEMA, [(OP_INSERT, 1, 10), (OP_INSERT, 1, 11),
+                              (OP_INSERT, 2, 20)]),
+             barrier(2, 1)]
+        r = [barrier(1, 0, BarrierKind.INITIAL),
+             chunk(R_SCHEMA, [(OP_INSERT, 1, 100)]),
+             chunk(R_SCHEMA, [(OP_INSERT, 2, 200)]),
+             barrier(2, 1)]
+        _, out = await run_sorted(l, r, append_only=(True, True))
+        got = changelog_counter(out)
+        assert got == Counter({
+            (1, (1, 10, 1, 100)): 1, (1, (1, 11, 1, 100)): 1,
+            (1, (2, 20, 2, 200)): 1,
+        })
+    asyncio.run(go())
+
+
+def test_overflow_fail_stops():
+    async def go():
+        rows = [(OP_INSERT, i, i) for i in range(20)]
+        l = [barrier(1, 0, BarrierKind.INITIAL),
+             chunk(L_SCHEMA, rows, cap=32), barrier(2, 1)]
+        r = [barrier(1, 0, BarrierKind.INITIAL), barrier(2, 1)]
+        with pytest.raises(RuntimeError, match="state overflow"):
+            await run_sorted(l, r, capacity=16)
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------- outer joins
+
+def _mv_state(rows_by_pk):
+    return dict(rows_by_pk)
+
+
+def _golden_outer(events, join_type):
+    """Python model: final materialized LEFT/RIGHT/FULL join result from a
+    list of (side, op, key, pk) events. Returns multiset of output rows
+    (l_k, l_pk, r_k, r_pk) with None for NULL."""
+    live = [{}, {}]   # side -> pk -> key
+    for side, op, k, pk in events:
+        if op == OP_INSERT:
+            live[side][pk] = k
+        else:
+            live[side].pop(pk, None)
+    out = Counter()
+    matched_r = set()
+    for lpk, lk in live[0].items():
+        ms = [(rpk, rk) for rpk, rk in live[1].items() if rk == lk]
+        if ms:
+            for rpk, rk in ms:
+                out[(lk, lpk, rk, rpk)] += 1
+                matched_r.add(rpk)
+        elif join_type in ("left", "full"):
+            out[(lk, lpk, None, None)] += 1
+    if join_type in ("right", "full"):
+        for rpk, rk in live[1].items():
+            if not any(lk == rk for lk in live[0].values()):
+                out[(None, None, rk, rpk)] += 1
+    return out
+
+
+def _accumulate(out):
+    """Net changelog -> final row multiset, decoding NULLs via validity."""
+    acc = Counter()
+    for m in out:
+        if not isinstance(m, StreamChunk):
+            continue
+        vis = np.asarray(m.vis)
+        ops = np.asarray(m.ops)[vis]
+        data = [np.asarray(c.data)[vis] for c in m.columns]
+        valid = [np.asarray(c.valid_mask())[vis] for c in m.columns]
+        for r in range(len(ops)):
+            row = tuple(int(d[r]) if v[r] else None
+                        for d, v in zip(data, valid))
+            sign = 1 if ops[r] in (OP_INSERT, OP_UPDATE_INSERT) else -1
+            acc[row] += sign
+    return Counter({k: v for k, v in acc.items() if v})
+
+
+def _run_outer(events, join_type, n_epochs=4):
+    """Split events into epochs, run the executor, compare final result."""
+    msgs = [[barrier(1, 0, BarrierKind.INITIAL)],
+            [barrier(1, 0, BarrierKind.INITIAL)]]
+    per = max(1, len(events) // n_epochs)
+    epoch = 2
+    for i in range(0, len(events), per):
+        batch = events[i:i + per]
+        for side in (0, 1):
+            rows = [(op, k, pk) for s, op, k, pk in batch if s == side]
+            if rows:
+                msgs[side].append(chunk(L_SCHEMA if side == 0 else R_SCHEMA,
+                                        rows))
+        msgs[0].append(barrier(epoch, epoch - 1))
+        msgs[1].append(barrier(epoch, epoch - 1))
+        epoch += 1
+
+    async def go():
+        _, out = await run_sorted(list(msgs[0]), list(msgs[1]),
+                                  capacity=256, join_type=join_type,
+                                  match_factor=16)
+        return out
+    out = asyncio.run(go())
+    assert _accumulate(out) == _golden_outer(events, join_type), \
+        f"{join_type} mismatch"
+
+
+def test_left_outer_basic_transitions():
+    events = [
+        (0, OP_INSERT, 1, 10),     # left 1 unmatched -> (1,10,NULL)
+        (1, OP_INSERT, 1, 100),    # match -> retract NULL, emit (1,10,1,100)
+        (1, OP_DELETE, 1, 100),    # unmatch -> back to (1,10,NULL)
+        (1, OP_INSERT, 2, 200),    # right 2 has no left: nothing (left join)
+    ]
+    _run_outer(events, "left")
+
+
+def test_right_and_full_outer():
+    events = [
+        (0, OP_INSERT, 1, 10),
+        (1, OP_INSERT, 2, 200),
+        (0, OP_INSERT, 2, 20),
+        (1, OP_INSERT, 1, 100),
+        (0, OP_DELETE, 1, 10),
+    ]
+    _run_outer(events, "right")
+    _run_outer(events, "full")
+
+
+def test_outer_null_keys_emit_padded():
+    async def go():
+        lcols = [np.asarray([5, 7], dtype=np.int64),
+                 np.asarray([50, 70], dtype=np.int64)]
+        lc = StreamChunk.from_numpy(
+            L_SCHEMA, lcols, ops=np.zeros(2, dtype=np.int8), capacity=16,
+            valids=[np.asarray([False, True]), None])
+        l = [barrier(1, 0, BarrierKind.INITIAL), lc, barrier(2, 1)]
+        r = [barrier(1, 0, BarrierKind.INITIAL),
+             chunk(R_SCHEMA, [(OP_INSERT, 7, 700)]),
+             barrier(2, 1)]
+        _, out = await run_sorted(l, r, join_type="left")
+        return out
+    out = asyncio.run(go())
+    # NULL-key left row emits (NULL, 50, NULL, NULL); key-7 row matches
+    assert _accumulate(out) == Counter({
+        (None, 50, None, None): 1, (7, 70, 7, 700): 1})
+
+
+def test_outer_randomized_golden():
+    rng = np.random.default_rng(23)
+    for join_type in ("left", "right", "full"):
+        live = [dict(), dict()]
+        next_pk = [0, 1_000_000]
+        events = []
+        for _ in range(120):
+            side = int(rng.integers(0, 2))
+            if live[side] and rng.random() < 0.35:
+                pk = int(rng.choice(list(live[side].keys())))
+                k = live[side].pop(pk)
+                events.append((side, OP_DELETE, k, pk))
+            else:
+                k = int(rng.integers(0, 5))
+                pk = next_pk[side]
+                next_pk[side] += 1
+                live[side][pk] = k
+                events.append((side, OP_INSERT, k, pk))
+        _run_outer(events, join_type, n_epochs=10)
