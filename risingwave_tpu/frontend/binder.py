@@ -171,6 +171,24 @@ class StreamPlanner:
         if isinstance(rel, ast.JoinRel):
             lf, ls = self.plan_rel(rel.left)
             rf, rs = self.plan_rel(rel.right)
+            # Join-state pk must be a REAL stream key: using all side
+            # columns would collapse two identical input rows into one pk
+            # (losing multiplicity / tripping the delete-miss fail-stop).
+            # The reference derives the stream key from the upstream
+            # (row_id for keyless streams, stream_key in plan_base); plan a
+            # row_id_gen below each join input and key the join state by
+            # its serial column (ADVICE r2 #5).
+            from ..common.types import Field
+
+            def with_row_id(fid_, scope_):
+                frag_ = self.graph.fragments[fid_]
+                frag_.root = Node("row_id_gen", {}, inputs=(frag_.root,))
+                sch = Schema(tuple(scope_.schema)
+                             + (Field("_row_id", DataType.SERIAL),))
+                return Scope(sch, dict(scope_.names))
+
+            ls = with_row_id(lf, ls)
+            rs = with_row_id(rf, rs)
             jscope = Scope.join(ls, rs)
             lkeys, rkeys, residue = [], [], []
             for conj in split_conjuncts(rel.on):
@@ -190,8 +208,8 @@ class StreamPlanner:
                 cond = bind_scalar(e, jscope)
             node = Node("hash_join", dict(
                 left_key_indices=lkeys, right_key_indices=rkeys,
-                left_pk_indices=list(range(len(ls.schema))),
-                right_pk_indices=list(range(len(rs.schema))),
+                left_pk_indices=[len(ls.schema) - 1],
+                right_pk_indices=[len(rs.schema) - 1],
                 condition=cond, match_factor=64),
                 inputs=(Exchange(lf), Exchange(rf)))
             f = self.graph.add(Fragment(self.fid(), node,

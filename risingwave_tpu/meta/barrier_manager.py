@@ -48,6 +48,11 @@ class BarrierCoordinator:
         self.committed_epochs: list[int] = []
         self._stopped = False
         self._failure: Optional[tuple] = None
+        # Serializes whole ROUNDS (inject..collect) across concurrent
+        # callers: the REPL's \tick / DDL bring-up can otherwise interleave
+        # with the background ticker on the same coordinator, breaking the
+        # in-order epoch completion contract (ADVICE r2 #1).
+        self._rounds_lock = asyncio.Lock()
         # headline health metric (reference meta_barrier_latency,
         # grafana/risingwave-dev-dashboard.dashboard.py:894)
         from ..utils.metrics import GLOBAL_METRICS
@@ -123,21 +128,24 @@ class BarrierCoordinator:
         cadence — a mid-stream Initial would skip syncing the previous epoch.
         interval_s=None => as fast as collection allows (bench mode);
         otherwise paced like the reference's 1s default."""
-        if not self._started:
-            self._started = True
-            b = await self.inject_barrier(kind=BarrierKind.INITIAL)
-            await self.wait_collected(b)
-        for _ in range(n):
-            if interval_s:
-                await asyncio.sleep(interval_s)
-            b = await self.inject_barrier()
-            await self.wait_collected(b)
+        async with self._rounds_lock:
+            if not self._started:
+                self._started = True
+                b = await self.inject_barrier(kind=BarrierKind.INITIAL)
+                await self.wait_collected(b)
+            for _ in range(n):
+                if interval_s:
+                    await asyncio.sleep(interval_s)
+                b = await self.inject_barrier()
+                await self.wait_collected(b)
 
     async def stop_all(self, actor_ids: Optional[set[int]] = None) -> None:
         from ..stream.message import StopMutation
-        ids = frozenset(actor_ids if actor_ids is not None else self.actor_ids)
-        b = await self.inject_barrier(mutation=StopMutation(ids))
-        await self.wait_collected(b)
+        async with self._rounds_lock:
+            ids = frozenset(actor_ids if actor_ids is not None
+                            else self.actor_ids)
+            b = await self.inject_barrier(mutation=StopMutation(ids))
+            await self.wait_collected(b)
 
     # -------------------------------------------------------------- metrics
     def barrier_latency_percentile(self, p: float) -> float:

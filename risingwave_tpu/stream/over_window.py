@@ -71,6 +71,9 @@ class OverWindowExecutor(StatefulUnaryExecutor):
             for i in self.partition_key_indices)
         self.table = HashTable.empty(capacity, self._key_dtypes)
         self.counts = jnp.zeros(capacity, dtype=jnp.int64)
+        # slots touched since the last persist — the delta to write at the
+        # barrier (sibling hash_agg persists only its flush view; ADVICE r2)
+        self.dirty = jnp.zeros(capacity, dtype=bool)
         self.agg_states = tuple(
             (spec.init_state((capacity,)) if spec is not None else None)
             for spec in self._specs)
@@ -82,7 +85,7 @@ class OverWindowExecutor(StatefulUnaryExecutor):
         return [self.counts] + super().fence_tokens()
 
     # --------------------------------------------------------- chunk step
-    def _apply_impl(self, table, counts, agg_states, errs,
+    def _apply_impl(self, table, counts, agg_states, dirty, errs,
                     chunk: StreamChunk):
         N = chunk.capacity
         C = self.capacity
@@ -163,9 +166,10 @@ class OverWindowExecutor(StatefulUnaryExecutor):
 
         counts2 = counts + jax.ops.segment_sum(
             ok.astype(jnp.int64), seg, C + 1)[:C]
+        dirty2 = dirty.at[jnp.where(ok, seg, C)].set(True, mode="drop")
         out_chunk = StreamChunk(tuple(out_cols), chunk.ops,
                                 chunk.vis & ok, self.schema)
-        return (table, counts2, tuple(new_agg_states),
+        return (table, counts2, tuple(new_agg_states), dirty2,
                 errs + n_un + n_viol, out_chunk)
 
     # -------------------------------------------------------------- hooks
@@ -177,9 +181,10 @@ class OverWindowExecutor(StatefulUnaryExecutor):
                 f"rows, capacity {self.capacity})")
 
     def on_chunk(self, chunk: StreamChunk) -> StreamChunk:
-        (self.table, self.counts, self.agg_states, self._errs_dev,
-         out) = self._apply(self.table, self.counts, self.agg_states,
-                            self._errs_dev, chunk)
+        (self.table, self.counts, self.agg_states, self.dirty,
+         self._errs_dev, out) = self._apply(
+            self.table, self.counts, self.agg_states, self.dirty,
+            self._errs_dev, chunk)
         self._dirty_persist = True
         return out
 
@@ -190,22 +195,19 @@ class OverWindowExecutor(StatefulUnaryExecutor):
             self.state_table.commit(barrier.epoch.curr)
             return
         self._dirty_persist = False
-        # snapshot the partition states (keys ++ count ++ agg states);
-        # dirty-slot delta persistence is the follow-up once partition
-        # counts warrant it (sibling hash_agg writes only its flush view)
-        occ = np.asarray(self.table.occupied)
-        idx = np.flatnonzero(occ)
+        # delta persistence: only slots touched since the last barrier are
+        # written, through the columnar batch path (state_table.rs:946)
+        idx = np.flatnonzero(np.asarray(self.dirty)
+                             & np.asarray(self.table.occupied))
         if idx.size:
-            keys = [np.asarray(k)[idx] for k in self.table.keys]
-            cnts = np.asarray(self.counts)[idx]
-            aggs = [np.asarray(s)[idx] for s in self.agg_states
-                    if s is not None]
-            rows = []
-            for r in range(idx.size):
-                row = tuple(k[r].item() for k in keys) + (int(cnts[r]),)
-                row += tuple(a[r].item() for a in aggs)
-                rows.append((int(OP_INSERT), row))
-            self.state_table.write_chunk_rows(rows)
+            cols = [np.asarray(k)[idx] for k in self.table.keys]
+            cols.append(np.asarray(self.counts)[idx])
+            cols += [np.asarray(s)[idx] for s in self.agg_states
+                     if s is not None]
+            self.state_table.write_chunk_columns(
+                np.full(idx.size, OP_INSERT, dtype=np.int8), cols,
+                np.ones(idx.size, dtype=bool))
+            self.dirty = jnp.zeros(self.capacity, dtype=bool)
         self.state_table.commit(barrier.epoch.curr)
 
     def recover_state(self, epoch: int) -> None:

@@ -64,7 +64,10 @@ class SourceExecutor(Executor):
         # executed: one token per emitted chunk bounds TOTAL pipeline depth.
         self.max_inflight_chunks = max_inflight_chunks
         self._tokens: deque = deque()
-        # reference stream_source_output_rows_counts (streaming_stats.rs:214)
+        # reference stream_source_output_rows_counts (streaming_stats.rs:214).
+        # Semantics: host-known emitted rows — exact when the connector
+        # exposes `last_chunk_rows`, padded chunk capacity otherwise (no
+        # per-chunk d2h sync is allowed to count device-visible rows).
         from ..utils.metrics import GLOBAL_METRICS
         self._rows_metric = GLOBAL_METRICS.counter(
             "stream_source_output_rows_counts", source_id=str(source_id))
@@ -136,19 +139,19 @@ class SourceExecutor(Executor):
             await self._acquire_credit()
             chunk = self.connector.next_chunk()
             self._tokens.append(chunk.columns[0].data)
-            # counted as padded capacity: visible-row counts need a d2h
-            # sync per chunk (forbidden in the steady state on tunneled
-            # TPUs) and generator chunks are always full; a connector with
-            # partial chunks overstates this series by its padding
-            self._rows_metric.inc(chunk.capacity)
+            # Visible rows come from HOST knowledge only: a d2h sync per
+            # chunk is forbidden in the steady state on tunneled TPUs. A
+            # connector that tracks its own fill exposes `last_chunk_rows`
+            # (generators fill every chunk, so capacity is exact for them);
+            # otherwise padded capacity is used, which OVER-counts partial
+            # chunks by their padding — the conservative direction for the
+            # rate limiter, and documented in the metric name below.
+            rows_host = getattr(self.connector, "last_chunk_rows", None)
+            if rows_host is None:
+                rows_host = chunk.capacity
+            self._rows_metric.inc(rows_host)
             if self.rate_limit is not None:
-                # padded capacity, NOT visible rows: counting visible rows
-                # is a per-chunk d2h sync, which poisons tunneled-TPU
-                # dispatch (the bench's honest-throughput rate limits made
-                # this the hot path). Connector chunks are full; partial
-                # chunks OVER-count by their padding, throttling early —
-                # the conservative direction for a limiter.
-                sent_this_interval += chunk.capacity
+                sent_this_interval += rows_host
             yield chunk
             if self.emit_watermarks:
                 wm = self.connector.current_watermark() - self.watermark_lag_us
