@@ -32,6 +32,11 @@ async def repl(args) -> None:
         print(f"durable state: {args.data} "
               f"(committed epoch {store.committed_epoch()})")
     session = Session(store=store)
+    if store is not None:
+        await session.recover()
+        if session.catalog.mvs:
+            print(f"recovered {len(session.catalog.sources)} source(s), "
+                  f"{len(session.catalog.mvs)} MV(s) from catalog")
 
     stop = asyncio.Event()
 
@@ -97,7 +102,7 @@ async def repl(args) -> None:
                 print(f"CREATE {kind} ok")
     stop.set()
     await tick_task
-    await session.drop_all()
+    await (session.shutdown() if args.data else session.drop_all())
     # the stdin executor thread may still be blocked in input(); a normal
     # interpreter exit would wait for it until the user presses Enter
     import os

@@ -106,11 +106,22 @@ def contains_agg(e) -> bool:
 
 
 @dataclass
+class RelInfo:
+    """Stream properties the reference tracks in plan_base: the STREAM KEY
+    (positions in the relation's output that uniquely identify a changelog
+    row — what retractions address) and append-only-ness."""
+
+    stream_key: Optional[tuple] = None      # None = keyless (needs row_id)
+    append_only: bool = True
+
+
+@dataclass
 class BoundPlan:
     graph: StreamGraph
     mv_fragment: int            # the fragment whose root will materialize
     schema: Schema
     pk_indices: tuple
+    append_only: bool = True
 
 
 class StreamPlanner:
@@ -125,19 +136,31 @@ class StreamPlanner:
         return f
 
     # ----------------------------------------------------------- relations
-    def plan_rel(self, rel) -> tuple[int, Scope]:
-        """Returns (fragment id, scope over its output)."""
+    def plan_rel(self, rel) -> tuple[int, Scope, RelInfo]:
+        """Returns (fragment id, scope over its output, stream info)."""
         if isinstance(rel, ast.TableRel):
+            # an MV name resolves to a backfilled stream scan over it
+            # (MV-on-MV, reference StreamScan/Chain); sources otherwise
+            if rel.name in getattr(self.catalog, "mvs", {}):
+                mv = self.catalog.mvs[rel.name]
+                node = Node("stream_scan", dict(mv=rel.name))
+                f = self.graph.add(Fragment(self.fid(), node,
+                                            dispatch="broadcast"))
+                return (f.fid, Scope.of(mv.schema, rel.alias or rel.name),
+                        RelInfo(stream_key=tuple(mv.pk_indices),
+                                append_only=getattr(mv, "append_only",
+                                                    False)))
             src = self.catalog.source(rel.name)
-            node = Node("nexmark_source", dict(src.options))
+            node = Node("nexmark_source", dict(src.options, durable=True))
             f = self.graph.add(Fragment(self.fid(), node,
                                         dispatch="broadcast"))
-            return f.fid, Scope.of(src.schema, rel.alias or rel.name)
+            return (f.fid, Scope.of(src.schema, rel.alias or rel.name),
+                    RelInfo(None, True))
         if isinstance(rel, ast.WindowRel):
             src = self.catalog.source(rel.inner.name)
             scope = Scope.of(src.schema, None)
             i, t = scope.resolve(ast.ColRef(rel.time_col))
-            src_node = Node("nexmark_source", dict(src.options))
+            src_node = Node("nexmark_source", dict(src.options, durable=True))
             if rel.kind == "tumble":
                 exprs = [col(j, f.data_type)
                          for j, f in enumerate(src.schema)]
@@ -167,28 +190,31 @@ class StreamPlanner:
                 out_schema = Schema(tuple(
                     list(src.schema) + [Field("window_start", t),
                                         Field("window_end", t)]))
-            return f.fid, Scope.of(out_schema, rel.alias or rel.inner.name)
+            return (f.fid, Scope.of(out_schema, rel.alias or rel.inner.name),
+                    RelInfo(None, True))
         if isinstance(rel, ast.JoinRel):
-            lf, ls = self.plan_rel(rel.left)
-            rf, rs = self.plan_rel(rel.right)
-            # Join-state pk must be a REAL stream key: using all side
-            # columns would collapse two identical input rows into one pk
-            # (losing multiplicity / tripping the delete-miss fail-stop).
-            # The reference derives the stream key from the upstream
-            # (row_id for keyless streams, stream_key in plan_base); plan a
-            # row_id_gen below each join input and key the join state by
-            # its serial column (ADVICE r2 #5).
+            lf, ls, li = self.plan_rel(rel.left)
+            rf, rs, ri = self.plan_rel(rel.right)
+            # Join-state pk must be a REAL stream key (reference: plan_base
+            # stream_key). A keyless append-only side gets a row_id column
+            # (ADVICE r2 #5); a side that already HAS a stream key (MV
+            # scan, agg subquery) keeps it — generating fresh row ids for
+            # retraction rows would orphan every delete.
             from ..common.types import Field
 
-            def with_row_id(fid_, scope_):
+            def side_key(fid_, scope_, info_):
+                if info_.stream_key is not None:
+                    return scope_, tuple(info_.stream_key)
+                if not info_.append_only:
+                    raise BindError("keyless retracting join input")
                 frag_ = self.graph.fragments[fid_]
                 frag_.root = Node("row_id_gen", {}, inputs=(frag_.root,))
                 sch = Schema(tuple(scope_.schema)
                              + (Field("_row_id", DataType.SERIAL),))
-                return Scope(sch, dict(scope_.names))
+                return Scope(sch, dict(scope_.names)), (len(sch) - 1,)
 
-            ls = with_row_id(lf, ls)
-            rs = with_row_id(rf, rs)
+            ls, lpk = side_key(lf, ls, li)
+            rs, rpk = side_key(rf, rs, ri)
             jscope = Scope.join(ls, rs)
             lkeys, rkeys, residue = [], [], []
             for conj in split_conjuncts(rel.on):
@@ -208,21 +234,76 @@ class StreamPlanner:
                 cond = bind_scalar(e, jscope)
             node = Node("hash_join", dict(
                 left_key_indices=lkeys, right_key_indices=rkeys,
-                left_pk_indices=[len(ls.schema) - 1],
-                right_pk_indices=[len(rs.schema) - 1],
-                condition=cond, match_factor=64),
+                left_pk_indices=list(lpk),
+                right_pk_indices=list(rpk),
+                condition=cond, match_factor=64, durable=True),
                 inputs=(Exchange(lf), Exchange(rf)))
             f = self.graph.add(Fragment(self.fid(), node,
                                         dispatch="broadcast"))
-            return f.fid, jscope
+            off = len(ls.schema)
+            jkey = tuple(lpk) + tuple(off + i for i in rpk)
+            return (f.fid, jscope,
+                    RelInfo(stream_key=jkey,
+                            append_only=li.append_only and ri.append_only))
+        if isinstance(rel, ast.SubqueryRel):
+            # FROM (SELECT ...) alias — plan the inner query WITHOUT
+            # materialization; its changelog feeds the outer plan
+            # directly (reference: StreamProject/Agg subplans compose,
+            # no intermediate MV)
+            from ..common.types import Field
+            sub_fid, names, types, pk_hint, ao = self._plan_query(
+                rel.select)
+            schema = Schema(tuple(Field(n, t)
+                                  for n, t in zip(names, types)))
+            return (sub_fid, Scope.of(schema, rel.alias),
+                    RelInfo(stream_key=pk_hint, append_only=ao))
         raise BindError(f"cannot plan relation {rel!r}")
 
     # -------------------------------------------------------------- select
     def plan_select(self, sel: ast.Select) -> BoundPlan:
-        fid, scope = self.plan_rel(sel.rel)
+        fid, names, types, pk_hint, append_only = self._plan_query(sel)
         frag = self.graph.fragments[fid]
-        sel = ast.Select(expand_star(sel.items, scope.schema), sel.rel,
-                         sel.where, sel.group_by)
+        from ..common.types import Field
+        if pk_hint is None:
+            frag.root = Node("row_id_gen", {}, inputs=(frag.root,))
+            mv = self.graph.add(Fragment(self.fid(), Node(
+                "materialize", dict(pk_indices=[len(names)]),
+                inputs=(Exchange(fid),))))
+            out = Schema(tuple(
+                [Field(n, t) for n, t in zip(names, types)]
+                + [Field("_row_id", DataType.SERIAL)]))
+            return BoundPlan(self.graph, mv.fid, out, (len(names),),
+                             append_only)
+        mv = self.graph.add(Fragment(self.fid(), Node(
+            "materialize", dict(pk_indices=list(pk_hint)),
+            inputs=(Exchange(fid),))))
+        out = Schema(tuple(Field(n, t) for n, t in zip(names, types)))
+        return BoundPlan(self.graph, mv.fid, out, tuple(pk_hint),
+                         append_only)
+
+    def _plan_query(self, sel: ast.Select):
+        """Plan one SELECT (no materialization). Returns (fragment id,
+        out names, out DataTypes, pk_hint, append_only) — pk_hint is the
+        output positions forming the stream key, or None when the stream
+        is keyless append-only (caller adds a row_id)."""
+        if sel.order_by or sel.limit is not None or sel.offset:
+            raise BindError(
+                "streaming plans do not support ORDER BY/LIMIT/OFFSET "
+                "(use them in batch SELECTs over the MV)")
+        # comma join: FROM a, b WHERE ... — the join condition lives in
+        # WHERE; hoist it into ON (single 2-way comma join supported)
+        rel, where = sel.rel, sel.where
+        if isinstance(rel, ast.JoinRel) and rel.on is None:
+            if isinstance(rel.left, ast.JoinRel) and rel.left.on is None:
+                raise BindError("only one comma join is supported")
+            if where is None:
+                raise BindError("comma join needs join conditions in WHERE")
+            rel = ast.JoinRel(rel.left, rel.right, where)
+            where = None
+        fid, scope, info = self.plan_rel(rel)
+        frag = self.graph.fragments[fid]
+        sel = ast.Select(expand_star(sel.items, scope.schema), rel,
+                         where, sel.group_by)
 
         if sel.where is not None:
             pred = bind_scalar(sel.where, scope)
@@ -236,21 +317,37 @@ class StreamPlanner:
             for j, it in enumerate(sel.items):
                 exprs.append(bind_scalar(it.expr, scope))
                 names.append(it.alias or auto_name(it.expr, j))
+            if info.append_only:
+                frag.root = Node("project", dict(exprs=exprs, names=names),
+                                 inputs=(frag.root,))
+                return fid, names, [e.ret_type for e in exprs], None, True
+            # retracting input: its stream key must survive projection so
+            # deletes keep addressing the same rows (the reference appends
+            # hidden stream-key columns the same way)
+            assert info.stream_key is not None
+            key_pos = []
+            from ..expr.ir import InputRef
+            for ki in info.stream_key:
+                found = None
+                for j, e in enumerate(exprs):
+                    if isinstance(e, InputRef) and e.index == ki:
+                        found = j
+                        break
+                if found is None:
+                    t = scope.schema[ki].data_type
+                    exprs.append(col(ki, t))
+                    names.append(f"_sk{ki}")
+                    found = len(exprs) - 1
+                key_pos.append(found)
             frag.root = Node("project", dict(exprs=exprs, names=names),
                              inputs=(frag.root,))
-            frag.root = Node("row_id_gen", {}, inputs=(frag.root,))
-            mv = self.graph.add(Fragment(self.fid(), Node(
-                "materialize", dict(pk_indices=[len(exprs)]),
-                inputs=(Exchange(fid),))))
-            from ..common.types import Field
-            out = Schema(tuple(
-                [Field(n, e.ret_type) for n, e in zip(names, exprs)]
-                + [Field("_row_id", DataType.SERIAL)]))
-            return BoundPlan(self.graph, mv.fid, out, (len(exprs),))
+            return (fid, names, [e.ret_type for e in exprs],
+                    tuple(key_pos), False)
 
-        return self._plan_agg(sel, fid, scope)
+        out = self._plan_agg(sel, fid, scope)
+        return out + (False,)
 
-    def _plan_agg(self, sel: ast.Select, fid: int, scope: Scope) -> BoundPlan:
+    def _plan_agg(self, sel: ast.Select, fid: int, scope: Scope):
         from ..common.types import Field
         frag = self.graph.fragments[fid]
         # pre-project: group keys then agg args
@@ -318,7 +415,7 @@ class StreamPlanner:
             frag.dist_key_indices = tuple(range(len(keys)))
             agg = self.graph.add(Fragment(self.fid(), Node(
                 "hash_agg", dict(group_key_indices=list(range(len(keys))),
-                                 agg_calls=agg_calls),
+                                 agg_calls=agg_calls, durable=True),
                 inputs=(Exchange(fid),)),
                 dispatch="hash",
                 dist_key_indices=tuple(range(len(keys)))))
@@ -327,7 +424,7 @@ class StreamPlanner:
             # (reference: DistId::Singleton, simple_agg.rs)
             frag.dispatch = "simple"
             agg = self.graph.add(Fragment(self.fid(), Node(
-                "simple_agg", dict(agg_calls=agg_calls),
+                "simple_agg", dict(agg_calls=agg_calls, durable=True),
                 inputs=(Exchange(fid),)),
                 dispatch="simple"))
 
@@ -364,12 +461,7 @@ class StreamPlanner:
             pk.append(found)
         agg.root = Node("project", dict(exprs=post, names=names),
                         inputs=(agg.root,))
-        mv = self.graph.add(Fragment(self.fid(), Node(
-            "materialize", dict(pk_indices=pk),
-            inputs=(Exchange(agg.fid),))))
-        out = Schema(tuple(Field(n, e.ret_type)
-                           for n, e in zip(names, post)))
-        return BoundPlan(self.graph, mv.fid, out, tuple(pk))
+        return agg.fid, names, [e.ret_type for e in post], tuple(pk)
 
 
 def split_conjuncts(e) -> list:

@@ -23,11 +23,14 @@ _BINOPS = {
 }
 
 
-def eval_numpy(e: Expr, cols: list[np.ndarray]):
-    """-> (values ndarray, valid ndarray bool)."""
+def eval_numpy(e: Expr, cols: list[np.ndarray], valids=None):
+    """-> (values ndarray, valid ndarray bool). `valids` threads per-column
+    NULL masks from the storage layer (ADVICE r2 #2); None = all valid."""
     n = len(cols[0]) if cols else 0
     if isinstance(e, InputRef):
-        return cols[e.index], np.ones(n, dtype=bool)
+        v = (valids[e.index] if valids is not None
+             and valids[e.index] is not None else np.ones(n, dtype=bool))
+        return cols[e.index], v
     if isinstance(e, Literal):
         if e.value is None:
             return np.zeros(n), np.zeros(n, dtype=bool)
@@ -36,7 +39,7 @@ def eval_numpy(e: Expr, cols: list[np.ndarray]):
             v = GLOBAL_DICT.get_or_insert(v)
         return np.full(n, v), np.ones(n, dtype=bool)
     if isinstance(e, FuncCall):
-        args = [eval_numpy(a, cols) for a in e.args]
+        args = [eval_numpy(a, cols, valids) for a in e.args]
         name = e.name
         if name in _BINOPS:
             (a, av), (b, bv) = args

@@ -13,6 +13,7 @@ only fast option).
 from __future__ import annotations
 
 import asyncio
+import json
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -47,6 +48,10 @@ class MvDef:
     deployment: Deployment
     coord: BarrierCoordinator
     mv_fragment: int
+    tap: object = None                 # TapDispatcher on the MV root actor
+    upstream_taps: tuple = ()          # (upstream MvDef, Channel) to detach
+    sql: str = ""                      # original DDL (durable catalog)
+    append_only: bool = False          # changelog has no retractions
 
     @property
     def table(self):
@@ -64,19 +69,97 @@ class Catalog:
         return self.sources[name]
 
 
+CATALOG_PATH = "CATALOG"
+
+
 class Session:
+    """One coordinator drives EVERY dataflow of the session (the reference
+    has one GlobalBarrierManager for all streaming jobs): MV-on-MV needs
+    all MVs on a single aligned epoch stream."""
+
     def __init__(self, store=None):
         self.store = store if store is not None else MemoryStateStore()
         self.catalog = Catalog()
-        self._next_table_id = 1
+        self.coord = BarrierCoordinator(self.store)
+        self.env = BuildEnv(self.store, self.coord)
+        self.env.session = self
+        # durable catalog: ordered DDL log + the table-id floor each MV was
+        # built at, so a replay rebinds the SAME state-table ids
+        # (reference: catalog in the meta store, meta/src/manager/catalog/).
+        # The persisted log loads EAGERLY: a session that issues DDL on an
+        # existing store without calling recover() must append to the
+        # stored log, not clobber it.
+        self._ddl_log: list[dict] = []
+        self._recovering = False
+        blob = self._load_catalog_blob()
+        if blob:
+            self._ddl_log = list(json.loads(blob)["ddl"])
+        self.recoveries = 0
+
+    # ------------------------------------------------------ durable catalog
+    def _persist_catalog(self) -> None:
+        if self._recovering:
+            return
+        blob = json.dumps({"format": 1, "ddl": self._ddl_log}).encode()
+        objects = getattr(self.store, "objects", None)
+        if objects is not None:          # Hummock: atomic object swap
+            objects.upload(CATALOG_PATH, blob)
+        else:                            # in-memory: survives in-process
+            self.store._catalog_blob = blob
+    def _load_catalog_blob(self):
+        objects = getattr(self.store, "objects", None)
+        if objects is not None:
+            if objects.exists(CATALOG_PATH):
+                return objects.read(CATALOG_PATH)
+            return None
+        return getattr(self.store, "_catalog_blob", None)
+
+    async def recover(self) -> None:
+        """Replay the persisted DDL log: re-register sources, re-deploy
+        every MV with its original table ids (their materialized state is
+        already in the store; sources re-seek their committed offsets).
+        The playground calls this on startup with --data."""
+        log = list(self._ddl_log)
+        if not log:
+            return
+        self._recovering = True
+        try:
+            for entry in log:
+                self.env._next_table_id = entry.get(
+                    "table_id_floor", self.env._next_table_id)
+                await self.execute(entry["sql"])
+        finally:
+            self._recovering = False
+        self._ddl_log = list(log)
+        # one Initial barrier over the fully-reattached topology
+        if self.catalog.mvs:
+            await self.coord.run_rounds(0)
 
     # --------------------------------------------------------------- DDL
     async def execute(self, sql_text: str):
         stmt = ast.parse(sql_text)
         if isinstance(stmt, ast.CreateSource):
-            return self._create_source(stmt)
+            out = self._create_source(stmt)
+            if not self._recovering:
+                self._ddl_log = [e for e in self._ddl_log if not (
+                    e["kind"] == "source" and e["name"] == stmt.name)]
+                self._ddl_log.append({"kind": "source", "name": stmt.name,
+                                      "sql": sql_text})
+                self._persist_catalog()
+            return out
         if isinstance(stmt, ast.CreateMV):
-            return await self._create_mv(stmt)
+            if stmt.name in self.catalog.mvs:
+                raise BindError(f"MV {stmt.name!r} already exists")
+            floor = self.env._next_table_id
+            out = await self._create_mv(stmt, sql_text)
+            if not self._recovering:
+                self._ddl_log = [e for e in self._ddl_log if not (
+                    e["kind"] == "mv" and e["name"] == stmt.name)]
+                self._ddl_log.append({"kind": "mv", "name": stmt.name,
+                                      "sql": sql_text,
+                                      "table_id_floor": floor})
+                self._persist_catalog()
+            return out
         if isinstance(stmt, ast.Select):
             return self.query_select(stmt)
         raise BindError(f"unsupported statement {stmt!r}")
@@ -107,34 +190,141 @@ class Session:
         self.catalog.sources[stmt.name] = src
         return src
 
-    async def _create_mv(self, stmt: ast.CreateMV) -> MvDef:
+    async def _create_mv(self, stmt: ast.CreateMV,
+                         sql_text: str = "") -> MvDef:
+        from ..stream import TapDispatcher
         planner = StreamPlanner(self.catalog)
         plan = planner.plan_select(stmt.select)
-        coord = BarrierCoordinator(self.store)
-        env = BuildEnv(self.store, coord)
-        # table ids must be unique ACROSS deployments on the shared store
-        env._next_table_id = self._next_table_id
-        dep = build_graph(plan.graph, env)
-        self._next_table_id = env._next_table_id
-        dep.spawn()
-        mv = MvDef(stmt.name, plan.schema, plan.pk_indices, dep, coord,
-                   plan.mv_fragment)
-        self.catalog.mvs[stmt.name] = mv
-        # the Initial barrier brings the dataflow up
-        await coord.run_rounds(0)
+        # bring-up holds the rounds lock: actor registration + tap attach
+        # must not interleave with an in-flight barrier round (the
+        # reference pauses the barrier loop around an Add command)
+        async with self.coord._rounds_lock:
+            self.env.pending_taps = []
+            dep = build_graph(plan.graph, self.env)
+            root = dep.roots[plan.mv_fragment][0]
+            actor = next(a for a in dep.actors if a.consumer is root)
+            assert actor.dispatcher is None, "MV fragment must be terminal"
+            tap = TapDispatcher()
+            actor.dispatcher = tap
+            dep.spawn()
+            # upstream taps learn this deployment's actor set so a Stop
+            # barrier covering it detaches the channel at the barrier
+            dep_ids = {a.actor_id for a in dep.actors}
+            for up, ch in self.env.pending_taps:
+                up.tap.set_consumers(ch, dep_ids)
+            mv = MvDef(stmt.name, plan.schema, plan.pk_indices, dep,
+                       self.coord, plan.mv_fragment, tap=tap,
+                       upstream_taps=tuple(self.env.pending_taps),
+                       sql=sql_text,
+                       append_only=getattr(plan, "append_only", False))
+            self.catalog.mvs[stmt.name] = mv
+        # bring the new dataflow up: the first MV gets the Initial
+        # barrier; later MVs initialize on the next ordinary barrier.
+        # During catalog recovery NO barrier may run until the WHOLE
+        # topology is reattached — a barrier between two re-created MVs
+        # would advance upstream state while a finished-backfill consumer
+        # is not yet tapped, losing its delta forever (the reference's
+        # recovery rebuilds all actors before resuming barriers,
+        # meta/src/barrier/recovery.rs:332).
+        if not self._recovering:
+            await self.coord.run_rounds(0 if not self.coord._started else 1)
         return mv
 
     # ------------------------------------------------------------ runtime
     async def tick(self, rounds: int = 1,
-                   interval_s: Optional[float] = None) -> None:
-        """Advance every MV's barrier loop (meta's periodic injection)."""
-        # snapshot: CREATE MV may run concurrently with a background ticker
-        for mv in list(self.catalog.mvs.values()):
-            await mv.coord.run_rounds(rounds, interval_s=interval_s)
+                   interval_s: Optional[float] = None,
+                   max_recoveries: int = 3) -> None:
+        """Advance the session's barrier loop (meta's periodic injection).
+
+        Barrier-collection failure (a dead actor) triggers AUTOMATIC
+        recovery — stop everything, rebuild the whole topology from the
+        DDL log, resume from the last committed epoch — and the tick is
+        retried; no operator in the loop (reference:
+        meta/src/barrier/recovery.rs:332-625)."""
+        if not self.catalog.mvs:
+            return
+        attempts = 0
+        while True:
+            try:
+                await self.coord.run_rounds(rounds, interval_s=interval_s)
+                return
+            except RuntimeError:
+                attempts += 1
+                if attempts > max_recoveries:
+                    raise
+                await self._auto_recover()
+
+    async def _auto_recover(self) -> None:
+        """Tear down every actor, drop uncommitted store state, rebuild
+        all dataflows from the DDL log at the committed epoch, resume."""
+        self.recoveries += 1
+        await self.crash()
+        reset = getattr(self.store, "reset_uncommitted", None)
+        if reset is not None:
+            reset()
+        # fresh coordinator: epochs re-floor at the committed epoch, no
+        # stale in-flight state
+        self.coord = BarrierCoordinator(self.store)
+        self.env = BuildEnv(self.store, self.coord)
+        self.env.session = self
+        self.catalog.mvs.clear()
+        log = list(self._ddl_log)
+        self._recovering = True
+        try:
+            for entry in log:
+                self.env._next_table_id = entry.get(
+                    "table_id_floor", self.env._next_table_id)
+                await self.execute(entry["sql"])
+        finally:
+            self._recovering = False
+        self._ddl_log = log
+        await self.coord.run_rounds(0)
+
+    async def drop_mv(self, name: str) -> None:
+        """Stop one MV's actors and detach its upstream taps. MVs that
+        READ this one must be dropped first (the reference rejects
+        dropping a relation with dependents)."""
+        dependents = [d.name for d in self.catalog.mvs.values()
+                      if any(up.name == name for up, _ in d.upstream_taps)]
+        if dependents:
+            raise BindError(
+                f"cannot drop {name!r}: MV(s) {dependents} read it")
+        mv = self.catalog.mvs.pop(name)
+        await mv.deployment.stop()
+        for up, ch in mv.upstream_taps:
+            up.tap.remove(ch)
+        self._ddl_log = [e for e in self._ddl_log
+                         if not (e["kind"] == "mv" and e["name"] == name)]
+        self._persist_catalog()
+
+    async def crash(self) -> None:
+        """Abandon every actor task WITHOUT the stop protocol — the
+        process-kill simulation used by restart/recovery tests. Catalog
+        and store are left as-is (a real crash persists both)."""
+        for mv in self.catalog.mvs.values():
+            for t in mv.deployment.tasks:
+                if not t.done():
+                    t.cancel()
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
 
     async def drop_all(self) -> None:
-        for mv in list(self.catalog.mvs.values()):
+        # reverse creation order: downstream MVs tap upstream ones
+        for name in reversed(list(self.catalog.mvs)):
+            await self.drop_mv(name)
+
+    async def shutdown(self) -> None:
+        """Graceful stop WITHOUT dropping: actors stop at a barrier, the
+        durable catalog and state stay for the next incarnation (the
+        playground's exit path under --data; drop_all would erase the
+        DDL log)."""
+        for name in reversed(list(self.catalog.mvs)):
+            mv = self.catalog.mvs[name]
             await mv.deployment.stop()
+            for up, ch in mv.upstream_taps:
+                up.tap.remove(ch)
         self.catalog.mvs.clear()
 
     # -------------------------------------------------------- batch query
@@ -144,30 +334,8 @@ class Session:
         return self.query_select(stmt)
 
     def query_select(self, sel: ast.Select) -> list[tuple]:
-        """Serving path: committed-snapshot scan of an MV + numpy eval
-        (reference: batch local execution over StorageTable,
-        scheduler/local.rs + storage_table.rs:646)."""
-        if not isinstance(sel.rel, ast.TableRel):
-            raise BindError("batch queries read one MV")
-        mv = self.catalog.mvs.get(sel.rel.name)
-        if mv is None:
-            raise BindError(f"unknown MV {sel.rel.name!r}")
-        if sel.group_by:
-            raise BindError("batch GROUP BY lands with the batch engine")
-        st = StorageTable.for_state_table(mv.table)
-        cols = st.to_numpy()
-        scope = Scope.of(mv.schema, sel.rel.alias or sel.rel.name)
-        mask = np.ones(len(cols[0]) if cols else 0, dtype=bool)
-        if sel.where is not None:
-            pred = bind_scalar(sel.where, scope)
-            v, valid = eval_numpy(pred, cols)
-            mask &= v.astype(bool) & valid
-        out_cols = []
-        items = expand_star(sel.items, mv.schema)
-        for it in items:
-            e = bind_scalar(it.expr, scope)
-            v, _ = eval_numpy(e, cols)
-            out_cols.append(np.asarray(v)[mask] if np.ndim(v) else
-                            np.full(int(mask.sum()), v))
-        n = len(out_cols[0]) if out_cols else 0
-        return [tuple(c[i].item() for c in out_cols) for i in range(n)]
+        """Serving path: the batch engine over committed MV snapshots
+        (reference: local batch execution, scheduler/local.rs over
+        batch/src/executor/ — scan/filter/join/agg/sort/limit)."""
+        from .batch import run_batch_select
+        return run_batch_select(self.catalog, sel)

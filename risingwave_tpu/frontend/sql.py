@@ -24,7 +24,7 @@ KEYWORDS = {
     "select", "from", "where", "group", "by", "as", "create",
     "materialized", "view", "source", "with", "join", "on", "and", "or",
     "not", "tumble", "hop", "count", "sum", "min", "max", "avg", "limit",
-    "order", "desc", "asc", "emit", "table",
+    "order", "desc", "asc", "offset", "between", "emit", "table",
 }
 
 _TOKEN_RE = re.compile(r"""
@@ -127,7 +127,13 @@ class WindowRel:
 class JoinRel:
     left: object
     right: object
-    on: object
+    on: object                  # None = comma join (ON comes from WHERE)
+
+
+@dataclass
+class SubqueryRel:
+    select: object              # Select
+    alias: str
 
 
 @dataclass
@@ -136,6 +142,9 @@ class Select:
     rel: object
     where: Optional[object] = None
     group_by: list = field(default_factory=list)
+    order_by: list = field(default_factory=list)   # (expr, descending)
+    limit: Optional[int] = None
+    offset: int = 0
 
 
 @dataclass
@@ -223,6 +232,8 @@ class Parser:
             items.append(self._select_item())
         self.expect("kw", "from")
         rel = self._relation()
+        while self.accept("op", ","):
+            rel = JoinRel(rel, self._relation(), None)
         where = None
         if self.accept("kw", "where"):
             where = self._expr()
@@ -232,7 +243,24 @@ class Parser:
             group_by.append(self._expr())
             while self.accept("op", ","):
                 group_by.append(self._expr())
-        return Select(items, rel, where, group_by)
+        order_by = []
+        if self.accept("kw", "order"):
+            self.expect("kw", "by")
+            while True:
+                e = self._expr()
+                desc = bool(self.accept("kw", "desc"))
+                if not desc:
+                    self.accept("kw", "asc")
+                order_by.append((e, desc))
+                if not self.accept("op", ","):
+                    break
+        limit = None
+        offset = 0
+        if self.accept("kw", "limit"):
+            limit = int(self.expect("num").val)
+        if self.accept("kw", "offset"):
+            offset = int(self.expect("num").val)
+        return Select(items, rel, where, group_by, order_by, limit, offset)
 
     def _select_item(self) -> SelectItem:
         if self.accept("op", "*"):
@@ -280,6 +308,18 @@ class Parser:
                 return WindowRel("tumble", inner, time_col, size=a,
                                  alias=alias)
         if self.accept("op", "("):
+            if self.peek().kind == "kw" and self.peek().val == "select":
+                sub = self._select()
+                self.expect("op", ")")
+                alias = None
+                if self.accept("kw", "as"):
+                    alias = self.next().val
+                elif self.peek().kind == "ident" \
+                        and self.peek().val not in KEYWORDS:
+                    alias = self.next().val
+                if alias is None:
+                    raise SqlError("FROM subquery needs an alias")
+                return SubqueryRel(sub, alias)
             rel = self._relation()
             self.expect("op", ")")
             return rel
@@ -321,6 +361,13 @@ class Parser:
                   "<": "less_than", "<=": "less_than_or_equal",
                   ">": "greater_than", ">=": "greater_than_or_equal"}[t.val]
             return BinOp(op, e, self._add())
+        if self.accept("kw", "between"):
+            lo = self._add()
+            self.expect("kw", "and")
+            hi = self._add()
+            return BinOp("and",
+                         BinOp("greater_than_or_equal", e, lo),
+                         BinOp("less_than_or_equal", e, hi))
         return e
 
     def _add(self):

@@ -60,6 +60,11 @@ class BuildEnv:
         self.channel_capacity = channel_capacity
         self._next_table_id = 1
         self._next_actor_id = 1
+        # session services for cross-MV nodes (stream_scan taps); set by
+        # the owning Session, None in engine-level tests
+        self.session = None
+        self.pending_taps: list = []          # (upstream MvDef, Channel)
+        self.pending_source_queues: list = []
 
     def alloc_table_id(self) -> int:
         t = self._next_table_id
@@ -106,14 +111,19 @@ class Deployment:
     actors: list[Actor] = field(default_factory=list)
     roots: dict[int, list[Executor]] = field(default_factory=dict)
     tasks: list[asyncio.Task] = field(default_factory=list)
+    source_queues: list = field(default_factory=list)
 
     def spawn(self) -> "Deployment":
         self.tasks = [a.spawn() for a in self.actors]
         return self
 
     async def stop(self) -> None:
+        """Stop THIS deployment's actors (a shared coordinator may drive
+        several deployments; the stop mutation names only ours) and
+        deregister them so later barriers don't wait on the dead."""
+        ids = {a.actor_id for a in self.actors}
         try:
-            await self.coord.stop_all()
+            await self.coord.stop_all(ids)
         finally:
             # a failed coordinator raises before the stop barrier reaches
             # anyone; surviving actors must still be torn down, not leaked
@@ -124,9 +134,15 @@ class Deployment:
                     await t
                 except (asyncio.CancelledError, Exception):
                     pass
+            for a in self.actors:
+                self.coord.actor_ids.discard(a.actor_id)
+            for q in self.source_queues:
+                if q in self.coord.source_queues:
+                    self.coord.source_queues.remove(q)
 
 
 def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
+    env.pending_source_queues = []
     dep = Deployment(coord=env.coord)
     # channels[(up_fid, down_fid, edge_k)][u_actor][d_actor] — one matrix
     # PER EXCHANGE EDGE, so a fragment consuming the same upstream twice
@@ -170,13 +186,17 @@ def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
                     up = graph.fragments[n.upstream]
                     matrix = channels[(n.upstream, fid, k)]
                     sch = built_schema[n.upstream]
+                    # terminate only on THIS actor's stop (a shared
+                    # coordinator routes other deployments' stops here too)
+                    stop_on = (lambda b, aid=ctx.actor_id: b.is_stop(aid))
                     if up.dispatch == "simple" and up.parallelism > 1:
                         # NoShuffle: 1:1 actor pairing
-                        return ChannelInput(matrix[idx][idx], sch)
+                        return ChannelInput(matrix[idx][idx], sch,
+                                            stop_on=stop_on)
                     chans = [matrix[u][idx] for u in range(up.parallelism)]
                     if len(chans) == 1:
-                        return ChannelInput(chans[0], sch)
-                    return MergeExecutor(chans, sch)
+                        return ChannelInput(chans[0], sch, stop_on=stop_on)
+                    return MergeExecutor(chans, sch, stop_on=stop_on)
                 inputs = [build_node(i) for i in n.inputs]
                 return BUILDERS[n.kind](dict(n.args), inputs, ctx, id(n))
 
@@ -207,6 +227,7 @@ def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
                               else FanoutDispatcher(per_consumer))
             env.coord.register_actor(actor_id)
             dep.actors.append(Actor(actor_id, root, dispatcher, env.coord))
+    dep.source_queues = list(env.pending_source_queues)
     return dep
 
 
@@ -236,6 +257,7 @@ def _build_source(args, inputs, ctx: ActorCtx, key):
                            **({"cfg": cfg} if cfg else {}))
     barrier_q: asyncio.Queue = asyncio.Queue()
     ctx.env.coord.register_source(barrier_q)
+    ctx.env.pending_source_queues.append(barrier_q)
     st = None
     if args.get("durable"):
         tid = ctx.table_id(key)
@@ -394,6 +416,31 @@ def _build_stateless_agg(args, inputs, ctx, key):
 @register_builder("row_id_gen")
 def _build_row_id(args, inputs, ctx: ActorCtx, key):
     return RowIdGenExecutor(inputs[0], instance=ctx.actor_id)
+
+
+@register_builder("stream_scan")
+def _build_stream_scan(args, inputs, ctx: ActorCtx, key):
+    """CREATE MV ... FROM <mv>: live tap on the upstream MV's root actor +
+    snapshot backfill over its StorageTable (no_shuffle_backfill.rs)."""
+    from ..state.storage_table import StorageTable
+    from ..stream import Channel, ChannelInput
+    from ..stream.backfill import BackfillExecutor, backfill_progress_schema
+    session = ctx.env.session
+    assert session is not None, "stream_scan needs a session catalog"
+    mv = session.catalog.mvs[args["mv"]]
+    ch = Channel(ctx.env.channel_capacity)
+    mv.tap.add(ch)
+    ctx.env.pending_taps.append((mv, ch))
+    storage = StorageTable.for_state_table(mv.table)
+    st = None
+    if args.get("durable", True):
+        sch = backfill_progress_schema(mv.schema, mv.pk_indices)
+        st = ctx.env.state_table(ctx.table_id(key), sch, (0,))
+    return BackfillExecutor(
+        ChannelInput(ch, mv.schema,
+                     stop_on=lambda b, aid=ctx.actor_id: b.is_stop(aid)),
+        storage, state_table=st,
+        batch_rows=args.get("batch_rows", 65536))
 
 
 @register_builder("materialize")

@@ -28,7 +28,7 @@ from typing import Iterator, Optional
 
 from .object_store import ObjectStore
 from .sstable import SsTable, build_sstable
-from .store import StateStore, WriteBatch
+from .store import StateStore, WriteBatch, lazy_merge_ranges
 
 MANIFEST_PATH = "MANIFEST"
 
@@ -89,27 +89,30 @@ class HummockStateStore(StateStore):
         return None
 
     def iter_range(self, start: bytes, end: bytes,
-                   committed_only: bool = False
+                   committed_only: bool = False,
+                   max_epoch: Optional[int] = None
                    ) -> Iterator[tuple[bytes, bytes]]:
         """committed_only=True reads the COMMITTED snapshot (SSTs under the
         manifest), excluding the uncommitted shared buffer — the batch/
         serving read isolation (reference: StorageTable::batch_iter at a
-        pinned snapshot epoch, batch_table/storage_table.rs:646)."""
-        merged: dict[bytes, Optional[bytes]] = {}
-        if self._l1 is not None:
-            for k, v in self._l1.iter_range(start, end):
-                merged[k] = v
-        for sst in reversed(self._l0):           # oldest -> newest overlay
-            for k, v in sst.iter_range(start, end):
-                merged[k] = v
+        pinned snapshot epoch, batch_table/storage_table.rs:646).
+        max_epoch additionally bounds which shared-buffer epochs are
+        visible (SSTs are always <= the last sync, which is <= any
+        in-flight barrier epoch, so only staged epochs need filtering)."""
+        streams = []
         if not committed_only:
-            for epoch in sorted(self._shared):
-                for k, v in self._shared[epoch].items():
-                    if start <= k and (not end or k < end):
-                        merged[k] = v
-        for k in sorted(merged):
-            if merged[k] is not None:
-                yield k, merged[k]
+            for epoch in sorted(self._shared, reverse=True):  # newest first
+                if max_epoch is not None and epoch > max_epoch:
+                    continue
+                buf = self._shared[epoch]
+                streams.append(sorted(
+                    (k, v) for k, v in buf.items()
+                    if start <= k and (not end or k < end)))
+        for sst in self._l0:                      # newest first
+            streams.append(sst.iter_range(start, end))
+        if self._l1 is not None:
+            streams.append(self._l1.iter_range(start, end))
+        yield from lazy_merge_ranges(streams)
 
     def committed_epoch(self) -> int:
         return self._committed_epoch

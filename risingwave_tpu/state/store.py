@@ -14,12 +14,38 @@ but everything stays in RAM. The durable LSM variant is state/hummock.py.
 from __future__ import annotations
 
 import bisect
+import heapq
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
 
 def encode_table_key(table_id: int, vnode: int, pk_bytes: bytes) -> bytes:
     return table_id.to_bytes(4, "big") + vnode.to_bytes(1, "big") + pk_bytes
+
+
+def lazy_merge_ranges(streams):
+    """K-way merge of (key, value|None) iterators, each ascending by key,
+    ordered NEWEST FIRST; yields live (key, value) lazily with the newest
+    version of each key winning. Lazy matters: backfill snapshot batches
+    stop after `limit` rows, and an eager range materialization would make
+    every per-barrier batch O(remaining rows) instead of O(limit)."""
+    h = []
+    for pri, it in enumerate(streams):
+        it = iter(it)
+        for k, v in it:
+            heapq.heappush(h, (k, pri, v, it))
+            break
+    prev_key = None
+    while h:
+        k, pri, v, it = heapq.heappop(h)
+        for nk, nv in it:
+            heapq.heappush(h, (nk, pri, nv, it))
+            break
+        if k == prev_key:
+            continue
+        prev_key = k
+        if v is not None:
+            yield k, v
 
 
 @dataclass
@@ -39,11 +65,15 @@ class StateStore:
         raise NotImplementedError
 
     def iter_range(self, start: bytes, end: bytes,
-                   committed_only: bool = False
+                   committed_only: bool = False,
+                   max_epoch: Optional[int] = None
                    ) -> Iterator[tuple[bytes, bytes]]:
-        """committed_only=True restricts to the committed snapshot where
-        the store can distinguish (Hummock); in-memory test stores apply
-        writes destructively and serve latest either way."""
+        """committed_only=True restricts to the committed (synced)
+        snapshot. max_epoch bounds which STAGED (shared-buffer) epochs are
+        visible — the backfill snapshot-read isolation: a reader at
+        barrier E must not see epochs the upstream ingested past E
+        (no_shuffle_backfill.rs reads the upstream table at exactly the
+        barrier epoch)."""
         raise NotImplementedError
 
     def ingest_batch(self, batch: WriteBatch) -> None:
@@ -59,41 +89,68 @@ class StateStore:
 
 
 class MemoryStateStore(StateStore):
+    """Sorted base map + per-epoch shared buffers (the same staging shape
+    as Hummock-lite, minus durability): `ingest_batch` stages, `sync`
+    applies destructively. Keeping staged epochs distinct is what lets
+    `iter_range(max_epoch=...)` serve the backfill's epoch-consistent
+    snapshot reads on the in-memory store too."""
+
     def __init__(self):
-        self._keys: list[bytes] = []       # sorted
+        self._keys: list[bytes] = []       # sorted, synced base
         self._vals: dict[bytes, bytes] = {}
+        self._shared: dict[int, dict[bytes, Optional[bytes]]] = {}
         self._committed_epoch = 0
-        self._pending_epochs: set[int] = set()
 
     def get(self, key: bytes) -> Optional[bytes]:
+        for epoch in sorted(self._shared, reverse=True):
+            buf = self._shared[epoch]
+            if key in buf:
+                return buf[key]
         return self._vals.get(key)
 
     def iter_range(self, start: bytes, end: bytes,
-                   committed_only: bool = False):
-        i = bisect.bisect_left(self._keys, start)
-        while i < len(self._keys) and self._keys[i] < end:
-            k = self._keys[i]
-            yield k, self._vals[k]
-            i += 1
+                   committed_only: bool = False,
+                   max_epoch: Optional[int] = None):
+        streams = []
+        if not committed_only:
+            for epoch in sorted(self._shared, reverse=True):  # newest first
+                if max_epoch is not None and epoch > max_epoch:
+                    continue
+                buf = self._shared[epoch]
+                streams.append(sorted(
+                    (k, v) for k, v in buf.items() if start <= k < end))
+
+        def base():
+            i = bisect.bisect_left(self._keys, start)
+            while i < len(self._keys) and self._keys[i] < end:
+                k = self._keys[i]
+                yield k, self._vals[k]
+                i += 1
+        streams.append(base())
+        yield from lazy_merge_ranges(streams)
 
     def ingest_batch(self, batch: WriteBatch) -> None:
-        self._pending_epochs.add(batch.epoch)
-        for k, v in batch.puts.items():
-            if v is None:
-                if k in self._vals:
-                    del self._vals[k]
-                    i = bisect.bisect_left(self._keys, k)
-                    if i < len(self._keys) and self._keys[i] == k:
-                        self._keys.pop(i)
-            else:
-                if k not in self._vals:
-                    bisect.insort(self._keys, k)
-                self._vals[k] = v
+        self._shared.setdefault(batch.epoch, {}).update(batch.puts)
 
     def sync(self, epoch: int) -> dict:
-        self._pending_epochs = {e for e in self._pending_epochs if e > epoch}
+        for e in sorted(e for e in self._shared if e <= epoch):
+            for k, v in self._shared.pop(e).items():
+                if v is None:
+                    if k in self._vals:
+                        del self._vals[k]
+                        i = bisect.bisect_left(self._keys, k)
+                        if i < len(self._keys) and self._keys[i] == k:
+                            self._keys.pop(i)
+                else:
+                    if k not in self._vals:
+                        bisect.insort(self._keys, k)
+                    self._vals[k] = v
         self._committed_epoch = max(self._committed_epoch, epoch)
         return {"uncommitted_ssts": []}
 
     def committed_epoch(self) -> int:
         return self._committed_epoch
+
+    def reset_uncommitted(self) -> None:
+        """Recovery entry point (see HummockStateStore.reset_uncommitted)."""
+        self._shared.clear()

@@ -11,12 +11,13 @@ from .source import SourceExecutor
 from .actor import Actor
 from .exchange import (
     Channel, SimpleDispatcher, BroadcastDispatcher, HashDispatcher,
-    ChannelInput, MergeExecutor,
+    ChannelInput, MergeExecutor, TapDispatcher,
 )
 from .hash_agg import HashAggExecutor
 from .hash_join import HashJoinExecutor
 from .sorted_join import SortedJoinExecutor
 from .sharded_join import ShardedSortedJoinExecutor
+from .backfill import BackfillExecutor
 from .align import barrier_align
 from .hop_window import HopWindowExecutor
 from .dedup import AppendOnlyDedupExecutor

@@ -1,0 +1,177 @@
+"""Backfill (StreamScan/Chain) — bring a new MV up over an existing MV.
+
+Reference: src/stream/src/executor/backfill/no_shuffle_backfill.rs — the
+executor that makes `CREATE MATERIALIZED VIEW ... FROM <mv>` possible:
+scan the upstream MV's table in pk order (the snapshot side) while the
+upstream's LIVE changelog streams in, reconciling the two with a progress
+pointer:
+
+  * at every barrier, read the next snapshot batch of rows with
+    pk > current_pos and emit them as Inserts, advancing current_pos;
+  * live chunks pass through ONLY for rows at-or-before current_pos
+    (their base row is already downstream); rows ahead of it are dropped —
+    a later snapshot batch will read their post-change image;
+  * when the scan is exhausted the executor flips to pass-through.
+
+Epoch consistency: the upstream actor runs AHEAD of this executor (tap
+channels buffer), so an unbounded snapshot read could see upstream epochs
+this executor's barrier hasn't reached — a row would be emitted via the
+snapshot AND forwarded live (double apply). Snapshot reads are therefore
+bounded to staged epochs <= barrier.epoch.prev (exactly the epochs the
+upstream sealed before forwarding this barrier), the analogue of the
+reference reading the upstream table at precisely the barrier epoch.
+
+Progress (vnode, pk, finished) persists to a state table at each barrier
+and recovers on restart, so a mid-backfill crash resumes where it left
+off (backfill_state_store in the reference).
+
+Watermarks are suppressed until the backfill finishes: a watermark only
+covers the live stream, and downstream state cleaning driven by it could
+purge rows the snapshot side has yet to deliver.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.chunk import StreamChunk
+from ..common.types import DataType, Field, Schema
+from ..common.vnode import VNODE_COUNT, compute_vnodes
+from ..state.state_table import StateTable
+from ..state.storage_table import StorageTable
+from .executor import Executor
+from .message import Barrier, BarrierKind, Watermark
+
+
+def backfill_progress_schema(mv_schema: Schema,
+                             pk_indices: Sequence[int]) -> Schema:
+    fields = [Field("slot", DataType.INT64), Field("finished", DataType.INT64),
+              Field("vnode", DataType.INT64), Field("has_pk", DataType.INT64)]
+    for j, i in enumerate(pk_indices):
+        fields.append(Field(f"pk{j}", mv_schema[i].data_type))
+    return Schema(tuple(fields))
+
+
+class BackfillExecutor(Executor):
+    def __init__(self, upstream: Executor, storage: StorageTable,
+                 state_table: Optional[StateTable] = None,
+                 batch_rows: int = 65536, chunk_capacity: int = 8192):
+        self.input = upstream                 # live changelog tap
+        self.storage = storage
+        self.schema = storage.schema
+        self.pk_indices = tuple(storage.pk_indices)
+        self.state_table = state_table
+        self.batch_rows = batch_rows
+        self.chunk_capacity = chunk_capacity
+        self.identity = f"Backfill(table={storage.table_id})"
+        self._dist_idx = tuple(storage._layout.dist_key_indices)
+        # progress
+        self.finished = False
+        self.vnode = 0                        # vnodes < this are complete
+        self.last_pk: Optional[tuple] = None  # within self.vnode
+        self._filter = jax.jit(self._filter_impl)
+        self.snapshot_rows_total = 0
+
+    # ------------------------------------------------------------ filtering
+    def _filter_impl(self, chunk: StreamChunk, cur_vnode, has_pk, pk_vals):
+        """Keep rows already covered by the snapshot scan:
+        vnode < cur  OR  (vnode == cur AND has_pk AND pk <= last_pk)."""
+        vn = compute_vnodes([chunk.columns[i].data for i in self._dist_idx])
+        vn = vn.astype(jnp.int64)
+        passed = vn < cur_vnode
+        le = jnp.ones(chunk.capacity, dtype=bool)
+        for i, v in zip(reversed(self.pk_indices), reversed(pk_vals)):
+            c = chunk.columns[i].data
+            le = (c < v) | ((c == v) & le)
+        passed = passed | ((vn == cur_vnode) & has_pk & le)
+        return chunk.mask(passed)
+
+    def _filter_chunk(self, chunk: StreamChunk) -> StreamChunk:
+        pk_vals = tuple(
+            jnp.asarray(self.last_pk[j] if self.last_pk is not None else 0,
+                        dtype=self.schema[i].data_type.jnp_dtype)
+            for j, i in enumerate(self.pk_indices))
+        return self._filter(chunk, jnp.int64(self.vnode),
+                            jnp.bool_(self.last_pk is not None), pk_vals)
+
+    # ------------------------------------------------------------- snapshot
+    def _snapshot_batch(self, max_epoch: int) -> list[StreamChunk]:
+        """Read up to batch_rows rows after the current position; advance
+        the position; flip finished when the scan is exhausted."""
+        rows: list[tuple] = []
+        budget = self.batch_rows
+        while budget > 0 and self.vnode < VNODE_COUNT:
+            got, exhausted = self.storage.scan_vnode_after(
+                self.vnode, self.last_pk, budget, max_epoch=max_epoch)
+            rows.extend(got)
+            budget -= len(got)
+            if exhausted:
+                self.vnode += 1
+                self.last_pk = None
+            else:
+                self.last_pk = tuple(got[-1][i] for i in self.pk_indices)
+        if self.vnode >= VNODE_COUNT:
+            self.finished = True
+        self.snapshot_rows_total += len(rows)
+        from ..state.storage_table import rows_to_columns
+        out = []
+        for ofs in range(0, len(rows), self.chunk_capacity):
+            part = rows[ofs:ofs + self.chunk_capacity]
+            arrays, valids = rows_to_columns(self.schema, part)
+            out.append(StreamChunk.from_numpy(
+                self.schema, arrays, capacity=self.chunk_capacity,
+                valids=[None if v.all() else v for v in valids]))
+        return out
+
+    # ------------------------------------------------------------ progress
+    def _persist(self, barrier: Barrier) -> None:
+        if self.state_table is None:
+            return
+        pk = (tuple(self.last_pk) if self.last_pk is not None
+              else tuple(0 for _ in self.pk_indices))
+        row = (0, int(self.finished), self.vnode,
+               int(self.last_pk is not None)) + pk
+        self.state_table.write_chunk_rows([(0, row)])
+        self.state_table.commit(barrier.epoch.curr)
+
+    def _recover(self) -> None:
+        if self.state_table is None:
+            return
+        row = self.state_table.get_row((0,))
+        if row is None:
+            return
+        _, finished, vnode, has_pk, *pk = row
+        self.finished = bool(finished)
+        self.vnode = int(vnode)
+        self.last_pk = tuple(pk) if has_pk else None
+
+    # --------------------------------------------------------------- stream
+    async def execute(self):
+        first = True
+        async for msg in self.input.execute():
+            if isinstance(msg, StreamChunk):
+                if self.finished:
+                    yield msg
+                else:
+                    yield self._filter_chunk(msg)
+            elif isinstance(msg, Barrier):
+                if first or msg.kind is BarrierKind.INITIAL:
+                    first = False
+                    if self.state_table is not None:
+                        self.state_table.init_epoch(msg.epoch.curr)
+                        self._recover()
+                    yield msg
+                    continue
+                if not self.finished:
+                    for chunk in self._snapshot_batch(msg.epoch.prev):
+                        yield chunk
+                self._persist(msg)
+                yield msg
+            else:
+                wm: Watermark = msg
+                if self.finished:
+                    yield wm

@@ -46,6 +46,45 @@ class Dispatcher:
         raise NotImplementedError
 
 
+class TapDispatcher(Dispatcher):
+    """Runtime-extendable fanout for MV roots: a downstream `CREATE
+    MATERIALIZED VIEW ... FROM <mv>` attaches a channel here while the
+    deployment is LIVE (the reference's Add-mutation installs new
+    dispatchers the same way, dispatch.rs AddOutput). Attach/detach must
+    happen between barriers (the session holds the coordinator's rounds
+    lock), so every consumer sees a barrier-aligned prefix.
+
+    A Stop barrier covering ALL of a channel's consumer actors removes
+    that channel right after delivering the barrier (the reference drops
+    dispatcher outputs at the DropActors barrier) — without this, the
+    upstream actor keeps pushing post-stop chunks into a channel nobody
+    drains and deadlocks on its bounded capacity."""
+
+    def __init__(self):
+        self.channels: list = []          # (Channel, consumer actor ids)
+
+    def add(self, channel, consumer_actor_ids=frozenset()) -> None:
+        self.channels.append((channel, frozenset(consumer_actor_ids)))
+
+    def remove(self, channel) -> None:
+        self.channels = [(c, ids) for c, ids in self.channels
+                         if c is not channel]
+
+    def set_consumers(self, channel, consumer_actor_ids) -> None:
+        self.channels = [
+            (c, frozenset(consumer_actor_ids) if c is channel else ids)
+            for c, ids in self.channels]
+
+    async def dispatch(self, msg: Message) -> None:
+        from .message import StopMutation
+        for ch, ids in list(self.channels):
+            await ch.send(msg)
+            if (isinstance(msg, Barrier) and ids
+                    and isinstance(msg.mutation, StopMutation)
+                    and ids <= msg.mutation.actor_ids):
+                self.remove(ch)
+
+
 class SimpleDispatcher(Dispatcher):
     def __init__(self, output: Channel):
         self.output = output
@@ -108,20 +147,29 @@ class HashDispatcher(Dispatcher):
 # ------------------------------------------------------------------ merge
 
 class ChannelInput(Executor):
-    """Executor adapter over a channel (ReceiverExecutor, receiver.rs)."""
+    """Executor adapter over a channel (ReceiverExecutor, receiver.rs).
 
-    def __init__(self, channel: Channel, schema):
+    `stop_on(barrier) -> bool` decides which Stop barrier ends the
+    stream. Deployment builders pass the owning actor's predicate
+    (`b.is_stop(actor_id)`): a shared coordinator's stop mutation may
+    target OTHER deployments' actors (MV-on-MV taps route every barrier
+    through everyone), and self-terminating on a foreign stop silently
+    killed the chain. Default (None) keeps the standalone/test behavior:
+    any Stop ends the stream."""
+
+    def __init__(self, channel: Channel, schema, stop_on=None):
         self.channel = channel
         self.schema = schema
+        self.stop_on = stop_on
         self.identity = "ChannelInput"
 
     async def execute(self):
+        from .message import StopMutation
         while True:
             msg = await self.channel.recv()
             yield msg
-            if isinstance(msg, Barrier):
-                from .message import StopMutation
-                if isinstance(msg.mutation, StopMutation):
+            if isinstance(msg, Barrier)                     and isinstance(msg.mutation, StopMutation):
+                if self.stop_on is None or self.stop_on(msg):
                     return
 
 
@@ -130,9 +178,10 @@ class MergeExecutor(Executor):
     yields a barrier is blocked until every upstream yields that barrier,
     then ONE barrier is emitted. Watermarks are min-combined per column."""
 
-    def __init__(self, channels: Sequence[Channel], schema):
+    def __init__(self, channels: Sequence[Channel], schema, stop_on=None):
         self.channels = list(channels)
         self.schema = schema
+        self.stop_on = stop_on            # see ChannelInput.stop_on
         self.identity = f"Merge({len(self.channels)})"
 
     async def execute(self):
@@ -147,10 +196,10 @@ class MergeExecutor(Executor):
                 waiting = [t for i, t in getters.items() if i not in pending_barrier]
                 if not waiting:
                     barrier = next(iter(pending_barrier.values()))
-                    stop = False
                     from .message import StopMutation
-                    if isinstance(barrier.mutation, StopMutation):
-                        stop = True
+                    stop = (isinstance(barrier.mutation, StopMutation)
+                            and (self.stop_on is None
+                                 or self.stop_on(barrier)))
                     yield barrier
                     pending_barrier.clear()
                     if stop:

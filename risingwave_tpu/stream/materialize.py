@@ -36,15 +36,20 @@ class MaterializeExecutor(Executor):
         self.identity = f"Materialize(table={table.table_id})"
 
     async def execute(self):
+        first = True
         async for msg in self.input.execute():
             if isinstance(msg, StreamChunk):
                 self._apply(msg)
                 yield msg
             elif isinstance(msg, Barrier):
-                if msg.kind is not BarrierKind.INITIAL:
-                    self.table.commit(msg.epoch.curr)
-                else:
+                # a dataflow created mid-session initializes on its first
+                # OBSERVED barrier, which need not be the Initial kind
+                # (MV-on-MV actors join a running epoch stream)
+                if first or msg.kind is BarrierKind.INITIAL:
+                    first = False
                     self.table.init_epoch(msg.epoch.curr)
+                else:
+                    self.table.commit(msg.epoch.curr)
                 yield msg
             else:
                 yield msg

@@ -293,7 +293,7 @@ class SortedJoinExecutor(Executor):
         src = jnp.searchsorted(offs, j, side="right").astype(jnp.int32)
         srcc = jnp.clip(src, 0, N - 1)
         prev = jnp.where(srcc > 0, offs[jnp.clip(srcc - 1, 0)], 0)
-        pos = jnp.clip(lo[srcc] + (j - prev), 0, Co - 1)
+        pos = jnp.clip(lo[srcc] + (j - prev), 0, Co - 1).astype(jnp.int32)
         emit = (j < jnp.minimum(total, M)) & (pos < other.n)
         # exact key equality (hash collisions rejected here)
         for kc, oi in zip(key_cols, self.key_indices[1 - side]):
@@ -422,7 +422,8 @@ class SortedJoinExecutor(Executor):
             dsrc = jnp.searchsorted(doffs, j, side="right").astype(jnp.int32)
             dsrcc = jnp.clip(dsrc, 0, N - 1)
             dprev = jnp.where(dsrcc > 0, doffs[jnp.clip(dsrcc - 1, 0)], 0)
-            dpos = jnp.clip(dlo[dsrcc] + (j - dprev), 0, C - 1)
+            dpos = jnp.clip(dlo[dsrcc] + (j - dprev), 0,
+                            C - 1).astype(jnp.int32)
             cand = (j < jnp.minimum(dtot, M)) & keep[dpos]
             for kc, ki in zip(key_cols, key_idx):
                 cand &= own.cols[ki][dpos] == kc[dsrcc].astype(own.cols[ki].dtype)

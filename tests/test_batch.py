@@ -1,0 +1,81 @@
+"""Batch engine: GROUP BY / ORDER BY / LIMIT / join over MVs, checked
+against host recomputation of the same committed snapshot (reference:
+batch/src/executor/{hash_agg,sort,limit,hash_join}.rs).
+"""
+
+import asyncio
+from collections import Counter, defaultdict
+
+import numpy as np
+
+from risingwave_tpu.frontend import Session
+
+
+async def _session():
+    s = Session()
+    await s.execute("CREATE SOURCE bid WITH (connector='nexmark', "
+                    "table='bid', chunk_size=256, rate_limit=1024)")
+    await s.execute("CREATE MATERIALIZED VIEW mv AS SELECT auction, "
+                    "bidder, price FROM bid WHERE price > 1000000")
+    await s.tick(3)
+    base = s.query("SELECT auction, bidder, price FROM mv")
+    assert base
+    return s, base
+
+
+async def test_group_by_order_limit():
+    s, base = await _session()
+    got = s.query("SELECT auction, count(*) FROM mv GROUP BY auction "
+                  "ORDER BY 2 DESC LIMIT 10")
+    counts = Counter(a for a, _, _ in base)
+    expected = sorted(counts.items(), key=lambda kv: -kv[1])[:10]
+    assert sorted(got, key=lambda r: (-r[1], r[0])) == sorted(
+        expected, key=lambda r: (-r[1], r[0]))
+    assert [c for _, c in got] == sorted((c for _, c in got),
+                                         reverse=True)
+    await s.drop_all()
+
+
+async def test_global_aggs_and_avg():
+    s, base = await _session()
+    [(cnt, tot, mn, mx, avg)] = s.query(
+        "SELECT count(*), sum(price), min(price), max(price), "
+        "avg(price) FROM mv")
+    prices = [p for _, _, p in base]
+    assert cnt == len(prices)
+    assert tot == sum(prices)
+    assert mn == min(prices) and mx == max(prices)
+    assert abs(avg - sum(prices) / len(prices)) < 1e-6
+    await s.drop_all()
+
+
+async def test_batch_join_with_residue():
+    s, base = await _session()
+    got = s.query("SELECT a.auction, b.price FROM mv AS a JOIN mv AS b "
+                  "ON a.auction = b.auction "
+                  "WHERE a.price > 9000000 AND b.price > 9500000")
+    by_auction = defaultdict(list)
+    for a, _, p in base:
+        by_auction[a].append(p)
+    expected = Counter()
+    for a, _, p in base:
+        if p > 9000000:
+            for q in by_auction[a]:
+                if q > 9500000:
+                    expected[(a, q)] += 1
+    assert Counter(got) == expected
+    await s.drop_all()
+
+
+async def test_sum_group_and_offset_pagination():
+    s, base = await _session()
+    full = s.query("SELECT auction, sum(price) FROM mv GROUP BY auction "
+                   "ORDER BY 2 DESC, 1")
+    page = s.query("SELECT auction, sum(price) FROM mv GROUP BY auction "
+                   "ORDER BY 2 DESC, 1 LIMIT 3 OFFSET 2")
+    assert page == full[2:5]
+    sums = defaultdict(int)
+    for a, _, p in base:
+        sums[a] += p
+    assert Counter(dict(full)) == Counter(sums)
+    await s.drop_all()

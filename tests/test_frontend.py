@@ -153,5 +153,8 @@ async def test_reject_unsupported_clause():
     import pytest
     from risingwave_tpu.frontend import SqlError
     with pytest.raises(SqlError, match="trailing"):
-        s.query("SELECT auction FROM m ORDER BY auction")
+        s.query("SELECT auction FROM m HAVING auction > 1")
+    # ORDER BY graduated from "unsupported" to the batch engine
+    rows = s.query("SELECT auction FROM m ORDER BY 1 LIMIT 3")
+    assert rows == sorted(rows)
     await s.drop_all()
