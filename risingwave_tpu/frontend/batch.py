@@ -85,32 +85,27 @@ def _hash_join(left: _Rel, right: _Rel, on) -> _Rel:
         rvalid &= right.valids[i]
     lkc = [np.asarray(left.cols[i]) for i in lkeys]
     rkc = [np.asarray(right.cols[i]) for i in rkeys]
-    order = np.lexsort(tuple(reversed(rkc)))
+    if len(lkc) > 1:
+        # composite keys -> ONE dense rank over the combined tuples, so
+        # the probe below stays a single vectorized searchsorted (the
+        # same rank-space trick sorted_join.py uses on device)
+        both = [np.concatenate([l, r]) for l, r in zip(lkc, rkc)]
+        oo = np.lexsort(tuple(reversed(both)))
+        same = np.ones(len(oo) - 1, dtype=bool)
+        for c in both:
+            sc = c[oo]
+            same &= sc[1:] == sc[:-1]
+        run = np.concatenate([[True], ~same])   # new run if ANY col differs
+        rank_sorted = np.cumsum(run) - 1
+        rank = np.empty(len(oo), dtype=np.int64)
+        rank[oo] = rank_sorted
+        lkc = [rank[:left.n]]
+        rkc = [rank[left.n:]]
+    order = np.argsort(rkc[0], kind="stable")
     order = order[rvalid[order]]
-    rs = [k[order] for k in rkc]
-
-    def _bounds(side):
-        lo = np.zeros(left.n, dtype=np.int64)
-        hi = np.zeros(left.n, dtype=np.int64)
-        # successive refinement per key column
-        lo[:] = 0
-        hi[:] = len(order)
-        for lk, rk in zip(lkc, rs):
-            new_lo = np.empty_like(lo)
-            new_hi = np.empty_like(hi)
-            for i in range(left.n):   # refine within current [lo, hi)
-                seg = rk[lo[i]:hi[i]]
-                new_lo[i] = lo[i] + np.searchsorted(seg, lk[i], "left")
-                new_hi[i] = lo[i] + np.searchsorted(seg, lk[i], "right")
-            lo, hi = new_lo, new_hi
-        return lo, hi
-
-    # vectorized single-key fast path; loop fallback for composite keys
-    if len(lkc) == 1:
-        lo = np.searchsorted(rs[0], lkc[0], "left")
-        hi = np.searchsorted(rs[0], lkc[0], "right")
-    else:
-        lo, hi = _bounds(None)
+    rs = [rkc[0][order]]
+    lo = np.searchsorted(rs[0], lkc[0], "left")
+    hi = np.searchsorted(rs[0], lkc[0], "right")
     lens = np.where(lvalid, hi - lo, 0)
     li = np.repeat(np.arange(left.n), lens)
     starts = np.repeat(lo, lens)

@@ -125,8 +125,9 @@ class BoundPlan:
 
 
 class StreamPlanner:
-    def __init__(self, catalog):
+    def __init__(self, catalog, parallelism: int = 1):
         self.catalog = catalog
+        self.parallelism = parallelism   # hash-distributed fragments
         self.graph = StreamGraph()
         self._next_fid = 1
 
@@ -260,6 +261,18 @@ class StreamPlanner:
         raise BindError(f"cannot plan relation {rel!r}")
 
     # -------------------------------------------------------------- select
+    def plan_sink(self, sel: ast.Select, options: dict) -> "BoundPlan":
+        """CREATE SINK: the plan terminates in a sink node instead of a
+        materialize (reference: StreamSink, sink desc from the WITH
+        options)."""
+        fid, names, types, pk_hint, append_only = self._plan_query(sel)
+        frag = self.graph.fragments[fid]
+        from ..common.types import Field
+        frag.root = Node("sink", dict(options), inputs=(frag.root,))
+        out = Schema(tuple(Field(n, t) for n, t in zip(names, types)))
+        return BoundPlan(self.graph, fid, out, tuple(pk_hint or ()),
+                         append_only)
+
     def plan_select(self, sel: ast.Select) -> BoundPlan:
         fid, names, types, pk_hint, append_only = self._plan_query(sel)
         frag = self.graph.fragments[fid]
@@ -418,7 +431,8 @@ class StreamPlanner:
                                  agg_calls=agg_calls, durable=True),
                 inputs=(Exchange(fid),)),
                 dispatch="hash",
-                dist_key_indices=tuple(range(len(keys)))))
+                dist_key_indices=tuple(range(len(keys))),
+                parallelism=self.parallelism))
         else:
             # global aggregation: a singleton SimpleAgg fragment
             # (reference: DistId::Singleton, simple_agg.rs)

@@ -41,6 +41,20 @@ class SourceDef:
 
 
 @dataclass
+class SinkDef:
+    name: str
+    schema: Schema
+    deployment: Deployment
+    sink_fragment: int
+    upstream_taps: tuple = ()
+    sql: str = ""
+
+    @property
+    def executor(self):
+        return self.deployment.roots[self.sink_fragment][0]
+
+
+@dataclass
 class MvDef:
     name: str
     schema: Schema
@@ -52,6 +66,7 @@ class MvDef:
     upstream_taps: tuple = ()          # (upstream MvDef, Channel) to detach
     sql: str = ""                      # original DDL (durable catalog)
     append_only: bool = False          # changelog has no retractions
+    parallelism: int = 1
 
     @property
     def table(self):
@@ -62,6 +77,7 @@ class Catalog:
     def __init__(self):
         self.sources: dict[str, SourceDef] = {}
         self.mvs: dict[str, MvDef] = {}
+        self.sinks: dict[str, SinkDef] = {}
 
     def source(self, name: str) -> SourceDef:
         if name not in self.sources:
@@ -127,9 +143,11 @@ class Session:
             for entry in log:
                 self.env._next_table_id = entry.get(
                     "table_id_floor", self.env._next_table_id)
+                self._replay_parallelism = entry.get("parallelism", 1)
                 await self.execute(entry["sql"])
         finally:
             self._recovering = False
+            self._replay_parallelism = 1
         self._ddl_log = list(log)
         # one Initial barrier over the fully-reattached topology
         if self.catalog.mvs:
@@ -147,11 +165,27 @@ class Session:
                                       "sql": sql_text})
                 self._persist_catalog()
             return out
+        if isinstance(stmt, ast.CreateSink):
+            if stmt.name in self.catalog.sinks:
+                raise BindError(f"sink {stmt.name!r} already exists")
+            floor = self.env._next_table_id   # BEFORE build, like MVs
+            out = await self._create_sink(stmt, sql_text)
+            if not self._recovering:
+                self._ddl_log = [e for e in self._ddl_log if not (
+                    e["kind"] == "sink" and e["name"] == stmt.name)]
+                self._ddl_log.append({"kind": "sink", "name": stmt.name,
+                                      "sql": sql_text,
+                                      "table_id_floor": floor})
+                self._persist_catalog()
+            return out
         if isinstance(stmt, ast.CreateMV):
             if stmt.name in self.catalog.mvs:
                 raise BindError(f"MV {stmt.name!r} already exists")
             floor = self.env._next_table_id
-            out = await self._create_mv(stmt, sql_text)
+            out = await self._create_mv(
+                stmt, sql_text,
+                parallelism=getattr(self, "_replay_parallelism", 1)
+                if self._recovering else 1)
             if not self._recovering:
                 self._ddl_log = [e for e in self._ddl_log if not (
                     e["kind"] == "mv" and e["name"] == stmt.name)]
@@ -160,6 +194,8 @@ class Session:
                                       "table_id_floor": floor})
                 self._persist_catalog()
             return out
+        if isinstance(stmt, ast.AlterParallelism):
+            return await self.alter_parallelism(stmt.name, stmt.parallelism)
         if isinstance(stmt, ast.Select):
             return self.query_select(stmt)
         raise BindError(f"unsupported statement {stmt!r}")
@@ -191,9 +227,13 @@ class Session:
         return src
 
     async def _create_mv(self, stmt: ast.CreateMV,
-                         sql_text: str = "") -> MvDef:
+                         sql_text: str = "",
+                         parallelism: int = 1,
+                         table_id_floor=None) -> MvDef:
         from ..stream import TapDispatcher
-        planner = StreamPlanner(self.catalog)
+        if table_id_floor is not None:
+            self.env._next_table_id = table_id_floor
+        planner = StreamPlanner(self.catalog, parallelism=parallelism)
         plan = planner.plan_select(stmt.select)
         # bring-up holds the rounds lock: actor registration + tap attach
         # must not interleave with an in-flight barrier round (the
@@ -216,7 +256,8 @@ class Session:
                        self.coord, plan.mv_fragment, tap=tap,
                        upstream_taps=tuple(self.env.pending_taps),
                        sql=sql_text,
-                       append_only=getattr(plan, "append_only", False))
+                       append_only=getattr(plan, "append_only", False),
+                       parallelism=parallelism)
             self.catalog.mvs[stmt.name] = mv
         # bring the new dataflow up: the first MV gets the Initial
         # barrier; later MVs initialize on the next ordinary barrier.
@@ -231,6 +272,76 @@ class Session:
         return mv
 
     # ------------------------------------------------------------ runtime
+    async def _create_sink(self, stmt, sql_text: str = "") -> "SinkDef":
+        planner = StreamPlanner(self.catalog)
+        plan = planner.plan_sink(stmt.select, stmt.options)
+        async with self.coord._rounds_lock:
+            self.env.pending_taps = []
+            dep = build_graph(plan.graph, self.env)
+            dep_ids = {a.actor_id for a in dep.actors}
+            for up, ch in self.env.pending_taps:
+                up.tap.set_consumers(ch, dep_ids)
+            dep.spawn()
+            sink = SinkDef(stmt.name, plan.schema, dep, plan.mv_fragment,
+                           upstream_taps=tuple(self.env.pending_taps),
+                           sql=sql_text)
+            self.catalog.sinks[stmt.name] = sink
+        if not self._recovering:
+            await self.coord.run_rounds(
+                0 if not self.coord._started else 1)
+        return sink
+
+    async def alter_parallelism(self, name: str, n: int) -> MvDef:
+        """Online rescale (reference: ALTER ... SET PARALLELISM, riding a
+        meta reschedule — scale.rs:370): stop ONE MV's actors at a barrier
+        (state flushes durably), rebuild its graph with the hash fragments
+        at parallelism n binding the SAME table ids, and resume — other
+        dataflows keep running throughout; the vnode-sliced state tables
+        are re-read per new actor bitmap (state_table.rs:778)."""
+        if name not in self.catalog.mvs:
+            raise BindError(f"unknown MV {name!r}")
+        mv = self.catalog.mvs[name]
+        dependents = [d.name for d in list(self.catalog.mvs.values())
+                      + list(self.catalog.sinks.values())
+                      if any(up.name == name for up, _ in d.upstream_taps)]
+        if dependents:
+            raise BindError(
+                f"cannot rescale {name!r}: {dependents} tap it "
+                f"(drop them first)")
+        entry = next(e for e in self._ddl_log
+                     if e["kind"] == "mv" and e["name"] == name)
+        await mv.deployment.stop()
+        for up, ch in mv.upstream_taps:
+            up.tap.remove(ch)
+        del self.catalog.mvs[name]
+        stmt = ast.parse(entry["sql"])
+        self._recovering = True     # suppress log append inside execute
+        saved_next_tid = self.env._next_table_id
+        try:
+            out = await self._create_mv(
+                stmt, entry["sql"], parallelism=n,
+                table_id_floor=entry["table_id_floor"])
+        finally:
+            self._recovering = False
+            # the rebuild rewound the allocator to the MV's old floor;
+            # restore the high-watermark or later DDL would hand out
+            # table ids already owned by OTHER live MVs
+            self.env._next_table_id = max(self.env._next_table_id,
+                                          saved_next_tid)
+        entry["parallelism"] = n
+        self._persist_catalog()
+        await self.coord.run_rounds(1)
+        return out
+
+    async def drop_sink(self, name: str) -> None:
+        sink = self.catalog.sinks.pop(name)
+        await sink.deployment.stop()
+        for up, ch in sink.upstream_taps:
+            up.tap.remove(ch)
+        self._ddl_log = [e for e in self._ddl_log
+                         if not (e["kind"] == "sink" and e["name"] == name)]
+        self._persist_catalog()
+
     async def tick(self, rounds: int = 1,
                    interval_s: Optional[float] = None,
                    max_recoveries: int = 3) -> None:
@@ -241,7 +352,7 @@ class Session:
         DDL log, resume from the last committed epoch — and the tick is
         retried; no operator in the loop (reference:
         meta/src/barrier/recovery.rs:332-625)."""
-        if not self.catalog.mvs:
+        if not self.catalog.mvs and not self.catalog.sinks:
             return
         attempts = 0
         while True:
@@ -268,15 +379,18 @@ class Session:
         self.env = BuildEnv(self.store, self.coord)
         self.env.session = self
         self.catalog.mvs.clear()
+        self.catalog.sinks.clear()
         log = list(self._ddl_log)
         self._recovering = True
         try:
             for entry in log:
                 self.env._next_table_id = entry.get(
                     "table_id_floor", self.env._next_table_id)
+                self._replay_parallelism = entry.get("parallelism", 1)
                 await self.execute(entry["sql"])
         finally:
             self._recovering = False
+            self._replay_parallelism = 1
         self._ddl_log = log
         await self.coord.run_rounds(0)
 
@@ -284,11 +398,12 @@ class Session:
         """Stop one MV's actors and detach its upstream taps. MVs that
         READ this one must be dropped first (the reference rejects
         dropping a relation with dependents)."""
-        dependents = [d.name for d in self.catalog.mvs.values()
+        dependents = [d.name for d in list(self.catalog.mvs.values())
+                      + list(self.catalog.sinks.values())
                       if any(up.name == name for up, _ in d.upstream_taps)]
         if dependents:
             raise BindError(
-                f"cannot drop {name!r}: MV(s) {dependents} read it")
+                f"cannot drop {name!r}: {dependents} read it")
         mv = self.catalog.mvs.pop(name)
         await mv.deployment.stop()
         for up, ch in mv.upstream_taps:
@@ -301,8 +416,9 @@ class Session:
         """Abandon every actor task WITHOUT the stop protocol — the
         process-kill simulation used by restart/recovery tests. Catalog
         and store are left as-is (a real crash persists both)."""
-        for mv in self.catalog.mvs.values():
-            for t in mv.deployment.tasks:
+        for d in (list(self.catalog.mvs.values())
+                  + list(self.catalog.sinks.values())):
+            for t in d.deployment.tasks:
                 if not t.done():
                     t.cancel()
                 try:
@@ -311,6 +427,8 @@ class Session:
                     pass
 
     async def drop_all(self) -> None:
+        for name in reversed(list(self.catalog.sinks)):
+            await self.drop_sink(name)
         # reverse creation order: downstream MVs tap upstream ones
         for name in reversed(list(self.catalog.mvs)):
             await self.drop_mv(name)
@@ -320,6 +438,11 @@ class Session:
         durable catalog and state stay for the next incarnation (the
         playground's exit path under --data; drop_all would erase the
         DDL log)."""
+        for name in reversed(list(self.catalog.sinks)):
+            sink = self.catalog.sinks.pop(name)
+            await sink.deployment.stop()
+            for up, ch in sink.upstream_taps:
+                up.tap.remove(ch)
         for name in reversed(list(self.catalog.mvs)):
             mv = self.catalog.mvs[name]
             await mv.deployment.stop()

@@ -24,7 +24,8 @@ KEYWORDS = {
     "select", "from", "where", "group", "by", "as", "create",
     "materialized", "view", "source", "with", "join", "on", "and", "or",
     "not", "tumble", "hop", "count", "sum", "min", "max", "avg", "limit",
-    "order", "desc", "asc", "offset", "between", "emit", "table",
+    "order", "desc", "asc", "offset", "between", "emit", "table", "sink",
+    "alter", "set", "parallelism",
 }
 
 _TOKEN_RE = re.compile(r"""
@@ -159,6 +160,19 @@ class CreateMV:
     select: Select
 
 
+@dataclass
+class CreateSink:
+    name: str
+    select: Select
+    options: dict
+
+
+@dataclass
+class AlterParallelism:
+    name: str
+    parallelism: int
+
+
 # --------------------------------------------------------------- parser
 
 class Parser:
@@ -195,9 +209,36 @@ class Parser:
         return stmt
 
     def _statement(self):
+        if self.accept("kw", "alter"):
+            self.expect("kw", "materialized")
+            self.expect("kw", "view")
+            name = self.expect("ident").val
+            self.expect("kw", "set")
+            self.expect("kw", "parallelism")
+            self.expect("op", "=")
+            n = int(self.expect("num").val)
+            self.accept("op", ";")
+            return AlterParallelism(name, n)
         if self.accept("kw", "create"):
             if self.accept("kw", "source") or self.accept("kw", "table"):
                 return self._create_source()
+            if self.accept("kw", "sink"):
+                name = self.expect("ident").val
+                self.expect("kw", "as")
+                sel = self._select()
+                self.expect("kw", "with")
+                self.expect("op", "(")
+                opts = {}
+                while True:
+                    k = self.next().val
+                    self.expect("op", "=")
+                    t = self.next()
+                    opts[k] = int(t.val) if t.kind == "num" else t.val
+                    if not self.accept("op", ","):
+                        break
+                self.expect("op", ")")
+                self.accept("op", ";")
+                return CreateSink(name, sel, opts)
             self.expect("kw", "materialized")
             self.expect("kw", "view")
             name = self.expect("ident").val

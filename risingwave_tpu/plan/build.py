@@ -443,6 +443,24 @@ def _build_stream_scan(args, inputs, ctx: ActorCtx, key):
         batch_rows=args.get("batch_rows", 65536))
 
 
+@register_builder("sink")
+def _build_sink(args, inputs, ctx: ActorCtx, key):
+    from ..stream.sink import (BlackholeSink, CallbackSink, FileSink,
+                               SinkExecutor)
+    connector = args.get("connector", "blackhole")
+    if connector == "blackhole":
+        target = BlackholeSink()
+    elif connector == "file":
+        target = FileSink(args["path"], schema=inputs[0].schema)
+    elif connector == "callback":
+        target = CallbackSink(args["callback"])
+    else:
+        raise ValueError(f"unknown sink connector {connector!r}")
+    force = args.get("type") == "append-only" or str(
+        args.get("force_append_only", "")).lower() in ("true", "1")
+    return SinkExecutor(inputs[0], target, force_append_only=force)
+
+
 @register_builder("materialize")
 def _build_materialize(args, inputs, ctx: ActorCtx, key):
     tid = ctx.table_id(key)

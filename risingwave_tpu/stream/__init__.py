@@ -18,6 +18,7 @@ from .hash_join import HashJoinExecutor
 from .sorted_join import SortedJoinExecutor
 from .sharded_join import ShardedSortedJoinExecutor
 from .backfill import BackfillExecutor
+from .sink import (SinkExecutor, BlackholeSink, FileSink, CallbackSink)
 from .align import barrier_align
 from .hop_window import HopWindowExecutor
 from .dedup import AppendOnlyDedupExecutor

@@ -401,13 +401,12 @@ class HashJoinExecutor(Executor):
             if self._dirty_since_flush[s]:
                 cols, ops, vis = self._persist_view(self.sides[s])
                 vis_np = np.asarray(vis)
-                n = int(vis_np.sum())
-                if n:
-                    cols_np = [np.asarray(c)[vis_np] for c in cols]
-                    ops_np = np.asarray(ops)[vis_np]
-                    rows = [(int(ops_np[r]), tuple(c[r].item() for c in cols_np))
-                            for r in range(n)]
-                    st.write_chunk_rows(rows)
+                if vis_np.any():
+                    # columnar batch write (state_table.rs:946): the C++
+                    # codec path, no per-row Python on the barrier
+                    st.write_chunk_columns(
+                        np.asarray(ops), [np.asarray(c) for c in cols],
+                        vis_np)
                 side = self.sides[s]
                 self.sides[s] = JoinSideState(
                     side.key_table, side.head, side.rows, side.valids,
@@ -423,10 +422,11 @@ class HashJoinExecutor(Executor):
         n = int(n)
         if not n:
             return
-        cols_np = [np.asarray(c)[:n] for c in cols]
-        rows = [(int(OP_DELETE), tuple(c[r].item() for c in cols_np))
-                for r in range(n)]
-        self.state_tables[s].write_chunk_rows(rows)
+        cols_np = [np.asarray(c) for c in cols]
+        vis = np.zeros(len(cols_np[0]), dtype=bool)
+        vis[:n] = True
+        self.state_tables[s].write_chunk_columns(
+            np.full(len(vis), OP_DELETE, dtype=np.int8), cols_np, vis)
 
     def _evict_rows_impl(self, side_state: JoinSideState, wm, side: int):
         col = self.clean_cols[side]

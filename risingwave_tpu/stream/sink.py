@@ -1,0 +1,145 @@
+"""Sink executor — changelog egress with exactly-once epoch commits.
+
+Reference: src/connector/src/sink/ (trait Sink + 12 connectors; mod.rs)
+and the sink executor (stream/src/executor/sink.rs) with log-store
+decoupling: rows buffer per epoch and deliver transactionally at the
+checkpoint barrier, so a crash replays from the last committed epoch and
+the target never sees a half-epoch.
+
+Targets here:
+  * BlackholeSink   — counts rows (the reference's blackhole connector,
+                      the benchmark egress)
+  * FileSink        — newline-delimited JSON, one record per epoch with
+                      the epoch id embedded; re-delivery after recovery
+                      dedupes by epoch (append-only file = the log)
+  * CallbackSink    — hands (epoch, rows) to a Python callable
+                      (embedding/integration egress)
+
+Delivery contract: `write(epoch, rows)` with rows = list of (op, values)
+in changelog order, called once per epoch at its CHECKPOINT barrier,
+ascending epochs; `committed_epoch()` lets the executor skip epochs the
+target already has (exactly-once across restarts)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Optional
+
+from ..common.chunk import StreamChunk, OP_DELETE, OP_INSERT, OP_UPDATE_INSERT
+from ..common.types import GLOBAL_DICT, DataType
+from .executor import Executor
+from .message import Barrier, BarrierKind, Watermark
+
+
+class SinkTarget:
+    def write(self, epoch: int, rows: list) -> None:
+        raise NotImplementedError
+
+    def committed_epoch(self) -> int:
+        return 0
+
+
+class BlackholeSink(SinkTarget):
+    def __init__(self):
+        self.rows_written = 0
+        self.epochs = 0
+
+    def write(self, epoch: int, rows: list) -> None:
+        self.rows_written += len(rows)
+        self.epochs += 1
+
+
+class CallbackSink(SinkTarget):
+    def __init__(self, fn: Callable[[int, list], None]):
+        self.fn = fn
+
+    def write(self, epoch: int, rows: list) -> None:
+        self.fn(epoch, rows)
+
+
+class FileSink(SinkTarget):
+    """JSONL with per-epoch records: {"epoch": E, "rows": [[op, [...]], ...]}.
+    The append-only file doubles as the delivery log: recovery reads the
+    last epoch and skips re-delivered ones (exactly-once)."""
+
+    def __init__(self, path: str, schema=None):
+        self.path = path
+        self.schema = schema
+        self._committed = 0
+        if os.path.exists(path):
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    if line.strip():
+                        self._committed = max(
+                            self._committed, json.loads(line)["epoch"])
+
+    def _decode(self, values) -> list:
+        if self.schema is None:
+            return list(values)
+        return [GLOBAL_DICT.decode(v)
+                if f.data_type is DataType.VARCHAR and v is not None else v
+                for v, f in zip(values, self.schema)]
+
+    def write(self, epoch: int, rows: list) -> None:
+        rec = {"epoch": epoch,
+               "rows": [[op, self._decode(vals)] for op, vals in rows]}
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self._committed = epoch
+
+    def committed_epoch(self) -> int:
+        return self._committed
+
+
+class SinkExecutor(Executor):
+    """Terminal executor: buffers the epoch's changelog on the host and
+    delivers it at the barrier (rows leave the system here, so the d2h
+    transfer is inherent — it happens at barrier cadence, not per chunk)."""
+
+    def __init__(self, input: Executor, target: SinkTarget,
+                 force_append_only: bool = False):
+        self.input = input
+        self.schema = input.schema
+        self.pk_indices = input.pk_indices
+        self.target = target
+        self.force_append_only = force_append_only
+        self.identity = f"Sink({type(target).__name__})"
+        self._buf: list[StreamChunk] = []
+        self.rows_delivered = 0
+
+    def _drain(self, epoch: int) -> None:
+        rows: list = []
+        for chunk in self._buf:
+            for op, vals in chunk.to_rows():
+                if self.force_append_only:
+                    if op in (OP_INSERT, OP_UPDATE_INSERT):
+                        rows.append((OP_INSERT, vals))
+                else:
+                    rows.append((op, vals))
+        self._buf = []
+        if epoch <= self.target.committed_epoch():
+            return                      # replayed epoch: already delivered
+        self.target.write(epoch, rows)
+        self.rows_delivered += len(rows)
+
+    async def execute(self):
+        first = True
+        async for msg in self.input.execute():
+            if isinstance(msg, StreamChunk):
+                self._buf.append(msg)
+                yield msg
+            elif isinstance(msg, Barrier):
+                if first or msg.kind is BarrierKind.INITIAL:
+                    first = False
+                    self._buf = []
+                    yield msg
+                    continue
+                if msg.kind is BarrierKind.CHECKPOINT:
+                    # the epoch SEALED by this barrier is epoch.prev
+                    self._drain(msg.epoch.prev)
+                yield msg
+            else:
+                yield msg

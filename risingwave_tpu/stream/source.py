@@ -86,16 +86,20 @@ class SourceExecutor(Executor):
     def _recover_offset(self) -> None:
         if self.state_table is None:
             return
-        row = self.state_table.get_row((self.source_id,))
+        # constant slot key: the offset table is exclusive to this source
+        # NODE; actor ids are NOT stable across rebuilds (rescale/recovery
+        # reallocate them), so keying by actor id would orphan the offset
+        # and silently replay the stream from 0
+        row = self.state_table.get_row((0,))
         if row is not None:
             self.connector.seek(row[1])
 
     def _commit_offset(self, barrier: Barrier) -> None:
         if self.state_table is None:
             return
-        # upsert (source_id, next_offset); offset rides the same epoch commit
+        # upsert (slot, next_offset); offset rides the same epoch commit
         # as operator state => exactly-once resume.
-        self.state_table.write_chunk_rows([(0, (self.source_id, self.connector.offset))])
+        self.state_table.write_chunk_rows([(0, (0, self.connector.offset))])
         self.state_table.commit(barrier.epoch.curr)
 
     async def execute(self):
@@ -104,8 +108,10 @@ class SourceExecutor(Executor):
         barrier = await self.barrier_queue.get()
         if self.state_table is not None:
             self.state_table.init_epoch(barrier.epoch.curr)
-        if barrier.kind is BarrierKind.INITIAL:
-            self._recover_offset()
+        # recover on the FIRST observed barrier whatever its kind: a
+        # rescale/MV-on-MV rebuild joins a running epoch stream where the
+        # Initial barrier happened long ago
+        self._recover_offset()
         self.paused = barrier.is_pause()
         yield barrier
 

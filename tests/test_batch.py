@@ -79,3 +79,18 @@ async def test_sum_group_and_offset_pagination():
         sums[a] += p
     assert Counter(dict(full)) == Counter(sums)
     await s.drop_all()
+
+
+async def test_batch_join_composite_key():
+    s, base = await _session()
+    got = s.query("SELECT a.auction, a.price FROM mv AS a JOIN mv AS b "
+                  "ON a.auction = b.auction AND a.bidder = b.bidder "
+                  "AND a.price = b.price WHERE a.price > 9000000")
+    rows = Counter((a, b, p) for a, b, p in base)
+    expected = Counter()
+    for (a, b, p), cnt in rows.items():
+        if p > 9000000:
+            expected[(a, p)] += cnt * cnt   # self-join multiplicity
+    assert Counter(got) == expected
+    assert got
+    await s.drop_all()

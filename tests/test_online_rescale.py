@@ -1,0 +1,92 @@
+"""Online rescale: ALTER MATERIALIZED VIEW ... SET PARALLELISM rebinds the
+hash-agg fragment at a new parallelism mid-stream with no lost or
+duplicated rows; other dataflows keep running (reference:
+meta/src/stream/scale.rs:370 + state_table.rs:778 vnode rebinding).
+"""
+
+import asyncio
+from collections import Counter, defaultdict
+
+import numpy as np
+
+from risingwave_tpu.frontend import Session
+from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+from risingwave_tpu.state.storage_table import StorageTable
+from risingwave_tpu.stream.source import SourceExecutor
+
+
+def _committed_offset(session, mv_name):
+    mv = session.catalog.mvs[mv_name]
+    for roots in mv.deployment.roots.values():
+        for root in roots:
+            node = root
+            while node is not None:
+                if isinstance(node, SourceExecutor) \
+                        and node.state_table is not None:
+                    rows = list(StorageTable.for_state_table(
+                        node.state_table).batch_iter())
+                    return int(rows[0][1]) if rows else 0
+                node = getattr(node, "input", None)
+    return 0
+
+
+def _oracle_counts(offset):
+    from risingwave_tpu.connectors import NexmarkGenerator
+    gen = NexmarkGenerator("bid", chunk_size=max(256, offset))
+    c = gen.next_chunk()
+    bidder = np.asarray(c.columns[1].data)[:offset]
+    counts = defaultdict(int)
+    for b in bidder:
+        counts[int(b) % 8] += 1
+    return dict(counts)
+
+
+async def test_alter_parallelism_mid_stream(tmp_path):
+    store = HummockStateStore(LocalFsObjectStore(str(tmp_path / "d")))
+    s = Session(store=store)
+    await s.execute("CREATE SOURCE bid WITH (connector='nexmark', "
+                    "table='bid', chunk_size=128, rate_limit=256)")
+    await s.execute("CREATE MATERIALIZED VIEW agg AS SELECT bidder % 8 "
+                    "AS k, count(*) AS n FROM bid GROUP BY bidder % 8")
+    await s.tick(3)
+
+    await s.execute("ALTER MATERIALIZED VIEW agg SET PARALLELISM = 4")
+    assert s.catalog.mvs["agg"].parallelism == 4
+    # the agg fragment now has 4 actors
+    dep = s.catalog.mvs["agg"].deployment
+    assert max(len(roots) for roots in dep.roots.values()) == 4
+    await s.tick(3)
+
+    got = dict(s.query("SELECT k, n FROM agg"))
+    offset = _committed_offset(s, "agg")
+    assert got == _oracle_counts(offset), "rescale lost or duplicated rows"
+
+    # scale back down mid-stream
+    await s.execute("ALTER MATERIALIZED VIEW agg SET PARALLELISM = 2")
+    await s.tick(2)
+    got = dict(s.query("SELECT k, n FROM agg"))
+    offset = _committed_offset(s, "agg")
+    assert got == _oracle_counts(offset)
+    await s.drop_all()
+
+
+async def test_rescale_survives_restart(tmp_path):
+    d = str(tmp_path / "d")
+    s = Session(store=HummockStateStore(LocalFsObjectStore(d)))
+    await s.execute("CREATE SOURCE bid WITH (connector='nexmark', "
+                    "table='bid', chunk_size=128, rate_limit=256)")
+    await s.execute("CREATE MATERIALIZED VIEW agg AS SELECT bidder % 8 "
+                    "AS k, count(*) AS n FROM bid GROUP BY bidder % 8")
+    await s.tick(2)
+    await s.execute("ALTER MATERIALIZED VIEW agg SET PARALLELISM = 4")
+    await s.tick(2)
+    await s.crash()
+
+    s2 = Session(store=HummockStateStore(LocalFsObjectStore(d)))
+    await s2.recover()
+    assert s2.catalog.mvs["agg"].parallelism == 4
+    await s2.tick(2)
+    got = dict(s2.query("SELECT k, n FROM agg"))
+    offset = _committed_offset(s2, "agg")
+    assert got == _oracle_counts(offset)
+    await s2.drop_all()

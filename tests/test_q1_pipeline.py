@@ -69,7 +69,7 @@ async def test_q1_end_to_end():
     # with tolerance, not equality
     np.testing.assert_allclose(got, sorted(expected), rtol=1e-12)
     # offsets committed for recovery
-    off = offset_table.get_row((1,))
+    off = offset_table.get_row((0,))
     assert off is not None and off[1] == gen.offset
     # barrier latency metric recorded
     assert len(coord.latencies_ns) >= 4
@@ -86,7 +86,7 @@ async def test_q1_source_recovery():
     await coord.run_rounds(2)
     await coord.stop_all({1})
     await task
-    committed_offset = offset_table.get_row((1,))[1]
+    committed_offset = offset_table.get_row((0,))[1]
 
     # "restart": fresh executors over the same store — source must resume
     barrier_q2, gen2, mat2, mv2, offset2 = build_q1(store)
