@@ -53,7 +53,7 @@ QUERY_BUDGET_S = {"q1": 60.0, "q5": 150.0, "q7": 150.0, "q8": 170.0}
 # orchestrator computes all four baselines in PARALLEL CPU subprocesses
 # while the device queries run serially.
 BASELINE_CHUNKS = {"q1": (16, 131072), "q5": (8, 131072),
-                   "q7": (8, 131072), "q8": (8, 196608)}
+                   "q7": (8, 131072), "q8": (8, 393216)}
 # Target duration of the timed measurement region per query.
 MEASURE_S = 8.0
 
@@ -428,7 +428,7 @@ async def bench_q8(progress: dict) -> None:
     )
 
     W = 10_000_000
-    p_chunk, a_chunk = 49152, 147456    # 1:3, equal event-time spans
+    p_chunk, a_chunk = 98304, 294912    # 1:3, equal event-time spans
     cfg = NexmarkConfig(inter_event_us=100)
     store = MemoryStateStore()
     q_p, q_a = asyncio.Queue(), asyncio.Queue()
@@ -450,6 +450,9 @@ async def bench_q8(progress: dict) -> None:
         names=["seller", "window_start"],
         watermark_transforms={5: (1, lambda v: v - v % W)})
     ch_p, ch_a = Channel(64), Channel(64)
+    # capacity: one in-flight auction chunk (295k) + live window rows
+    # fits 2^19 at the 0.7 threshold; the per-chunk merge is O(capacity),
+    # so larger chunks amortize it
     join = SortedJoinExecutor(
         ChannelInput(ch_p, pp.schema), ChannelInput(ch_a, pa.schema),
         left_key_indices=[0, 1], right_key_indices=[0, 1],

@@ -91,7 +91,7 @@ def _hash_join(left: _Rel, right: _Rel, on) -> _Rel:
         # same rank-space trick sorted_join.py uses on device)
         both = [np.concatenate([l, r]) for l, r in zip(lkc, rkc)]
         oo = np.lexsort(tuple(reversed(both)))
-        same = np.ones(len(oo) - 1, dtype=bool)
+        same = np.ones(max(0, len(oo) - 1), dtype=bool)
         for c in both:
             sc = c[oo]
             same &= sc[1:] == sc[:-1]

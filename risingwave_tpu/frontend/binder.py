@@ -299,10 +299,14 @@ class StreamPlanner:
         out names, out DataTypes, pk_hint, append_only) — pk_hint is the
         output positions forming the stream key, or None when the stream
         is keyless append-only (caller adds a row_id)."""
-        if sel.order_by or sel.limit is not None or sel.offset:
+        top_spec = (list(sel.order_by), sel.limit, sel.offset)
+        want_top_n = sel.limit is not None
+        if (sel.order_by or sel.offset) and not want_top_n:
             raise BindError(
-                "streaming plans do not support ORDER BY/LIMIT/OFFSET "
-                "(use them in batch SELECTs over the MV)")
+                "streaming ORDER BY needs a LIMIT (a TopN MV); unbounded "
+                "ORDER BY belongs in batch SELECTs over the MV")
+        if want_top_n and not sel.order_by:
+            raise BindError("streaming LIMIT needs ORDER BY (TopN)")
         # comma join: FROM a, b WHERE ... — the join condition lives in
         # WHERE; hoist it into ON (single 2-way comma join supported)
         rel, where = sel.rel, sel.where
@@ -333,7 +337,10 @@ class StreamPlanner:
             if info.append_only:
                 frag.root = Node("project", dict(exprs=exprs, names=names),
                                  inputs=(frag.root,))
-                return fid, names, [e.ret_type for e in exprs], None, True
+                out = (fid, names, [e.ret_type for e in exprs], None, True)
+                if want_top_n:
+                    out = self._plan_top_n(top_spec, out)
+                return out
             # retracting input: its stream key must survive projection so
             # deletes keep addressing the same rows (the reference appends
             # hidden stream-key columns the same way)
@@ -354,11 +361,51 @@ class StreamPlanner:
                 key_pos.append(found)
             frag.root = Node("project", dict(exprs=exprs, names=names),
                              inputs=(frag.root,))
-            return (fid, names, [e.ret_type for e in exprs],
-                    tuple(key_pos), False)
+            out = (fid, names, [e.ret_type for e in exprs],
+                   tuple(key_pos), False)
+            if want_top_n:
+                out = self._plan_top_n(top_spec, out)
+            return out
 
         out = self._plan_agg(sel, fid, scope)
-        return out + (False,)
+        out = out + (False,)
+        if want_top_n:
+            out = self._plan_top_n(top_spec, out)
+        return out
+
+    def _plan_top_n(self, top_spec, planned):
+        """Streaming ORDER BY + LIMIT -> RetractableTopN over the query's
+        changelog (reference: StreamTopN; retraction-capable because the
+        input may be an agg/join changelog)."""
+        order_by, limit, offset = top_spec
+        fid, names, types, pk_hint, append_only = planned
+        frag = self.graph.fragments[fid]
+        if len(order_by) != 1:
+            raise BindError("streaming TopN supports one ORDER BY key")
+        e, desc = order_by[0]
+        idx = None
+        if isinstance(e, ast.Lit) and isinstance(e.value, int):
+            idx = e.value - 1
+        elif isinstance(e, ast.ColRef) and e.qualifier is None \
+                and e.name in names:
+            idx = names.index(e.name)
+        if idx is None or not 0 <= idx < len(names):
+            raise BindError("streaming ORDER BY must name an output column")
+        if pk_hint is None:
+            raise BindError(
+                "streaming TopN over a keyless stream is unsupported "
+                "(add GROUP BY or aggregate first)")
+        # the TopN is a SINGLETON fragment downstream of the (possibly
+        # hash-parallel) input: per-shard top-Ns would union to up to
+        # limit*parallelism wrong rows (reference: StreamTopN is a
+        # singleton below the hash agg)
+        frag.dispatch = "simple" if frag.parallelism == 1 else frag.dispatch
+        top = self.graph.add(Fragment(self.fid(), Node(
+            "retract_top_n", dict(
+                group_key_indices=(), order_col=idx, limit=limit,
+                offset=offset, descending=desc, durable=True),
+            inputs=(Exchange(fid),)), dispatch="simple"))
+        return top.fid, names, types, pk_hint, False
 
     def _plan_agg(self, sel: ast.Select, fid: int, scope: Scope):
         from ..common.types import Field

@@ -227,16 +227,7 @@ class Parser:
                 self.expect("kw", "as")
                 sel = self._select()
                 self.expect("kw", "with")
-                self.expect("op", "(")
-                opts = {}
-                while True:
-                    k = self.next().val
-                    self.expect("op", "=")
-                    t = self.next()
-                    opts[k] = int(t.val) if t.kind == "num" else t.val
-                    if not self.accept("op", ","):
-                        break
-                self.expect("op", ")")
+                opts = self._with_options()
                 self.accept("op", ";")
                 return CreateSink(name, sel, opts)
             self.expect("kw", "materialized")
@@ -253,6 +244,11 @@ class Parser:
     def _create_source(self) -> CreateSource:
         name = self.expect("ident").val
         self.expect("kw", "with")
+        opts = self._with_options()
+        self.accept("op", ";")
+        return CreateSource(name, opts)
+
+    def _with_options(self) -> dict:
         self.expect("op", "(")
         opts = {}
         while True:
@@ -263,8 +259,7 @@ class Parser:
             if not self.accept("op", ","):
                 break
         self.expect("op", ")")
-        self.accept("op", ";")
-        return CreateSource(name, opts)
+        return opts
 
     def _select(self) -> Select:
         self.expect("kw", "select")

@@ -443,6 +443,24 @@ def _build_stream_scan(args, inputs, ctx: ActorCtx, key):
         batch_rows=args.get("batch_rows", 65536))
 
 
+@register_builder("retract_top_n")
+def _build_retract_top_n(args, inputs, ctx: ActorCtx, key):
+    from ..stream.retract_top_n import RetractableTopNExecutor
+    st = None
+    if args.get("durable"):
+        pk = tuple(inputs[0].pk_indices) or tuple(
+            range(len(inputs[0].schema)))
+        st = ctx.env.state_table(ctx.table_id(key), inputs[0].schema, pk,
+                                 vnode_bitmap=ctx.vnode_bitmap)
+    return RetractableTopNExecutor(
+        inputs[0], args.get("group_key_indices", ()),
+        args["order_col"], args["limit"], offset=args.get("offset", 0),
+        descending=args.get("descending", False),
+        capacity=args.get("capacity", 1 << 14),
+        state_table=st,
+        watchdog_interval=args.get("watchdog_interval", 1))
+
+
 @register_builder("sink")
 def _build_sink(args, inputs, ctx: ActorCtx, key):
     from ..stream.sink import (BlackholeSink, CallbackSink, FileSink,

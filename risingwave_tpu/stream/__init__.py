@@ -24,6 +24,7 @@ from .hop_window import HopWindowExecutor
 from .dedup import AppendOnlyDedupExecutor
 from .simple_agg import SimpleAggExecutor, StatelessSimpleAggExecutor
 from .top_n import GroupTopNExecutor, top_n
+from .retract_top_n import RetractableTopNExecutor
 from .sort import SortExecutor
 from .over_window import OverWindowExecutor, ROW_NUMBER
 from .misc import (

@@ -1,0 +1,312 @@
+"""Retractable (Group)TopN — full-input sorted state, per-barrier diff.
+
+Reference: src/stream/src/executor/top_n/ (top_n_cache.rs): the
+retractable path persists ALL input rows so a deleted top row can be
+refilled from below; the cache keeps the top-K hot. The append-only
+variant lives in top_n.py; THIS executor handles retracting inputs
+(e.g. TopN over an aggregation's changelog).
+
+TPU re-design: the whole live input lives in a dense array store sorted
+by a 63-bit hash of the ROW KEY (the stream key — retractions address
+rows by it), maintained with the same searchsorted/merge machinery as
+sorted_join.py's own-side update. Nothing data-dependent per chunk.
+At each barrier the flush program:
+
+  1. lexsorts live rows by (group hash, order key, row key) — iterated
+     stable argsorts, compile-friendly;
+  2. ranks rows within their group runs (cummax over run starts);
+  3. selects ranks in [offset, offset+limit) as the NEW top set;
+  4. diffs it against the LAST EMITTED top set by full-row hash
+     membership (two searchsorteds) and emits Deletes for dropped rows
+     and Inserts for new ones — refill-from-below falls out naturally:
+     when a top row is retracted, rank promotion pulls the next row in
+     and the diff emits it.
+
+v1 scope: device-resident (durable TopN remains the append-only
+GroupTopNExecutor; this one serves retracting inputs).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.chunk import Column, StreamChunk, OP_DELETE, OP_INSERT, op_sign
+from ..ops.hash_table import stable_lexsort
+from .executor import Executor, StatefulUnaryExecutor
+from .message import Barrier, Watermark
+from .sorted_join import _HSENTINEL, key_hash
+
+
+class RetractableTopNExecutor(StatefulUnaryExecutor):
+    """Output: the rows whose rank within their group (by order_col,
+    direction) falls in [offset, offset+limit), maintained incrementally
+    under inserts AND retractions."""
+
+    def __init__(self, input: Executor,
+                 group_key_indices: Sequence[int],
+                 order_col: int, limit: int, offset: int = 0,
+                 descending: bool = False,
+                 capacity: int = 1 << 14,
+                 state_table=None,
+                 watchdog_interval: Optional[int] = 1):
+        self.input = input
+        self.schema = input.schema
+        self.pk_indices = tuple(input.pk_indices) or tuple(
+            range(len(input.schema)))
+        self.group_key_indices = tuple(group_key_indices)
+        self.order_col = order_col
+        self.limit = limit
+        self.offset = offset
+        self.descending = descending
+        self.capacity = capacity
+        self.identity = (f"RetractTopN(g={self.group_key_indices}, "
+                         f"by={order_col}, k={limit})")
+        C = capacity
+        dts = tuple(f.data_type.jnp_dtype for f in input.schema)
+        self._col_dtypes = dts
+        # dense store sorted by row-key hash
+        self.khash = jnp.full(C, _HSENTINEL, dtype=jnp.int64)
+        self.cols = tuple(jnp.zeros(C, dtype=dt) for dt in dts)
+        self.valids = tuple(jnp.zeros(C, dtype=bool) for _ in dts)
+        self.n = jnp.int32(0)
+        # last emitted top set, as a sorted array of full-row hashes plus
+        # the row payloads (for emitting deletes)
+        self.top_hash = jnp.full(C, _HSENTINEL, dtype=jnp.int64)
+        self.top_cols = tuple(jnp.zeros(C, dtype=dt) for dt in dts)
+        self.top_valids = tuple(jnp.zeros(C, dtype=bool) for _ in dts)
+        self.top_n = jnp.int32(0)
+        self._errs_dev = jnp.zeros(2, dtype=jnp.int32)  # [row_ovf, del_miss]
+        self._apply = jax.jit(self._apply_impl)
+        self._flush = jax.jit(self._flush_impl)
+        # durability: the state table materializes the FULL input row set
+        # keyed by the stream key (the reference's TopN state table holds
+        # all input rows too, top_n_state.rs); each epoch's buffered
+        # chunks apply to it at the barrier, recovery re-inserts them
+        self._epoch_chunks: list[StreamChunk] = []
+        self._init_stateful(state_table, watchdog_interval)
+
+    # ------------------------------------------------------------- apply
+    def _apply_impl(self, khash, cols, valids, n, errs, chunk: StreamChunk):
+        """Insert/retract chunk rows into the sorted dense store (the
+        own-side update of sorted_join._apply_impl, sans probe)."""
+        N = chunk.capacity
+        C = self.capacity
+        pk_idx = self.pk_indices
+        active = chunk.vis
+        signs = op_sign(chunk.ops)
+        row_ids = jnp.arange(N, dtype=jnp.int32)
+        h = key_hash([chunk.columns[i].data for i in pk_idx])
+
+        # within-chunk pk-run netting (sorted_join semantics)
+        sort_keys = [row_ids]
+        for p in pk_idx:
+            sort_keys.append(chunk.columns[p].data)
+        sort_keys.append(~active)
+        order = stable_lexsort(tuple(sort_keys))
+        s_act = active[order]
+        same = s_act[1:] & s_act[:-1]
+        for p in pk_idx:
+            d = chunk.columns[p].data[order]
+            same = same & (d[1:] == d[:-1])
+        run_start = jnp.concatenate([jnp.array([True]), ~same])
+        run_end = jnp.concatenate([~same, jnp.array([True])])
+        s_signs = signs[order]
+        is_del = jnp.zeros(N, dtype=bool).at[order].set(
+            run_start & (s_signs < 0) & s_act)
+        is_ins = jnp.zeros(N, dtype=bool).at[order].set(
+            run_end & (s_signs > 0) & s_act)
+
+        live = jnp.arange(C, dtype=jnp.int32) < n
+        keep = live
+        # deletes: exact (hash, pk) match
+        dlo = jnp.searchsorted(khash, h, side="left").astype(jnp.int32)
+        dhi = jnp.searchsorted(khash, h, side="right").astype(jnp.int32)
+        M = 2 * N
+        dlens = jnp.where(is_del, (dhi - dlo).astype(jnp.int64), 0)
+        doffs = jnp.cumsum(dlens)
+        dtot = doffs[N - 1]
+        j = jnp.arange(M, dtype=jnp.int64)
+        dsrc = jnp.searchsorted(doffs, j, side="right").astype(jnp.int32)
+        dsrcc = jnp.clip(dsrc, 0, N - 1)
+        dprev = jnp.where(dsrcc > 0, doffs[jnp.clip(dsrcc - 1, 0)], 0)
+        dpos = jnp.clip(dlo[dsrcc] + (j - dprev), 0, C - 1).astype(jnp.int32)
+        cand = (j < jnp.minimum(dtot, M)) & keep[dpos]
+        for p in pk_idx:
+            cand &= (cols[p][dpos]
+                     == chunk.columns[p].data[dsrcc].astype(cols[p].dtype))
+        victim = jnp.full(N, C, dtype=jnp.int32).at[
+            jnp.where(cand, dsrcc, N)].min(dpos, mode="drop")
+        found = victim < C
+        keep = keep.at[jnp.where(found, victim, C)].set(False, mode="drop")
+        n_del_miss = jnp.sum((is_del & ~found).astype(jnp.int32))
+
+        # merge inserts (stable, state rows before equal-hash new rows)
+        ins_h = jnp.where(is_ins, h, _HSENTINEL)
+        iorder = jnp.argsort(ins_h, stable=True)
+        nh = ins_h[iorder]
+        n_new = jnp.sum(is_ins.astype(jnp.int32))
+        dead_cum = jnp.cumsum((~keep).astype(jnp.int32))
+        kept_rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        n_kept = kept_rank[C - 1] + 1
+        new_lt = jnp.searchsorted(nh, khash, side="left").astype(jnp.int32)
+        pos_t = kept_rank + new_lt
+        idx = jnp.searchsorted(khash, nh, side="right")
+        dead_before = jnp.where(idx > 0, dead_cum[jnp.clip(idx - 1, 0)], 0)
+        kept_le = (idx - dead_before).astype(jnp.int32)
+        rr = jnp.arange(N, dtype=jnp.int32)
+        pos_r = rr + kept_le
+        new_ok = rr < n_new
+        n_after = n_kept + n_new
+        n_row_overflow = jnp.maximum(n_after - C, 0)
+        n_after = jnp.minimum(n_after, C)
+        tgt_t = jnp.where(keep & (pos_t < C), pos_t, C)
+        tgt_r = jnp.where(new_ok & (pos_r < C), pos_r, C)
+        kh2 = jnp.full(C, _HSENTINEL, dtype=jnp.int64)
+        kh2 = kh2.at[tgt_t].set(khash, mode="drop")
+        kh2 = kh2.at[tgt_r].set(nh, mode="drop")
+        cols2, valids2 = [], []
+        for ci, (sc, sv) in enumerate(zip(cols, valids)):
+            col = chunk.columns[ci]
+            c2 = jnp.zeros(C, dtype=sc.dtype).at[tgt_t].set(sc, mode="drop")
+            c2 = c2.at[tgt_r].set(col.data[iorder].astype(sc.dtype),
+                                  mode="drop")
+            v2 = jnp.zeros(C, dtype=bool).at[tgt_t].set(sv, mode="drop")
+            v2 = v2.at[tgt_r].set(col.valid_mask()[iorder], mode="drop")
+            cols2.append(c2)
+            valids2.append(v2)
+        errs = errs + jnp.stack([n_row_overflow, n_del_miss]).astype(
+            jnp.int32)
+        return (kh2, tuple(cols2), tuple(valids2),
+                n_after.astype(jnp.int32), errs)
+
+    # ------------------------------------------------------------- flush
+    def _flush_impl(self, khash, cols, valids, n, top_hash, top_cols,
+                    top_valids, top_n):
+        """Compute the new top set, diff vs the last emitted one."""
+        C = self.capacity
+        live = jnp.arange(C, dtype=jnp.int32) < n
+        ghash = (key_hash([cols[i] for i in self.group_key_indices])
+                 if self.group_key_indices
+                 else jnp.zeros(C, dtype=jnp.int64))
+        oval = cols[self.order_col]
+        okey = -oval if self.descending else oval
+        # sort live rows by (group, order, row hash); dead rows last
+        order = stable_lexsort((khash, okey,
+                                jnp.where(live, ghash, jnp.iinfo(
+                                    jnp.int64).max)))
+        s_g = jnp.where(live, ghash, jnp.iinfo(jnp.int64).max)[order]
+        new_run = jnp.concatenate([jnp.array([True]),
+                                   s_g[1:] != s_g[:-1]])
+        pos = jnp.arange(C, dtype=jnp.int32)
+        run_start = jax.lax.cummax(jnp.where(new_run, pos, 0))
+        rank = pos - run_start
+        s_live = live[order]
+        in_top = s_live & (rank >= self.offset) & (
+            rank < self.offset + self.limit)
+        # full-row hash identifies a row across top sets
+        s_cols = [c[order] for c in cols]
+        rhash = key_hash(s_cols)
+        topk = jnp.where(in_top, rhash, _HSENTINEL)
+        torder = jnp.argsort(topk, stable=True)
+        new_hash = topk[torder]
+        n_top = jnp.sum(in_top.astype(jnp.int32))
+        new_cols = tuple(c[torder] for c in s_cols)
+        new_valids = tuple(v[order][torder] for v in valids)
+
+        # membership diffs via searchsorted (hashes are sorted arrays)
+        def member(a_hash, a_n, b_hash):
+            i = jnp.searchsorted(b_hash, a_hash)
+            i = jnp.clip(i, 0, C - 1)
+            return (jnp.arange(C) < a_n) & (b_hash[i] == a_hash)
+
+        old_still = member(top_hash, top_n, new_hash)   # in both
+        emit_del = (jnp.arange(C) < top_n) & ~old_still
+        new_was = member(new_hash, n_top, top_hash)
+        emit_ins = (jnp.arange(C) < n_top) & ~new_was
+
+        out_cols = tuple(
+            Column(jnp.concatenate([tc, nc]),
+                   jnp.concatenate([tv, nv]))
+            for tc, nc, tv, nv in zip(top_cols, new_cols, top_valids,
+                                      new_valids))
+        ops = jnp.concatenate([
+            jnp.full(C, OP_DELETE, dtype=jnp.int8),
+            jnp.full(C, OP_INSERT, dtype=jnp.int8)])
+        vis = jnp.concatenate([emit_del, emit_ins])
+        return (new_hash, new_cols, new_valids, n_top.astype(jnp.int32),
+                out_cols, ops, vis)
+
+    # -------------------------------------------------------------- hooks
+    def on_chunk(self, chunk: StreamChunk) -> None:
+        (self.khash, self.cols, self.valids, self.n,
+         self._errs_dev) = self._apply(self.khash, self.cols, self.valids,
+                                       self.n, self._errs_dev, chunk)
+        if self.state_table is not None:
+            self._epoch_chunks.append(chunk)
+        return None
+
+    def persist(self, barrier: Barrier, flushed) -> None:
+        if self.state_table is None:
+            return
+        for c in self._epoch_chunks:
+            vis = np.asarray(c.vis)
+            if vis.any():
+                self.state_table.write_chunk_columns(
+                    np.asarray(c.ops), [np.asarray(col.data)
+                                        for col in c.columns], vis)
+        self._epoch_chunks = []
+        self.state_table.commit(barrier.epoch.curr)
+
+    def recover_state(self, epoch: int) -> None:
+        rows = [r for _, r in self.state_table.iter_all()]
+        if not rows:
+            return
+        from ..state.storage_table import rows_to_columns
+        cap = 1 << max(6, (len(rows) - 1).bit_length())
+        for ofs in range(0, len(rows), cap):
+            part = rows[ofs:ofs + cap]
+            arrays, valids = rows_to_columns(self.schema, part)
+            c = StreamChunk.from_numpy(
+                self.schema, arrays, capacity=cap,
+                valids=[None if v.all() else v for v in valids])
+            (self.khash, self.cols, self.valids, self.n,
+             self._errs_dev) = self._apply(self.khash, self.cols,
+                                           self.valids, self.n,
+                                           self._errs_dev, c)
+        # Seed the diff BASELINE: the downstream MV materialized exactly
+        # the top set of this recovered (checkpoint-consistent) store, so
+        # compute it once and DISCARD the output — the next real flush
+        # then emits only genuine changes. Without this, rows that left
+        # the top set across the rebuild would never receive a Delete
+        # (re-emitting inserts is idempotent; omitted deletes are not).
+        (self.top_hash, self.top_cols, self.top_valids, self.top_n,
+         _c, _o, _v) = self._flush(
+            self.khash, self.cols, self.valids, self.n,
+            self.top_hash, self.top_cols, self.top_valids, self.top_n)
+
+    def flush(self) -> Optional[StreamChunk]:
+        (self.top_hash, self.top_cols, self.top_valids, self.top_n,
+         out_cols, ops, vis) = self._flush(
+            self.khash, self.cols, self.valids, self.n,
+            self.top_hash, self.top_cols, self.top_valids, self.top_n)
+        return StreamChunk(out_cols, ops, vis, self.schema)
+
+    def check_watchdog(self) -> None:
+        vals = np.asarray(self._errs_dev)
+        if int(vals[0]):
+            raise RuntimeError(
+                f"retractable TopN overflow ({int(vals[0])} rows dropped; "
+                f"capacity {self.capacity})")
+        if int(vals[1]):
+            raise RuntimeError(
+                f"retractable TopN: {int(vals[1])} deletes matched no row")
+
+    def fence_tokens(self) -> list:
+        return [self.n, self.top_n] + super().fence_tokens()
+
+    def map_watermark(self, wm: Watermark) -> Optional[Watermark]:
+        return None          # ranks can change; no watermark survives
