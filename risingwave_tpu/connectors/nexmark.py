@@ -82,20 +82,21 @@ _CITIES = ["Phoenix", "Los Angeles", "San Francisco", "Boise", "Portland",
 # Dict-encoded vocabularies: every VARCHAR column draws ids from a
 # contiguous range [base, base+size) registered in GLOBAL_DICT, so device
 # ids always decode to real strings.
-_VOCABS: dict[str, tuple[int, int]] = {}
+_VOCABS: dict[str, tuple[int, ...]] = {}
 
 
-def _register_vocab(name: str, strings: list[str]) -> tuple[int, int]:
+def _register_vocab(name: str, strings: list[str]) -> tuple:
+    # ids need NOT be contiguous: any of these strings may already be in
+    # GLOBAL_DICT (e.g. inserted by a bound SQL literal before the first
+    # generator was constructed), so vocab picks gather from an explicit
+    # id table instead of doing base+offset arithmetic
     if name not in _VOCABS:
-        ids = [GLOBAL_DICT.get_or_insert(s) for s in strings]
-        base = ids[0]
-        assert ids == list(range(base, base + len(ids))), \
-            f"vocab {name} not contiguous in GLOBAL_DICT"
-        _VOCABS[name] = (base, len(ids))
+        _VOCABS[name] = tuple(GLOBAL_DICT.get_or_insert(s)
+                              for s in strings)
     return _VOCABS[name]
 
 
-def _ensure_vocabs() -> dict[str, tuple[int, int]]:
+def _ensure_vocabs() -> dict[str, tuple[int, ...]]:
     _register_vocab("channel", _CHANNELS)
     _register_vocab("state", _STATES)
     _register_vocab("city", _CITIES)
@@ -109,9 +110,9 @@ def _ensure_vocabs() -> dict[str, tuple[int, int]]:
     return dict(_VOCABS)
 
 
-def _vocab_pick(vocab: tuple[int, int], eid: jnp.ndarray, salt: int) -> jnp.ndarray:
-    base, size = vocab
-    return (base + _rand(eid, salt, size)).astype(jnp.int32)
+def _vocab_pick(vocab: tuple, eid: jnp.ndarray, salt: int) -> jnp.ndarray:
+    ids = jnp.asarray(vocab, dtype=jnp.int32)
+    return ids[_rand(eid, salt, len(vocab))]
 
 
 def _splitmix64(x: jnp.ndarray) -> jnp.ndarray:
@@ -192,8 +193,8 @@ def gen_person_columns(start_index: jnp.ndarray, n: int, cfg: NexmarkConfig,
     k = start_index + jnp.arange(n, dtype=jnp.int64)
     global_id = k * TOTAL_PROPORTION  # persons sit at offset 0 of each group
     pid = FIRST_PERSON_ID + k
-    name_base, name_size = V["name"]
-    name = (name_base + (pid % name_size)).astype(jnp.int32)
+    name_ids = jnp.asarray(V["name"], dtype=jnp.int32)
+    name = name_ids[pid % len(V["name"])]
     email = _vocab_pick(V["email"], global_id, 11)
     card = _vocab_pick(V["card"], global_id, 12)
     city = _vocab_pick(V["city"], global_id, 13)

@@ -7,3 +7,5 @@ __all__ = [
     "AggCall", "AggKind", "AggSpec", "count_star", "agg_max", "agg_min",
     "agg_sum", "registered_functions",
 ]
+
+from . import strings as _strings  # registers string kernels

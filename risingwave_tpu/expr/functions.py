@@ -306,6 +306,13 @@ _EXTRACT_FNS = {"extract_epoch", "extract_year", "extract_month",
 
 
 def infer_ret_type(name: str, args) -> DataType:
+    from .strings import STRING_FNS, STRING_PREDS
+    if name in STRING_PREDS:
+        return DataType.BOOLEAN
+    if name in STRING_FNS:
+        return DataType.VARCHAR
+    if name in ("length", "char_length", "ascii"):
+        return DataType.INT64
     if name in _CMP_FNS or name in _BOOL_FNS:
         return DataType.BOOLEAN
     if name in ("tumble_start", "tumble_end") or name.startswith("date_trunc_"):

@@ -1,0 +1,191 @@
+"""String functions over dict-encoded VARCHAR columns.
+
+Reference: src/expr/impl/src/scalar/{lower,upper,length,like,...}.rs —
+the reference evaluates string kernels over UTF-8 payloads per row. Here
+VARCHAR columns are GLOBAL_DICT int32 ids, so ANY pure string function
+becomes a DEVICE GATHER through a host-built mapping table over the
+dictionary: `out[i] = map[ids[i]]` where `map[k] = f(dict[k])`. One
+mapping covers every row ever — O(|dict|) host work per (function,
+dict-version), O(1) gathers per chunk, no per-row host string code on
+the streaming path.
+
+Mappings are cached per (key, dict length) and rebuilt when the dict
+grows (a retrace; dictionaries are near-static after vocab
+registration). Ids minted AFTER the mapping was traced gather the
+clipped last entry — callers that mint ids mid-stream (none of the
+built-in connectors do) must flush jit caches; documented limitation.
+
+String-RESULT functions (lower/upper/...) insert their outputs into the
+dict on the host at mapping-build time, so emitted ids always decode.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.chunk import Column
+from ..common.types import GLOBAL_DICT, DataType
+from .functions import register, strict, _and_valid
+
+# (key, dict_len) -> device mapping array
+_MAP_CACHE: dict = {}
+
+
+def _mapping(key, fn, np_dtype):
+    d = GLOBAL_DICT
+    snapshot = list(d._strings)          # fn may insert (string results)
+    n = len(snapshot)
+    cached = _MAP_CACHE.get(key)
+    if cached is not None and cached[0] == n:
+        return cached[1]
+    vals = np.asarray([fn(s) for s in snapshot], dtype=np_dtype)
+    if n == 0:
+        vals = np.zeros(1, dtype=np_dtype)
+    # cache NUMPY, never device values: _mapping may run inside a jit
+    # trace, and a cached traced constant would escape its trace
+    _MAP_CACHE[key] = (n, vals)
+    return vals
+
+
+def _gather(arr, ids):
+    arr = jnp.asarray(arr)
+    return arr[jnp.clip(ids, 0, arr.shape[0] - 1)]
+
+
+def _str_to_str(name, py_fn):
+    @register(name)
+    @strict
+    def _impl(node, ids, _name=name, _fn=py_fn):
+        m = _mapping(("s2s", _name),
+                     lambda s: GLOBAL_DICT.get_or_insert(_fn(s)),
+                     np.int32)
+        return _gather(m, ids)
+    return _impl
+
+
+_str_to_str("lower", str.lower)
+_str_to_str("upper", str.upper)
+_str_to_str("trim", str.strip)
+_str_to_str("ltrim", str.lstrip)
+_str_to_str("rtrim", str.rstrip)
+_str_to_str("reverse", lambda s: s[::-1])
+_str_to_str("md5", lambda s: __import__("hashlib").md5(
+    s.encode()).hexdigest())
+
+
+@register("length")
+@register("char_length")
+@strict
+def _length(node, ids):
+    m = _mapping(("len",), len, np.int64)
+    return _gather(m, ids)
+
+
+@register("ascii")
+@strict
+def _ascii(node, ids):
+    m = _mapping(("ascii",), lambda s: ord(s[0]) if s else 0, np.int64)
+    return _gather(m, ids)
+
+
+def _literal_arg(node, pos: int, what: str) -> str:
+    from .ir import Literal
+    a = node.args[pos]
+    if not isinstance(a, Literal) or not isinstance(a.value, str):
+        raise NotImplementedError(
+            f"{node.name} needs a string literal {what} (got {a!r})")
+    return a.value
+
+
+def _str_pred(name, build_pred):
+    """String predicate with a LITERAL second argument -> bool mapping."""
+    @register(name)
+    def _impl(node, cols, _name=name, _build=build_pred):
+        pat = _literal_arg(node, 1, "pattern")
+        pred = _build(pat)
+        m = _mapping((_name, pat), lambda s: bool(pred(s)), np.bool_)
+        data = _gather(m, cols[0].data)
+        return Column(data, _and_valid(cols[:1]))
+    return _impl
+
+
+def _like_matcher(pattern: str):
+    rx = re.compile("".join(
+        ".*" if ch == "%" else "." if ch == "_" else re.escape(ch)
+        for ch in pattern) + r"\Z", re.S)
+    return lambda s: rx.match(s) is not None
+
+
+_str_pred("like", _like_matcher)
+_str_pred("starts_with", lambda p: (lambda s: s.startswith(p)))
+_str_pred("ends_with", lambda p: (lambda s: s.endswith(p)))
+_str_pred("contains", lambda p: (lambda s: p in s))
+
+
+@register("substr")
+@strict
+def _substr(node, ids, *_rest):
+    """substr(s, start[, count]) with LITERAL positions (1-based, PG)."""
+    from .ir import Literal
+    start = node.args[1]
+    if not isinstance(start, Literal):
+        raise NotImplementedError("substr needs literal positions")
+    s0 = int(start.value)
+    cnt = None
+    if len(node.args) > 2:
+        c = node.args[2]
+        if not isinstance(c, Literal):
+            raise NotImplementedError("substr needs literal positions")
+        cnt = int(c.value)
+
+    def f(s):
+        begin = max(0, s0 - 1)
+        out = s[begin:begin + cnt] if cnt is not None else s[begin:]
+        return GLOBAL_DICT.get_or_insert(out)
+    m = _mapping(("substr", s0, cnt), f, np.int32)
+    return _gather(m, ids)
+
+
+STRING_FNS = ("lower", "upper", "trim", "ltrim", "rtrim", "reverse",
+              "md5", "substr")
+STRING_PREDS = ("like", "starts_with", "ends_with", "contains")
+
+
+def numpy_string_eval(node, ids: np.ndarray) -> np.ndarray:
+    """Serving-path evaluation: the SAME mappings, gathered in numpy."""
+    name = node.name
+    if name in ("length", "char_length"):
+        m = _mapping(("len",), len, np.int64)
+    elif name == "ascii":
+        m = _mapping(("ascii",), lambda s: ord(s[0]) if s else 0, np.int64)
+    elif name in STRING_PREDS:
+        pat = _literal_arg(node, 1, "pattern")
+        builders = {"like": _like_matcher,
+                    "starts_with": lambda p: (lambda s: s.startswith(p)),
+                    "ends_with": lambda p: (lambda s: s.endswith(p)),
+                    "contains": lambda p: (lambda s: p in s)}
+        pred = builders[name](pat)
+        m = _mapping((name, pat), lambda s: bool(pred(s)), np.bool_)
+    elif name == "substr":
+        from .ir import Literal
+        s0 = int(node.args[1].value)
+        cnt = int(node.args[2].value) if len(node.args) > 2 else None
+
+        def f(s):
+            begin = max(0, s0 - 1)
+            out = s[begin:begin + cnt] if cnt is not None else s[begin:]
+            return GLOBAL_DICT.get_or_insert(out)
+        m = _mapping(("substr", s0, cnt), f, np.int32)
+    else:
+        fns = {"lower": str.lower, "upper": str.upper, "trim": str.strip,
+               "ltrim": str.lstrip, "rtrim": str.rstrip,
+               "reverse": lambda s: s[::-1],
+               "md5": lambda s: __import__("hashlib").md5(
+                   s.encode()).hexdigest()}
+        m = _mapping(("s2s", name),
+                     lambda s, _f=fns[name]: GLOBAL_DICT.get_or_insert(
+                         _f(s)), np.int32)
+    return m[np.clip(ids, 0, len(m) - 1)]

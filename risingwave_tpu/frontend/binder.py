@@ -395,15 +395,15 @@ class StreamPlanner:
             raise BindError(
                 "streaming TopN over a keyless stream is unsupported "
                 "(add GROUP BY or aggregate first)")
-        # the TopN is a SINGLETON fragment downstream of the (possibly
-        # hash-parallel) input: per-shard top-Ns would union to up to
-        # limit*parallelism wrong rows (reference: StreamTopN is a
-        # singleton below the hash agg)
-        frag.dispatch = "simple" if frag.parallelism == 1 else frag.dispatch
+        # the TopN is a SINGLETON fragment (default parallelism=1)
+        # downstream of the (possibly hash-parallel) input: per-shard
+        # top-Ns would union to up to limit*parallelism wrong rows
+        # (reference: StreamTopN is a singleton below the hash agg)
         top = self.graph.add(Fragment(self.fid(), Node(
             "retract_top_n", dict(
                 group_key_indices=(), order_col=idx, limit=limit,
-                offset=offset, descending=desc, durable=True),
+                offset=offset, descending=desc, durable=True,
+                pk_indices=list(pk_hint)),
             inputs=(Exchange(fid),)), dispatch="simple"))
         return top.fid, names, types, pk_hint, False
 

@@ -95,6 +95,16 @@ def eval_numpy(e: Expr, cols: list[np.ndarray], valids=None):
                 v = np.where(valid, v, b)
                 valid = valid | bv
             return v, valid
+        if name in ("lower", "upper", "trim", "ltrim", "rtrim",
+                    "reverse", "md5", "length", "char_length", "ascii",
+                    "like", "starts_with", "ends_with", "contains",
+                    "substr"):
+            # PURE NUMPY gather through the same host-built dictionary
+            # mapping the streaming kernels use — the serving path must
+            # stay off the accelerator (module docstring)
+            from ..expr.strings import numpy_string_eval
+            (a, av) = args[0]
+            return numpy_string_eval(e, np.asarray(a, dtype=np.int64)), av
         if name in ("tumble_start", "tumble_end"):
             (a, av), (w, _) = args
             start = a - a % w

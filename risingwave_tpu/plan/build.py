@@ -446,10 +446,11 @@ def _build_stream_scan(args, inputs, ctx: ActorCtx, key):
 @register_builder("retract_top_n")
 def _build_retract_top_n(args, inputs, ctx: ActorCtx, key):
     from ..stream.retract_top_n import RetractableTopNExecutor
+    pk = tuple(args.get("pk_indices")
+               or inputs[0].pk_indices
+               or range(len(inputs[0].schema)))
     st = None
     if args.get("durable"):
-        pk = tuple(inputs[0].pk_indices) or tuple(
-            range(len(inputs[0].schema)))
         st = ctx.env.state_table(ctx.table_id(key), inputs[0].schema, pk,
                                  vnode_bitmap=ctx.vnode_bitmap)
     return RetractableTopNExecutor(
@@ -457,7 +458,7 @@ def _build_retract_top_n(args, inputs, ctx: ActorCtx, key):
         args["order_col"], args["limit"], offset=args.get("offset", 0),
         descending=args.get("descending", False),
         capacity=args.get("capacity", 1 << 14),
-        state_table=st,
+        state_table=st, pk_indices=pk,
         watchdog_interval=args.get("watchdog_interval", 1))
 
 

@@ -38,7 +38,7 @@ from ..common.chunk import Column, StreamChunk, OP_DELETE, OP_INSERT, op_sign
 from ..ops.hash_table import stable_lexsort
 from .executor import Executor, StatefulUnaryExecutor
 from .message import Barrier, Watermark
-from .sorted_join import _HSENTINEL, key_hash
+from .sorted_join import _HSENTINEL, _count_le, key_hash
 
 
 class RetractableTopNExecutor(StatefulUnaryExecutor):
@@ -52,11 +52,13 @@ class RetractableTopNExecutor(StatefulUnaryExecutor):
                  descending: bool = False,
                  capacity: int = 1 << 14,
                  state_table=None,
+                 pk_indices: Optional[Sequence[int]] = None,
                  watchdog_interval: Optional[int] = 1):
         self.input = input
         self.schema = input.schema
-        self.pk_indices = tuple(input.pk_indices) or tuple(
-            range(len(input.schema)))
+        self.pk_indices = tuple(
+            pk_indices if pk_indices is not None
+            else (input.pk_indices or range(len(input.schema))))
         self.group_key_indices = tuple(group_key_indices)
         self.order_col = order_col
         self.limit = limit
@@ -154,9 +156,7 @@ class RetractableTopNExecutor(StatefulUnaryExecutor):
         n_kept = kept_rank[C - 1] + 1
         new_lt = jnp.searchsorted(nh, khash, side="left").astype(jnp.int32)
         pos_t = kept_rank + new_lt
-        idx = jnp.searchsorted(khash, nh, side="right")
-        dead_before = jnp.where(idx > 0, dead_cum[jnp.clip(idx - 1, 0)], 0)
-        kept_le = (idx - dead_before).astype(jnp.int32)
+        kept_le = _count_le(khash, dead_cum, nh, side="right")
         rr = jnp.arange(N, dtype=jnp.int32)
         pos_r = rr + kept_le
         new_ok = rr < n_new
