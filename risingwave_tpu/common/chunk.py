@@ -169,11 +169,19 @@ class StreamChunk:
         return cols, ops
 
     def to_rows(self) -> list[tuple]:
-        """Visible rows as python tuples (op, values...). For tests/sinks."""
-        cols, ops = self.to_numpy()
+        """Visible rows as python tuples (op, values...), NULL lanes as
+        None. For materialize/sinks/tests — NULL-ness must survive the
+        host boundary or outer-join padding rows materialize as zeros."""
+        vis = np.asarray(self.vis)
+        ops = np.asarray(self.ops)[vis]
+        cols = [np.asarray(c.data)[vis] for c in self.columns]
+        valids = [None if c.valid is None else np.asarray(c.valid)[vis]
+                  for c in self.columns]
         out = []
         for r in range(len(ops)):
-            out.append((int(ops[r]), tuple(c[r].item() for c in cols)))
+            out.append((int(ops[r]), tuple(
+                c[r].item() if v is None or v[r] else None
+                for c, v in zip(cols, valids))))
         return out
 
 

@@ -109,10 +109,18 @@ def contains_agg(e) -> bool:
 class RelInfo:
     """Stream properties the reference tracks in plan_base: the STREAM KEY
     (positions in the relation's output that uniquely identify a changelog
-    row — what retractions address) and append-only-ness."""
+    row — what retractions address), append-only-ness, and the columns a
+    WATERMARK flows on (reference: watermark_columns in plan_base, derived
+    by the watermark inference pass — drives join/agg state cleaning)."""
 
     stream_key: Optional[tuple] = None      # None = keyless (needs row_id)
     append_only: bool = True
+    wm_cols: frozenset = frozenset()
+
+
+# date_time column index per nexmark table (the connector's declared
+# watermark column, connectors/nexmark.py watermark_col)
+_NEXMARK_WM_COL = {"bid": 5, "person": 6, "auction": 5}
 
 
 @dataclass
@@ -125,16 +133,37 @@ class BoundPlan:
 
 
 class StreamPlanner:
-    def __init__(self, catalog, parallelism: int = 1):
+    def __init__(self, catalog, parallelism: int = 1, config=None):
         self.catalog = catalog
         self.parallelism = parallelism   # hash-distributed fragments
+        self.config = config or {}
         self.graph = StreamGraph()
         self._next_fid = 1
+
+    def cfg(self, name: str, default):
+        return self.config.get(name, default)
 
     def fid(self) -> int:
         f = self._next_fid
         self._next_fid = f + 1
         return f
+
+    def source_fragment(self, name: str) -> int:
+        """Source fragments are SHARED within one plan (reference: the
+        source-sharing rewrite, ShareSourceRewriter) — a query reading
+        `bid` twice (q7: raw stream + windowed agg over it) runs ONE
+        generator/connector, not two. The cached fragment stays bare;
+        consumers attach through Exchange so later grafts (WHERE, window
+        projects) never mutate a shared root."""
+        if not hasattr(self, "_source_frags"):
+            self._source_frags = {}
+        if name not in self._source_frags:
+            src = self.catalog.source(name)
+            node = Node("nexmark_source", dict(src.options, durable=True))
+            f = self.graph.add(Fragment(self.fid(), node,
+                                        dispatch="broadcast"))
+            self._source_frags[name] = f.fid
+        return self._source_frags[name]
 
     # ----------------------------------------------------------- relations
     def plan_rel(self, rel) -> tuple[int, Scope, RelInfo]:
@@ -152,16 +181,22 @@ class StreamPlanner:
                                 append_only=getattr(mv, "append_only",
                                                     False)))
             src = self.catalog.source(rel.name)
-            node = Node("nexmark_source", dict(src.options, durable=True))
-            f = self.graph.add(Fragment(self.fid(), node,
-                                        dispatch="broadcast"))
+            sfid = self.source_fragment(rel.name)
+            # indirection fragment: WHERE/project grafts land here, the
+            # shared source root stays untouched
+            f = self.graph.add(Fragment(self.fid(), Node(
+                "no_op", {}, inputs=(Exchange(sfid),)),
+                dispatch="broadcast"))
+            wm = frozenset()
+            if src.options.get("emit_watermarks"):
+                wm = frozenset({_NEXMARK_WM_COL[src.options["table"]]})
             return (f.fid, Scope.of(src.schema, rel.alias or rel.name),
-                    RelInfo(None, True))
+                    RelInfo(None, True, wm))
         if isinstance(rel, ast.WindowRel):
             src = self.catalog.source(rel.inner.name)
             scope = Scope.of(src.schema, None)
             i, t = scope.resolve(ast.ColRef(rel.time_col))
-            src_node = Node("nexmark_source", dict(src.options, durable=True))
+            src_node = Exchange(self.source_fragment(rel.inner.name))
             if rel.kind == "tumble":
                 exprs = [col(j, f.data_type)
                          for j, f in enumerate(src.schema)]
@@ -173,7 +208,9 @@ class StreamPlanner:
                 node = Node("project", dict(
                     exprs=exprs, names=names,
                     watermark_transforms={
-                        i: (len(names) - 2, lambda v, W=W: v - v % W)}),
+                        i: [(len(names) - 2, lambda v, W=W: v - v % W),
+                            (len(names) - 1,
+                             lambda v, W=W: (v - v % W) + W)]}),
                     inputs=(src_node,))
                 f = self.graph.add(Fragment(self.fid(), node,
                                             dispatch="broadcast"))
@@ -191,8 +228,15 @@ class StreamPlanner:
                 out_schema = Schema(tuple(
                     list(src.schema) + [Field("window_start", t),
                                         Field("window_end", t)]))
+            wm = frozenset()
+            if src.options.get("emit_watermarks"):
+                # tumble transforms the event-time watermark onto BOTH
+                # window columns; hop emits it on window_start only
+                wm = (frozenset({len(src.schema), len(src.schema) + 1})
+                      if rel.kind == "tumble"
+                      else frozenset({len(src.schema)}))
             return (f.fid, Scope.of(out_schema, rel.alias or rel.inner.name),
-                    RelInfo(None, True))
+                    RelInfo(None, True, wm))
         if isinstance(rel, ast.JoinRel):
             lf, ls, li = self.plan_rel(rel.left)
             rf, rs, ri = self.plan_rel(rel.right)
@@ -233,31 +277,117 @@ class StreamPlanner:
                 for r in residue[1:]:
                     e = ast.BinOp("and", e, r)
                 cond = bind_scalar(e, jscope)
-            node = Node("hash_join", dict(
-                left_key_indices=lkeys, right_key_indices=rkeys,
-                left_pk_indices=list(lpk),
-                right_pk_indices=list(rpk),
-                condition=cond, match_factor=64, durable=True),
-                inputs=(Exchange(lf), Exchange(rf)))
+            jt = getattr(rel, "join_type", "inner")
+            # --- watermark-driven state cleaning (reference: the stream
+            # planner's watermark inference + interval-join condition
+            # analysis, optimizer/plan_node/stream_hash_join.rs clean_*):
+            # a side may evict rows below its watermark on column c when
+            # future matches against them are impossible — (1) c is an
+            # equi-key whose partner column is also watermarked (windowed
+            # joins: both sides advance together), or (2) a residual
+            # conjunct bands c against watermarked columns of the other
+            # side (interval joins: old rows fall out of every future
+            # band). Outer joins never clean (degree accounting).
+            clean_l = clean_r = None
+            if jt == "inner":
+                for kpos, (lk, rk) in enumerate(zip(lkeys, rkeys)):
+                    if lk in li.wm_cols and rk in ri.wm_cols:
+                        clean_l = ("pair", lk, kpos)
+                        clean_r = ("pair", rk, kpos)
+                        break
+                if clean_l is None and clean_r is None:
+                    for conj in residue:
+                        b = band_bound(conj, ls, rs, li.wm_cols, ri.wm_cols)
+                        if b is None:
+                            continue
+                        bside, own_col, other_col, delta = b
+                        info_side = li if bside == "l" else ri
+                        own_wm = own_col in info_side.wm_cols
+                        # a RETRACTING side may still emit deletes for
+                        # rows the band bound already evicted (the other
+                        # side's watermark can run ahead of ours). Safe
+                        # only if the side is append-only, or its own
+                        # column is watermarked so the executor caps the
+                        # bound at min(own wm, band bound).
+                        if not (info_side.append_only or own_wm):
+                            continue
+                        cap = (own_col if own_wm
+                               and not info_side.append_only else None)
+                        spec = ("band", own_col, other_col, delta, cap)
+                        if bside == "l" and clean_l is None:
+                            clean_l = spec
+                        elif bside == "r" and clean_r is None:
+                            clean_r = spec
+                        if clean_l is not None and clean_r is not None:
+                            break
+            # The sorted-merge join (fast path: dense sorted state, no
+            # chain walks) requires integer-comparable keys — true for
+            # every engine type except FLOAT64 (varchar = dict ids,
+            # decimal = scaled int, timestamps = int64). Non-integer keys
+            # fall back to the chained hash join.
+            import numpy as np
+            key_int = all(
+                np.issubdtype(sc.schema[i].data_type.np_dtype, np.integer)
+                for sc, keys in ((ls, lkeys), (rs, rkeys)) for i in keys)
+            wd = 1 if self.cfg("streaming_watchdog", 1) else None
+            if key_int:
+                node = Node("sorted_join", dict(
+                    left_key_indices=lkeys, right_key_indices=rkeys,
+                    left_pk_indices=list(lpk),
+                    right_pk_indices=list(rpk),
+                    condition=cond, join_type=jt,
+                    capacity=self.cfg("streaming_join_capacity", 1 << 17),
+                    match_factor=self.cfg("streaming_join_match_factor", 64),
+                    append_only=(li.append_only, ri.append_only),
+                    clean_specs=(clean_l, clean_r),
+                    watchdog_interval=wd,
+                    durable=True),
+                    inputs=(Exchange(lf), Exchange(rf)))
+            else:
+                if jt != "inner":
+                    raise BindError(
+                        "outer joins require integer-comparable keys")
+                node = Node("hash_join", dict(
+                    left_key_indices=lkeys, right_key_indices=rkeys,
+                    left_pk_indices=list(lpk),
+                    right_pk_indices=list(rpk),
+                    condition=cond,
+                    match_factor=self.cfg("streaming_join_match_factor", 64),
+                    watchdog_interval=wd,
+                    durable=True),
+                    inputs=(Exchange(lf), Exchange(rf)))
             f = self.graph.add(Fragment(self.fid(), node,
                                         dispatch="broadcast"))
             off = len(ls.schema)
             jkey = tuple(lpk) + tuple(off + i for i in rpk)
+            # the executor forwards min-of-sides watermarks on equi-key
+            # columns where BOTH sides carry one. Inner joins only: an
+            # outer join's NULL-padded rows emit values on the padded
+            # side's key column at arbitrary future times, which would
+            # violate the advertised watermark downstream.
+            out_wm = set()
+            if jt == "inner":
+                for lk, rk in zip(lkeys, rkeys):
+                    if lk in li.wm_cols and rk in ri.wm_cols:
+                        out_wm |= {lk, off + rk}
             return (f.fid, jscope,
                     RelInfo(stream_key=jkey,
-                            append_only=li.append_only and ri.append_only))
+                            append_only=(li.append_only and ri.append_only
+                                         and jt == "inner"),
+                            wm_cols=frozenset(out_wm)))
         if isinstance(rel, ast.SubqueryRel):
             # FROM (SELECT ...) alias — plan the inner query WITHOUT
             # materialization; its changelog feeds the outer plan
             # directly (reference: StreamProject/Agg subplans compose,
             # no intermediate MV)
             from ..common.types import Field
-            sub_fid, names, types, pk_hint, ao = self._plan_query(
+            sub_fid, names, types, pk_hint, ao, wm = self._plan_query(
                 rel.select)
             schema = Schema(tuple(Field(n, t)
                                   for n, t in zip(names, types)))
             return (sub_fid, Scope.of(schema, rel.alias),
-                    RelInfo(stream_key=pk_hint, append_only=ao))
+                    RelInfo(stream_key=pk_hint, append_only=ao,
+                            wm_cols=wm))
         raise BindError(f"cannot plan relation {rel!r}")
 
     # -------------------------------------------------------------- select
@@ -265,7 +395,7 @@ class StreamPlanner:
         """CREATE SINK: the plan terminates in a sink node instead of a
         materialize (reference: StreamSink, sink desc from the WITH
         options)."""
-        fid, names, types, pk_hint, append_only = self._plan_query(sel)
+        fid, names, types, pk_hint, append_only, _wm = self._plan_query(sel)
         frag = self.graph.fragments[fid]
         from ..common.types import Field
         frag.root = Node("sink", dict(options), inputs=(frag.root,))
@@ -274,7 +404,7 @@ class StreamPlanner:
                          append_only)
 
     def plan_select(self, sel: ast.Select) -> BoundPlan:
-        fid, names, types, pk_hint, append_only = self._plan_query(sel)
+        fid, names, types, pk_hint, append_only, _wm = self._plan_query(sel)
         frag = self.graph.fragments[fid]
         from ..common.types import Field
         if pk_hint is None:
@@ -329,6 +459,15 @@ class StreamPlanner:
 
         has_agg = bool(sel.group_by) or any(
             contains_agg(it.expr) for it in sel.items)
+        from ..expr.ir import InputRef
+
+        def project_wm(exprs):
+            """Watermarks survive a projection on InputRef columns (the
+            project executor's default watermark_mapping)."""
+            return frozenset(
+                j for j, e in enumerate(exprs)
+                if isinstance(e, InputRef) and e.index in info.wm_cols)
+
         if not has_agg:
             exprs, names = [], []
             for j, it in enumerate(sel.items):
@@ -337,7 +476,8 @@ class StreamPlanner:
             if info.append_only:
                 frag.root = Node("project", dict(exprs=exprs, names=names),
                                  inputs=(frag.root,))
-                out = (fid, names, [e.ret_type for e in exprs], None, True)
+                out = (fid, names, [e.ret_type for e in exprs], None, True,
+                       project_wm(exprs))
                 if want_top_n:
                     out = self._plan_top_n(top_spec, out)
                 return out
@@ -346,7 +486,6 @@ class StreamPlanner:
             # hidden stream-key columns the same way)
             assert info.stream_key is not None
             key_pos = []
-            from ..expr.ir import InputRef
             for ki in info.stream_key:
                 found = None
                 for j, e in enumerate(exprs):
@@ -362,13 +501,14 @@ class StreamPlanner:
             frag.root = Node("project", dict(exprs=exprs, names=names),
                              inputs=(frag.root,))
             out = (fid, names, [e.ret_type for e in exprs],
-                   tuple(key_pos), False)
+                   tuple(key_pos), False, project_wm(exprs))
             if want_top_n:
                 out = self._plan_top_n(top_spec, out)
             return out
 
-        out = self._plan_agg(sel, fid, scope)
-        out = out + (False,)
+        afid, names, types, pk, wm_out = self._plan_agg(sel, fid, scope,
+                                                        info)
+        out = (afid, names, types, pk, False, wm_out)
         if want_top_n:
             out = self._plan_top_n(top_spec, out)
         return out
@@ -378,7 +518,7 @@ class StreamPlanner:
         changelog (reference: StreamTopN; retraction-capable because the
         input may be an agg/join changelog)."""
         order_by, limit, offset = top_spec
-        fid, names, types, pk_hint, append_only = planned
+        fid, names, types, pk_hint, append_only, _wm = planned
         frag = self.graph.fragments[fid]
         if len(order_by) != 1:
             raise BindError("streaming TopN supports one ORDER BY key")
@@ -405,9 +545,11 @@ class StreamPlanner:
                 offset=offset, descending=desc, durable=True,
                 pk_indices=list(pk_hint)),
             inputs=(Exchange(fid),)), dispatch="simple"))
-        return top.fid, names, types, pk_hint, False
+        # ranks can change retroactively: no watermark survives a TopN
+        return top.fid, names, types, pk_hint, False, frozenset()
 
-    def _plan_agg(self, sel: ast.Select, fid: int, scope: Scope):
+    def _plan_agg(self, sel: ast.Select, fid: int, scope: Scope,
+                  info: RelInfo):
         from ..common.types import Field
         frag = self.graph.fragments[fid]
         # pre-project: group keys then agg args
@@ -470,12 +612,25 @@ class StreamPlanner:
 
         frag.root = Node("project", dict(exprs=pre_exprs, names=pre_names),
                          inputs=(frag.root,))
+        # group keys that are direct refs to watermarked input columns:
+        # the first becomes the agg's state-cleaning column (groups below
+        # the watermark can never change again — reference: the agg's
+        # state-cleaning watermark from watermark inference)
+        from ..expr.ir import InputRef
+        wm_keys = [kj for kj, ke in enumerate(keys)
+                   if isinstance(ke, InputRef) and ke.index in info.wm_cols]
+        wd = 1 if self.cfg("streaming_watchdog", 1) else None
         if keys:
             frag.dispatch = "hash"
             frag.dist_key_indices = tuple(range(len(keys)))
             agg = self.graph.add(Fragment(self.fid(), Node(
-                "hash_agg", dict(group_key_indices=list(range(len(keys))),
-                                 agg_calls=agg_calls, durable=True),
+                "hash_agg", dict(
+                    group_key_indices=list(range(len(keys))),
+                    agg_calls=agg_calls, durable=True,
+                    capacity=self.cfg("streaming_agg_capacity", 1 << 16),
+                    cleaning_watermark_col=(wm_keys[0] if wm_keys
+                                            else None),
+                    watchdog_interval=wd),
                 inputs=(Exchange(fid),)),
                 dispatch="hash",
                 dist_key_indices=tuple(range(len(keys))),
@@ -509,6 +664,7 @@ class StreamPlanner:
         # MV pk = the group keys, which must survive projection: append any
         # key not already selected
         pk = []
+        key_out = {}
         for kj in range(nk):
             found = None
             for j, plan in enumerate(items_plan):
@@ -520,15 +676,79 @@ class StreamPlanner:
                 names.append(f"_key{kj}")
                 found = len(post) - 1
             pk.append(found)
+            key_out[kj] = found
         agg.root = Node("project", dict(exprs=post, names=names),
                         inputs=(agg.root,))
-        return agg.fid, names, [e.ret_type for e in post], tuple(pk)
+        # group-key watermarks pass through the agg re-indexed, then
+        # through the post-project on their InputRef positions
+        wm_out = frozenset(key_out[kj] for kj in wm_keys)
+        return (agg.fid, names, [e.ret_type for e in post], tuple(pk),
+                wm_out)
 
 
 def split_conjuncts(e) -> list:
     if isinstance(e, ast.BinOp) and e.op == "and":
         return split_conjuncts(e.left) + split_conjuncts(e.right)
     return [e]
+
+
+def band_bound(conj, ls: Scope, rs: Scope, lwm: frozenset, rwm: frozenset):
+    """Interval-join cleaning derivation (reference: the condition
+    analysis behind stream interval joins): a comparison conjunct
+    normalizing to `X.a > Y.o + d` (op may be any of > >= < <=, the small
+    side affine in one column) lets side X evict rows with a below
+    wm(Y.o) + d — every FUTURE Y row has o >= wm(Y.o), so old X rows fall
+    out of every future band. Requires Y.o to carry a watermark. Returns
+    (side_of_X, a_index, o_index, d) or None."""
+    if not isinstance(conj, ast.BinOp):
+        return None
+    if conj.op in ("greater_than", "greater_than_or_equal"):
+        big, small = conj.left, conj.right
+    elif conj.op in ("less_than", "less_than_or_equal"):
+        big, small = conj.right, conj.left
+    else:
+        return None
+
+    def affine(e):
+        if isinstance(e, ast.ColRef):
+            return e, 0
+        if isinstance(e, ast.BinOp) and e.op in ("add", "subtract"):
+            if (isinstance(e.left, ast.ColRef) and isinstance(e.right, ast.Lit)
+                    and isinstance(e.right.value, int)):
+                return e.left, (e.right.value if e.op == "add"
+                                else -e.right.value)
+            if (e.op == "add" and isinstance(e.right, ast.ColRef)
+                    and isinstance(e.left, ast.Lit)
+                    and isinstance(e.left.value, int)):
+                return e.right, e.left.value
+        return None
+
+    bg = affine(big)
+    sm = affine(small)
+    if bg is None or sm is None:
+        return None
+    # normalize (big_col + bd) > (small_col + sd)  ->  big_col >
+    # small_col + (sd - bd), so `b.dt <= a.dt + 10` also cleans side a
+    big, bd = bg
+    other_ref, sd = sm
+    delta = sd - bd
+
+    def side_of(ref):
+        try:
+            return ("l", ls.resolve(ref)[0])
+        except BindError:
+            pass
+        try:
+            return ("r", rs.resolve(ref)[0])
+        except BindError:
+            return None
+
+    sb, so = side_of(big), side_of(other_ref)
+    if sb is None or so is None or sb[0] == so[0]:
+        return None
+    if (so[1] not in lwm) if so[0] == "l" else (so[1] not in rwm):
+        return None
+    return sb[0], sb[1], so[1], delta
 
 
 def equi_pair(e, ls: Scope, rs: Scope) -> Optional[tuple[int, int]]:

