@@ -191,21 +191,11 @@ def _baseline_main(query: str, n_chunks: int, chunk_size: int) -> None:
 
 # ------------------------------------------------------------------ device
 
-class _DeviceSink:
-    """Consume chunks without host readback (the bench measures the engine;
-    the reference's harness likewise reads source-side counters)."""
-
-    def __init__(self, input):
-        self.input = input
-        self.schema = input.schema
-        self.last = None
-
-    async def execute(self):
-        from risingwave_tpu.common.chunk import StreamChunk
-        async for msg in self.input.execute():
-            if isinstance(msg, StreamChunk):
-                self.last = msg.columns[-1].data
-            yield msg
+def _DeviceSink(input):
+    """Device-resident blackhole (no host readback) — the library's sink
+    executor, shared with the SQL-path benches."""
+    from risingwave_tpu.stream.sink import DeviceBlackholeSinkExecutor
+    return DeviceBlackholeSinkExecutor(input)
 
 
 async def _measure(coord, gen, sink, progress: dict, measure_s: float,
@@ -324,168 +314,141 @@ async def bench_q5(progress: dict) -> None:
 
 
 
+async def _bench_sql(progress: dict, ddl: list, interval_s: float,
+                     measure_s: float = MEASURE_S) -> None:
+    """Run a query expressed as SQL through the Session — the measured
+    number IS the system number (VERDICT r3: "the bench path and the SQL
+    path must converge"). The sink is connector='blackhole_device' (no
+    host readback); sources free-run between paced barriers exactly like
+    the hand-built pipelines did."""
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.stream.sorted_join import SortedJoinExecutor
+    from risingwave_tpu.stream.source import SourceExecutor
+
+    s = Session()
+    for stmt in ddl:
+        await s.execute(stmt)
+    gens, sink, join = [], None, None
+    for d in s.catalog.sinks.values():
+        for roots in d.deployment.roots.values():
+            for root in roots:
+                node = root
+                while node is not None:
+                    if isinstance(node, SourceExecutor):
+                        gens.append(node.connector)
+                    if isinstance(node, SortedJoinExecutor):
+                        join = node
+                    node = getattr(node, "input", None)
+        sink = d.executor
+
+    class _Gens:
+        @property
+        def offset(self):
+            return sum(g.offset for g in gens)
+
+    await _measure(s.coord, _Gens(), sink, progress, measure_s,
+                   interval_s=interval_s)
+    # quiesce: stop the sources producing (the stop barrier would
+    # otherwise ride behind a growing backlog)
+    from risingwave_tpu.stream.message import PauseMutation
+    b = await s.coord.inject_barrier(mutation=PauseMutation())
+    await s.coord.wait_collected(b)
+    if join is not None:
+        # Post-run d2h of even 3 ints can stall for MINUTES on the
+        # tunneled TPU (measured this round: the fetch after a drained
+        # 8s run exceeded 15s; the same stall produced every round-3
+        # "teardown abandoned" note). Bound it; when it stalls, the
+        # overflow attestations fall back to the CPU-backend tests of the
+        # same pipeline shapes.
+        try:
+            import jax as _jax
+            errs = await asyncio.wait_for(
+                asyncio.to_thread(
+                    lambda: [int(x) for x in
+                             _jax.device_get(join._errs_dev)]),
+                timeout=15.0)
+            progress["state_errs_checked"] = True
+            if any(errs):
+                progress["state_errs"] = errs
+        except asyncio.TimeoutError:
+            progress["state_errs"] = "unavailable (d2h stall)"
+    # NO drop_all here BY DESIGN: executor teardown performs synchronous
+    # device syncs that block the event loop in the post-run stalled-d2h
+    # regime; this subprocess is isolated, so the paused dataflow is
+    # reclaimed by process exit. clean_exit=true means the run finished
+    # and exited on its own (vs. being killed by the deadline).
+    progress["teardown"] = "skipped by design (isolated subprocess)"
+    # signal completion for the emit-and-exit watcher: asyncio.run() would
+    # now cancel the actor tasks, whose unwind blocks on device syncs in
+    # the stalled-d2h regime — the watcher exits the process instead
+    progress["clean_exit"] = True
+    progress["pipeline_done"] = True
+    await asyncio.Event().wait()      # parked until process exit
+
+
+W = 10_000_000          # 10s tumble window, microseconds
+
+
 async def bench_q7(progress: dict) -> None:
-    """q7: tumble-window MAX(price) joined back to bids at the max price
-    (BASELINE config 3) — reference workload q7.sql. Two actors: source +
-    broadcast, and the join graph (2-input barrier alignment).
+    """q7 VIA SQL: tumble-window MAX(price) joined back to the bids at the
+    max price (BASELINE config 3, reference workload q7.sql). The planner
+    supplies what the hand-built round-3 pipeline hard-coded: ONE shared
+    bid source (source sharing), sorted-merge join with per-chunk band
+    eviction derived from the interval ON-condition, append-only running
+    MAX, and input pruning below the join.
 
-    The join is the SortedJoinExecutor: dense sorted state with PER-CHUNK
-    watermark eviction, so capacity bounds the LIVE set (one 2W lookback +
-    one in-flight chunk), NOT the epoch churn — no source rate limit is
-    needed (the round-2 design capped honest throughput at row_capacity x
-    barrier_rate; this one removes the cap). Overflow/match counters are
-    fetched ONCE after the timed region and reported in the JSON note —
-    a dropped row can't hide."""
-    from risingwave_tpu.common import DataType
-    from risingwave_tpu.connectors import NexmarkGenerator
-    from risingwave_tpu.connectors.nexmark import NexmarkConfig
-    from risingwave_tpu.expr import call, col, lit
-    from risingwave_tpu.expr.agg import agg_max
-    from risingwave_tpu.meta import BarrierCoordinator
-    from risingwave_tpu.state import MemoryStateStore
-    from risingwave_tpu.stream import (
-        Actor, BroadcastDispatcher, Channel, ChannelInput, HashAggExecutor,
-        ProjectExecutor, SortedJoinExecutor, SourceExecutor,
-    )
-
-    W = 10_000_000          # 10s tumble window, microseconds
-    chunk_size = 131072
-    cfg = NexmarkConfig(inter_event_us=250)
-    store = MemoryStateStore()
-    barrier_q = asyncio.Queue()
-    gen = NexmarkGenerator("bid", chunk_size=chunk_size, cfg=cfg)
-    src = SourceExecutor(1, gen, barrier_q, emit_watermarks=True,
-                         watermark_lag_us=2 * W)
-    bid4 = ProjectExecutor(
-        src, [col(0), col(1), col(2), col(5, DataType.TIMESTAMP)],
-        names=["auction", "bidder", "price", "date_time"])
-    ch_l, ch_r = Channel(64), Channel(64)
-    disp = BroadcastDispatcher([ch_l, ch_r])
-    BID4 = bid4.schema
-
-    right_in = ChannelInput(ch_r, BID4)
-    tumble = ProjectExecutor(
-        right_in,
-        [call("tumble_end", col(3, DataType.TIMESTAMP), lit(W)), col(2)],
-        names=["window_end", "price"],
-        watermark_transforms={3: (0, lambda v: (v - v % W) + W)})
-    agg = HashAggExecutor(tumble, group_key_indices=[0],
-                          agg_calls=[agg_max(1, append_only=True)],
-                          capacity=1 << 13, group_key_names=["window_end"],
-                          cleaning_watermark_col=0,
-                          watchdog_interval=None)
-    cond = call("and",
-                call("greater_than", col(3, DataType.TIMESTAMP),
-                     call("subtract", col(4, DataType.TIMESTAMP), lit(W))),
-                call("less_than_or_equal", col(3, DataType.TIMESTAMP),
-                     col(4, DataType.TIMESTAMP)))
-    join = SortedJoinExecutor(
-        ChannelInput(ch_l, BID4), agg,
-        left_key_indices=[2], right_key_indices=[1],
-        left_pk_indices=[0, 1, 2, 3], right_pk_indices=[0],
-        capacity=1 << 19, match_factor=2,
-        condition=cond, output_indices=[0, 2, 1, 3],
-        append_only=(True, False),
-        clean_watermark_cols=(3, None), watchdog_interval=None)
-    sink = _DeviceSink(join)
-    coord = BarrierCoordinator(store)
-    coord.register_source(barrier_q)
-    coord.register_actor(1)
-    coord.register_actor(2)
-    t1 = Actor(1, bid4, disp, coord).spawn()
-    t2 = Actor(2, sink, None, coord).spawn()
-    await _measure(coord, gen, sink, progress, MEASURE_S, interval_s=0.05)
-    await coord.stop_all({1, 2})
-    await t1
-    await t2
-    errs = np.asarray(join._errs_dev).tolist()
-    if any(errs):
-        progress["state_errs"] = errs
-
-
+    SET streaming_durability=0 keeps state device-resident (the
+    reference's in-memory state backend) — same durability class as the
+    numpy baseline; the durable path is covered by the crash-recovery
+    test suite."""
+    ddl = [
+        "SET streaming_durability = 0",
+        "SET streaming_watchdog = 0",
+        f"SET streaming_join_capacity = {1 << 19}",
+        "SET streaming_join_match_factor = 2",
+        f"SET streaming_agg_capacity = {1 << 13}",
+        ("CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+         f"chunk_size=131072, inter_event_us=250, emit_watermarks=1, "
+         f"watermark_lag_us={2 * W})"),
+        ("CREATE SINK q7 AS "
+         "SELECT B.auction, B.price, B.bidder, B.date_time "
+         "FROM bid B JOIN ("
+         "  SELECT max(price) AS maxprice, window_end "
+         f"  FROM TUMBLE(bid, date_time, {W}) GROUP BY window_end) B1 "
+         "ON B.price = B1.maxprice "
+         f"AND B.date_time > B1.window_end - {W} "
+         "AND B.date_time <= B1.window_end "
+         "WITH (connector='blackhole_device')"),
+    ]
+    await _bench_sql(progress, ddl, interval_s=0.05)
 
 
 async def bench_q8(progress: dict) -> None:
-    """q8: persons joined with auctions they opened in the same 10s tumble
-    window (BASELINE config 4) — reference workload q8.sql. TWO sources
-    (person, auction) in separate actors, equi-join on (id=seller,
-    window_start), SortedJoinExecutor with per-chunk eviction.
-
-    Chunk sizes keep the 1:3 person:auction EVENT-TIME alignment of the
-    real Nexmark interleave (one event stream split 1:3:46): person rows
-    are 50 global events apart, auction rows 50/3 — equal event-time spans
-    need 3x more auction rows per epoch, or the faster side's watermark
-    would evict rows the slower side still joins against."""
-    from risingwave_tpu.common import DataType
-    from risingwave_tpu.connectors import NexmarkGenerator
-    from risingwave_tpu.connectors.nexmark import NexmarkConfig
-    from risingwave_tpu.expr import call, col, lit
-    from risingwave_tpu.meta import BarrierCoordinator
-    from risingwave_tpu.state import MemoryStateStore
-    from risingwave_tpu.stream import (
-        Actor, Channel, ChannelInput, ProjectExecutor, SimpleDispatcher,
-        SortedJoinExecutor, SourceExecutor,
-    )
-
-    W = 10_000_000
-    p_chunk, a_chunk = 98304, 294912    # 1:3, equal event-time spans
-    cfg = NexmarkConfig(inter_event_us=100)
-    store = MemoryStateStore()
-    q_p, q_a = asyncio.Queue(), asyncio.Queue()
-    gen_p = NexmarkGenerator("person", chunk_size=p_chunk, cfg=cfg)
-    gen_a = NexmarkGenerator("auction", chunk_size=a_chunk, cfg=cfg)
-    src_p = SourceExecutor(1, gen_p, q_p, emit_watermarks=True,
-                           watermark_lag_us=W)
-    src_a = SourceExecutor(2, gen_a, q_a, emit_watermarks=True,
-                           watermark_lag_us=W)
-    # person: (id, window_start); auction: (seller, window_start)
-    pp = ProjectExecutor(
-        src_p, [col(0), call("tumble_start", col(6, DataType.TIMESTAMP),
-                             lit(W))],
-        names=["id", "window_start"],
-        watermark_transforms={6: (1, lambda v: v - v % W)})
-    pa = ProjectExecutor(
-        src_a, [col(7), call("tumble_start", col(5, DataType.TIMESTAMP),
-                             lit(W))],
-        names=["seller", "window_start"],
-        watermark_transforms={5: (1, lambda v: v - v % W)})
-    ch_p, ch_a = Channel(64), Channel(64)
-    # capacity: one in-flight auction chunk (295k) + live window rows
-    # fits 2^19 at the 0.7 threshold; the per-chunk merge is O(capacity),
-    # so larger chunks amortize it
-    join = SortedJoinExecutor(
-        ChannelInput(ch_p, pp.schema), ChannelInput(ch_a, pa.schema),
-        left_key_indices=[0, 1], right_key_indices=[0, 1],
-        left_pk_indices=[0, 1], right_pk_indices=[0, 1],
-        capacity=1 << 19, match_factor=2, output_indices=[0, 1],
-        append_only=(True, True),
-        clean_watermark_cols=(1, 1), watchdog_interval=None)
-    sink = _DeviceSink(join)
-    coord = BarrierCoordinator(store)
-    coord.register_source(q_p)
-    coord.register_source(q_a)
-    coord.register_actor(1)
-    coord.register_actor(2)
-    coord.register_actor(3)
-    t1 = Actor(1, pp, SimpleDispatcher(ch_p), coord).spawn()
-    t2 = Actor(2, pa, SimpleDispatcher(ch_a), coord).spawn()
-    t3 = Actor(3, sink, None, coord).spawn()
-
-    class _TwoGen:
-        """progress counter over both sources."""
-        @property
-        def offset(self):
-            return gen_p.offset + gen_a.offset
-    await _measure(coord, _TwoGen(), sink, progress, MEASURE_S,
-                   interval_s=0.05)
-    await coord.stop_all({1, 2, 3})
-    for t in (t1, t2, t3):
-        await t
-    errs = np.asarray(join._errs_dev).tolist()
-    if any(errs):
-        progress["state_errs"] = errs
-
-
+    """q8 VIA SQL: persons joined with auctions they opened in the same
+    10s tumble window (BASELINE config 4, reference workload q8.sql).
+    The planner derives pair-min watermark eviction on the
+    (window_start, window_start) key pair — safe even when one side's
+    watermark runs ahead, unlike round 3's own-side eviction which needed
+    the 1:3 chunk alignment for correctness (here it is only a state-size
+    optimization)."""
+    ddl = [
+        "SET streaming_durability = 0",
+        "SET streaming_watchdog = 0",
+        f"SET streaming_join_capacity = {1 << 19}",
+        "SET streaming_join_match_factor = 2",
+        ("CREATE SOURCE person WITH (connector='nexmark', table='person', primary_key='id', "
+         "chunk_size=98304, inter_event_us=100, emit_watermarks=1)"),
+        ("CREATE SOURCE auction WITH (connector='nexmark', primary_key='id', "
+         "table='auction', chunk_size=294912, inter_event_us=100, "
+         "emit_watermarks=1)"),
+        ("CREATE SINK q8 AS "
+         "SELECT P.id, P.window_start "
+         f"FROM TUMBLE(person, date_time, {W}) P "
+         f"JOIN TUMBLE(auction, date_time, {W}) A "
+         "ON P.id = A.seller AND P.window_start = A.window_start "
+         "WITH (connector='blackhole_device')"),
+    ]
+    await _bench_sql(progress, ddl, interval_s=0.05)
 
 
 QUERIES = {"q1": bench_q1, "q5": bench_q5, "q7": bench_q7,
@@ -510,6 +473,8 @@ def _query_result(query: str, progress: dict, note: str = "") -> dict:
         out["baseline_rows_per_sec"] = round(base, 1)
     if progress.get("state_errs"):
         out["state_errs"] = progress["state_errs"]
+    if "clean_exit" in progress:
+        out["clean_exit"] = progress["clean_exit"]
     if note:
         out["note"] = note
     return out
@@ -546,34 +511,54 @@ def _one_query_main(query: str) -> None:
                   flush=True)
 
     def _bail():
+        # no-op once the clean final line is out (ADVICE r3 #5: a late
+        # timer must not relabel a successful run as abandoned)
+        if finals["done"]:
+            return
+        progress["clean_exit"] = False
         _emit(f"hard deadline {budget}s; teardown abandoned", final=True)
         os._exit(0)
 
     killer = threading.Timer(budget, _bail)
     killer.daemon = True
     killer.start()
+    timers = [killer]
 
     def _watcher():
+        provisional = False
         while not done.wait(0.5):
-            if progress.get("rows") and progress.get(
-                    "seconds", 0.0) >= MEASURE_S:
+            if progress.get("pipeline_done"):
+                # the pipeline finished and parked: emit the final line
+                # and exit without unwinding the asyncio loop (actor
+                # cancellation blocks on device syncs post-run)
+                for t in timers:
+                    t.cancel()
+                _emit(note, final=True)
+                os._exit(0)
+            if (not provisional and progress.get("rows")
+                    and progress.get("seconds", 0.0) >= MEASURE_S):
+                provisional = True
                 _emit("provisional (teardown pending)")
                 # the number is recorded; don't let a stalled teardown
                 # (blocking d2h on the tunnel) consume the whole budget
                 t2 = threading.Timer(35.0, _bail)
                 t2.daemon = True
                 t2.start()
-                return
+                timers.append(t2)
 
     w = threading.Thread(target=_watcher, daemon=True)
     w.start()
     try:
         asyncio.run(QUERIES[query](progress))
+        progress.setdefault("clean_exit", True)
     except Exception as e:  # noqa: BLE001 — a number beats a stack trace
         note = f"error: {type(e).__name__}: {e}"
-    killer.cancel()
+        progress["clean_exit"] = False
+    for t in timers:
+        t.cancel()
     done.set()
     _emit(note, final=True)
+    os._exit(0)
 
 
 def _emit_combined(results: dict, note: str = "") -> None:
@@ -633,16 +618,7 @@ def main() -> None:
     killer.start()
     t0 = time.perf_counter()
     here = os.path.dirname(os.path.abspath(__file__))
-    # all baselines start NOW, in parallel, on CPU — they are independent
-    # of the device runs and their wall time hides behind device compiles
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    baseline_procs = {}
-    for q, (n, cs) in BASELINE_CHUNKS.items():
-        baseline_procs[q] = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--baseline", q,
-             str(n), str(cs)],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-            env=env, cwd=here)
     for q in ("q1", "q5", "q7", "q8"):
         remaining = GLOBAL_BUDGET_S - (time.perf_counter() - t0) - 10
         if remaining <= 40:   # a query needs import+compile time to matter
@@ -688,23 +664,33 @@ def main() -> None:
         # external timeout kills this orchestrator, the last printed line
         # still carries everything measured so far
         _emit_combined(results, note="in progress")
-    for q, p in baseline_procs.items():
+    # baselines AFTER the device queries and STRICTLY SERIAL: this host
+    # has ONE cpu core (nproc=1), so anything concurrent — device actors
+    # or sibling baselines — depresses the numpy numbers 2-4x and
+    # corrupts vs_baseline in either direction (round-4 measurement)
+    for q, (n, cs) in BASELINE_CHUNKS.items():
         base = None
+        remaining = GLOBAL_BUDGET_S - (time.perf_counter() - t0) - 10
+        if remaining <= 10:
+            continue
         try:
-            out, _ = p.communicate(
-                timeout=max(5.0, GLOBAL_BUDGET_S
-                            - (time.perf_counter() - t0) - 10))
-            for line in out.splitlines():
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--baseline",
+                 q, str(n), str(cs)],
+                capture_output=True, text=True, env=env, cwd=here,
+                timeout=remaining)
+            for line in p.stdout.splitlines():
                 if line.startswith("{"):
                     base = json.loads(line)["baseline_rows_per_sec"]
         except Exception:
-            p.kill()
+            pass
         r = results.get(q)
         if r is not None and base:
             r["baseline_rows_per_sec"] = round(base, 1)
             rps = r.get("rows_per_sec")
             if rps:
                 r["vs_baseline"] = round(rps / base, 3)
+        _emit_combined(results, note="in progress")
     killer.cancel()
     if emit_once.acquire(blocking=False):
         _emit_combined(results)

@@ -130,3 +130,25 @@ def lit(value, dtype: Optional[DataType] = None) -> Literal:
     if dtype is not None:
         e.ret_type = dtype
     return e
+
+
+def input_refs(e: Expr) -> set:
+    """All InputRef indices in an expression tree (optimizer analysis)."""
+    if isinstance(e, InputRef):
+        return {e.index}
+    if isinstance(e, FuncCall):
+        out = set()
+        for a in e.args:
+            out |= input_refs(a)
+        return out
+    return set()
+
+
+def remap_inputs(e: Expr, mapping: dict) -> Expr:
+    """Rewrite InputRef indices through `mapping` (projection pruning)."""
+    if isinstance(e, InputRef):
+        return InputRef(mapping[e.index], e.ret_type)
+    if isinstance(e, FuncCall):
+        return FuncCall(e.name, tuple(remap_inputs(a, mapping)
+                                      for a in e.args), e.ret_type)
+    return e

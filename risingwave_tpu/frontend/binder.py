@@ -143,6 +143,11 @@ class StreamPlanner:
     def cfg(self, name: str, default):
         return self.config.get(name, default)
 
+    def durable(self) -> bool:
+        """Stateful executors flush to state tables at barriers unless the
+        session selected the in-memory state backend."""
+        return bool(self.cfg("streaming_durability", 1))
+
     def fid(self) -> int:
         f = self._next_fid
         self._next_fid = f + 1
@@ -190,8 +195,10 @@ class StreamPlanner:
             wm = frozenset()
             if src.options.get("emit_watermarks"):
                 wm = frozenset({_NEXMARK_WM_COL[src.options["table"]]})
+            pk_opt = src.options.get("primary_key")
             return (f.fid, Scope.of(src.schema, rel.alias or rel.name),
-                    RelInfo(None, True, wm))
+                    RelInfo(None if pk_opt is None else (pk_opt,), True,
+                            wm))
         if isinstance(rel, ast.WindowRel):
             src = self.catalog.source(rel.inner.name)
             scope = Scope.of(src.schema, None)
@@ -235,8 +242,16 @@ class StreamPlanner:
                 wm = (frozenset({len(src.schema), len(src.schema) + 1})
                       if rel.kind == "tumble"
                       else frozenset({len(src.schema)}))
+            # tumble is 1:1 so a declared source pk remains a stream key;
+            # hop emits one row PER WINDOW so the key widens to
+            # (pk, window_start)
+            pk_opt = src.options.get("primary_key")
+            sk = None
+            if pk_opt is not None:
+                sk = ((pk_opt,) if rel.kind == "tumble"
+                      else (pk_opt, len(src.schema)))
             return (f.fid, Scope.of(out_schema, rel.alias or rel.inner.name),
-                    RelInfo(None, True, wm))
+                    RelInfo(sk, True, wm))
         if isinstance(rel, ast.JoinRel):
             lf, ls, li = self.plan_rel(rel.left)
             rf, rs, ri = self.plan_rel(rel.right)
@@ -341,7 +356,7 @@ class StreamPlanner:
                     append_only=(li.append_only, ri.append_only),
                     clean_specs=(clean_l, clean_r),
                     watchdog_interval=wd,
-                    durable=True),
+                    durable=self.durable()),
                     inputs=(Exchange(lf), Exchange(rf)))
             else:
                 if jt != "inner":
@@ -354,10 +369,18 @@ class StreamPlanner:
                     condition=cond,
                     match_factor=self.cfg("streaming_join_match_factor", 64),
                     watchdog_interval=wd,
-                    durable=True),
+                    durable=self.durable()),
                     inputs=(Exchange(lf), Exchange(rf)))
             f = self.graph.add(Fragment(self.fid(), node,
                                         dispatch="broadcast"))
+            # stash for the bind-time optimizer passes (_optimize_join):
+            # filter pushdown + join-input pruning run once the consuming
+            # SELECT is known
+            if not hasattr(self, "_join_frags"):
+                self._join_frags = {}
+            self._join_frags[f.fid] = dict(
+                node=node, nl=len(ls.schema), lsch=ls.schema,
+                rsch=rs.schema, jt=jt)
             off = len(ls.schema)
             jkey = tuple(lpk) + tuple(off + i for i in rpk)
             # the executor forwards min-of-sides watermarks on equi-key
@@ -450,7 +473,12 @@ class StreamPlanner:
         fid, scope, info = self.plan_rel(rel)
         frag = self.graph.fragments[fid]
         sel = ast.Select(expand_star(sel.items, scope.schema), rel,
-                         where, sel.group_by)
+                         where, sel.group_by, list(sel.order_by),
+                         sel.limit, sel.offset)
+
+        jinfo = getattr(self, "_join_frags", {}).get(fid)
+        if jinfo is not None and frag.root is jinfo["node"]:
+            scope, info, sel = self._optimize_join(jinfo, scope, info, sel)
 
         if sel.where is not None:
             pred = bind_scalar(sel.where, scope)
@@ -513,6 +541,231 @@ class StreamPlanner:
             out = self._plan_top_n(top_spec, out)
         return out
 
+    # ----------------------------------------------------- optimizer passes
+    def _optimize_join(self, jinfo, scope: Scope, info: RelInfo,
+                       sel: ast.Select):
+        """Bind-time rewrite passes on a SELECT directly over a join
+        (reference: logical_optimization.rs rules, scoped to the two that
+        shape device state):
+
+        1. PREDICATE PUSHDOWN (inner joins): WHERE conjuncts touching one
+           side move below the join, shrinking its probe+state input.
+           (FilterJoinRule / push_down_filters.)
+        2. JOIN INPUT PRUNING: each side's input narrows to the columns
+           the join or the SELECT actually uses — on TPU the win is
+           direct, join state is dense SoA so every pruned column is HBM
+           bandwidth off the per-chunk merge. (PruneJoinRule /
+           column pruning.)
+        """
+        node, nl = jinfo["node"], jinfo["nl"]
+        lsch, rsch = jinfo["lsch"], jinfo["rsch"]
+        args = node.args
+
+        def refs(e) -> set:
+            if isinstance(e, ast.ColRef):
+                return {scope.resolve(e)[0]}
+            if isinstance(e, ast.BinOp):
+                return refs(e.left) | refs(e.right)
+            if isinstance(e, ast.UnOp):
+                return refs(e.arg)
+            if isinstance(e, ast.Func):
+                out = set()
+                for a in e.args:
+                    out |= refs(a)
+                return out
+            return set()
+
+        # ---- 1. filter pushdown ----
+        if sel.where is not None and jinfo["jt"] == "inner":
+            from ..expr.ir import remap_inputs
+
+            def push_filter(side: int, pred) -> None:
+                inp = node.inputs[side]
+                # absorb into a single-consumer upstream fragment so the
+                # channel carries filtered chunks; else wrap locally
+                if (isinstance(inp, Exchange) and
+                        len(self.graph.consumers(inp.upstream)) == 1):
+                    up = self.graph.fragments[inp.upstream]
+                    up.root = Node("filter", dict(predicate=pred),
+                                   inputs=(up.root,))
+                else:
+                    wrapped = Node("filter", dict(predicate=pred),
+                                   inputs=(inp,))
+                    node.inputs = tuple(
+                        wrapped if i == side else x
+                        for i, x in enumerate(node.inputs))
+
+            keep = []
+            for conj in split_conjuncts(sel.where):
+                cols = refs(conj)
+                if cols and max(cols) < nl:
+                    push_filter(0, bind_scalar(conj, scope))
+                elif cols and min(cols) >= nl:
+                    push_filter(1, remap_inputs(
+                        bind_scalar(conj, scope),
+                        {i: i - nl for i in cols}))
+                else:
+                    keep.append(conj)
+            w = None
+            for c in keep:
+                w = c if w is None else ast.BinOp("and", w, c)
+            sel = ast.Select(sel.items, sel.rel, w, sel.group_by,
+                             sel.order_by, sel.limit, sel.offset)
+
+        # ---- 2. join input pruning ----
+        used = set(info.stream_key or ())
+        for it in sel.items:
+            used |= refs(it.expr)
+        if sel.where is not None:
+            used |= refs(sel.where)
+        for g in sel.group_by:
+            used |= refs(g)
+        for e, _ in sel.order_by:
+            try:
+                used |= refs(e)          # may be an output alias/ordinal
+            except BindError:
+                pass
+        need_l = {i for i in used if i < nl}
+        need_r = {i - nl for i in used if i >= nl}
+        need_l |= set(args["left_key_indices"]) | set(args["left_pk_indices"])
+        need_r |= set(args["right_key_indices"]) | set(args["right_pk_indices"])
+        cond = args.get("condition")
+        if cond is not None:
+            from ..expr.ir import input_refs
+            for i in input_refs(cond):
+                (need_l if i < nl else need_r).add(i if i < nl else i - nl)
+        specs = args.get("clean_specs") or (None, None)
+        for s, spec in enumerate(specs):
+            if spec is None:
+                continue
+            own, other = (need_l, need_r) if s == 0 else (need_r, need_l)
+            own.add(spec[1])
+            if spec[0] == "band":
+                other.add(spec[2])
+                if len(spec) > 4 and spec[4] is not None:
+                    own.add(spec[4])
+        if len(need_l) == nl and len(need_r) == len(rsch):
+            return scope, info, sel     # nothing to prune
+
+        keep_l, keep_r = sorted(need_l), sorted(need_r)
+        lmap = {o: n for n, o in enumerate(keep_l)}
+        rmap = {o: n for n, o in enumerate(keep_r)}
+        jmap = {**{o: lmap[o] for o in keep_l},
+                **{o + nl: len(keep_l) + rmap[o] for o in keep_r}}
+        new_inputs = []
+        for keep, sch, inp in ((keep_l, lsch, node.inputs[0]),
+                               (keep_r, rsch, node.inputs[1])):
+            # prefer absorbing the pruning into the upstream fragment
+            # (single-consumer): its projects then COMPUTE only the kept
+            # columns and the channel carries narrow chunks
+            if (isinstance(inp, Exchange)
+                    and self._push_prune_upstream(inp.upstream, keep, sch)):
+                new_inputs.append(inp)
+            else:
+                new_inputs.append(Node("project", dict(
+                    exprs=[col(i, sch[i].data_type) for i in keep],
+                    names=[sch[i].name for i in keep]),
+                    inputs=(inp,)))
+        node.inputs = tuple(new_inputs)
+        args["left_key_indices"] = [lmap[i] for i in args["left_key_indices"]]
+        args["right_key_indices"] = [rmap[i] for i in args["right_key_indices"]]
+        args["left_pk_indices"] = [lmap[i] for i in args["left_pk_indices"]]
+        args["right_pk_indices"] = [rmap[i] for i in args["right_pk_indices"]]
+        if cond is not None:
+            from ..expr.ir import remap_inputs
+            args["condition"] = remap_inputs(cond, jmap)
+        if any(specs):
+            def remap_spec(spec, s):
+                if spec is None:
+                    return None
+                m, om = (lmap, rmap) if s == 0 else (rmap, lmap)
+                if spec[0] == "band":
+                    cap = (m[spec[4]] if len(spec) > 4
+                           and spec[4] is not None else None)
+                    return ("band", m[spec[1]], om[spec[2]], spec[3], cap)
+                return (spec[0], m[spec[1]]) + tuple(spec[2:])
+            args["clean_specs"] = (remap_spec(specs[0], 0),
+                                   remap_spec(specs[1], 1))
+        # rebuild scope / RelInfo over the pruned joined schema
+        new_fields = tuple(scope.schema[o]
+                           for o in sorted(jmap, key=lambda o: jmap[o]))
+        new_scope = Scope(Schema(new_fields),
+                          {k: (jmap[i], t) for k, (i, t) in
+                           scope.names.items() if i in jmap})
+        new_info = RelInfo(
+            stream_key=(None if info.stream_key is None
+                        else tuple(jmap[i] for i in info.stream_key)),
+            append_only=info.append_only,
+            wm_cols=frozenset(jmap[i] for i in info.wm_cols if i in jmap))
+        return new_scope, new_info, sel
+
+    def _push_prune_upstream(self, up_fid: int, keep: list,
+                             sch: Schema) -> bool:
+        """Absorb an input pruning into the upstream fragment when this
+        join is its only consumer. A `project` root narrows to the kept
+        exprs (unneeded window/passthrough computations disappear
+        entirely); `row_id_gen` composes through (its serial column is
+        always the last kept index); a bare `no_op` gets the project
+        grafted above it. Returns False when the upstream is shared or
+        has an unsupported root (caller falls back to a local project)."""
+        if len(self.graph.consumers(up_fid)) != 1:
+            return False
+        frag = self.graph.fragments[up_fid]
+        # a hash-dispatching fragment routes on OUTPUT positions — they
+        # move with the pruning (or block it if a dist key is dropped)
+        if frag.dispatch == "hash" and frag.dist_key_indices:
+            pos = {o: n for n, o in enumerate(keep)}
+            if not all(d in pos for d in frag.dist_key_indices):
+                return False
+            new_dist = tuple(pos[d] for d in frag.dist_key_indices)
+        else:
+            new_dist = None
+
+        def prune_project(p: Node, keep_idx: list) -> Node:
+            exprs = p.args["exprs"]
+            names = p.args.get("names") or [f"e{i}"
+                                            for i in range(len(exprs))]
+            args = dict(exprs=[exprs[i] for i in keep_idx],
+                        names=[names[i] for i in keep_idx])
+            tf = p.args.get("watermark_transforms")
+            if tf:
+                pos = {o: n for n, o in enumerate(keep_idx)}
+                new_tf = {}
+                for in_col, spec in tf.items():
+                    specs = spec if isinstance(spec, list) else [spec]
+                    kept = [(pos[o], fn) for o, fn in specs if o in pos]
+                    if kept:
+                        new_tf[in_col] = kept
+                if new_tf:
+                    args["watermark_transforms"] = new_tf
+            return Node("project", args, inputs=p.inputs)
+
+        def graft(inner: Node, keep_idx: list) -> Node:
+            return Node("project", dict(
+                exprs=[col(i, sch[i].data_type) for i in keep_idx],
+                names=[sch[i].name for i in keep_idx]),
+                inputs=(inner,))
+
+        root = frag.root
+        if root.kind == "project":
+            frag.root = prune_project(root, keep)
+        elif root.kind == "row_id_gen":
+            rid = len(sch) - 1
+            if rid not in keep:
+                return False
+            inner_keep = [i for i in keep if i < rid]
+            inner = root.inputs[0]
+            root.inputs = ((prune_project(inner, inner_keep)
+                            if inner.kind == "project"
+                            else graft(inner, inner_keep)),)
+        else:
+            # any other root (filter, no_op, stream_scan, agg...): graft
+            # the narrowing project on top — the channel still narrows
+            frag.root = graft(root, keep)
+        if new_dist is not None:
+            frag.dist_key_indices = new_dist
+        return True
+
     def _plan_top_n(self, top_spec, planned):
         """Streaming ORDER BY + LIMIT -> RetractableTopN over the query's
         changelog (reference: StreamTopN; retraction-capable because the
@@ -542,7 +795,7 @@ class StreamPlanner:
         top = self.graph.add(Fragment(self.fid(), Node(
             "retract_top_n", dict(
                 group_key_indices=(), order_col=idx, limit=limit,
-                offset=offset, descending=desc, durable=True,
+                offset=offset, descending=desc, durable=self.durable(),
                 pk_indices=list(pk_hint)),
             inputs=(Exchange(fid),)), dispatch="simple"))
         # ranks can change retroactively: no watermark survives a TopN
@@ -570,7 +823,11 @@ class StreamPlanner:
 
         def add_call(kind: AggKind, arg: Optional[int],
                      ret: DataType) -> int:
-            agg_calls.append(AggCall(kind, arg, ret))
+            # append-only inputs get the cheap agg variants (running
+            # max/min instead of retractable top-K buffers) — the
+            # reference picks them by the same plan property
+            agg_calls.append(AggCall(kind, arg, ret,
+                                     append_only=info.append_only))
             return len(agg_calls) - 1
 
         for it in sel.items:
@@ -626,7 +883,7 @@ class StreamPlanner:
             agg = self.graph.add(Fragment(self.fid(), Node(
                 "hash_agg", dict(
                     group_key_indices=list(range(len(keys))),
-                    agg_calls=agg_calls, durable=True,
+                    agg_calls=agg_calls, durable=self.durable(),
                     capacity=self.cfg("streaming_agg_capacity", 1 << 16),
                     cleaning_watermark_col=(wm_keys[0] if wm_keys
                                             else None),
@@ -640,7 +897,7 @@ class StreamPlanner:
             # (reference: DistId::Singleton, simple_agg.rs)
             frag.dispatch = "simple"
             agg = self.graph.add(Fragment(self.fid(), Node(
-                "simple_agg", dict(agg_calls=agg_calls, durable=True),
+                "simple_agg", dict(agg_calls=agg_calls, durable=self.durable()),
                 inputs=(Exchange(fid),)),
                 dispatch="simple"))
 

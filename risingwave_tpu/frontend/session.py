@@ -102,6 +102,10 @@ class Session:
         "streaming_agg_capacity": (1 << 16, int),
         "streaming_watchdog": (1, int),      # 0 disables d2h error fetches
         "streaming_parallelism": (1, int),
+        # 0 = in-memory state backend for stateful executors (reference:
+        # the in-memory hummock backend) — no per-barrier state-table
+        # flush; crash recovery then replays sources from scratch
+        "streaming_durability": (1, int),
     }
 
     def __init__(self, store=None):
@@ -247,6 +251,14 @@ class Session:
         if "emit_watermarks" in opts:
             v = opts.pop("emit_watermarks")
             args["emit_watermarks"] = v in (True, 1, "1", "true", "t", "on")
+        if "primary_key" in opts:
+            # reference: PRIMARY KEY on CREATE TABLE/SOURCE — declares a
+            # unique column so downstream state needs no generated row id
+            pk_name = opts.pop("primary_key")
+            names = list(_NEXMARK_SCHEMAS[table].names)
+            if pk_name not in names:
+                raise BindError(f"primary_key {pk_name!r} not a column")
+            args["primary_key"] = names.index(pk_name)
         for k in ("watermark_lag_us", "rate_limit"):
             if k in opts:
                 args[k] = int(opts.pop(k))

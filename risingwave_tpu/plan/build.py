@@ -501,9 +501,12 @@ def _build_retract_top_n(args, inputs, ctx: ActorCtx, key):
 
 @register_builder("sink")
 def _build_sink(args, inputs, ctx: ActorCtx, key):
-    from ..stream.sink import (BlackholeSink, CallbackSink, FileSink,
+    from ..stream.sink import (BlackholeSink, CallbackSink,
+                               DeviceBlackholeSinkExecutor, FileSink,
                                SinkExecutor)
     connector = args.get("connector", "blackhole")
+    if connector == "blackhole_device":
+        return DeviceBlackholeSinkExecutor(inputs[0])
     if connector == "blackhole":
         target = BlackholeSink()
     elif connector == "file":

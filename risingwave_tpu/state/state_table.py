@@ -183,11 +183,14 @@ class StateTable:
             mc = mc_encode_i64_batch(pk_mat)
             if mc is not None:
                 if self.dist_key_indices:
-                    dist = np.stack(
-                        [np.asarray(cols[i], dtype=np.int64)[idx]
-                         for i in self.dist_key_indices], axis=1)
-                    vns = (crc32_i64_batch(dist)
-                           & np.uint32(VNODE_COUNT - 1)).astype(np.uint8)
+                    # MUST match compute_vnodes_numpy / the device hash
+                    # (splitmix64) — the native crc32 batch is for the
+                    # serialization goldens only; using it here would
+                    # place batch-written rows under different keys than
+                    # per-row gets/deletes compute
+                    dist = [np.asarray(cols[i], dtype=np.int64)[idx]
+                            for i in self.dist_key_indices]
+                    vns = compute_vnodes_numpy(dist).astype(np.uint8)
                 else:
                     vns = np.zeros(idx.size, dtype=np.uint8)
                 prefix = np.frombuffer(

@@ -94,6 +94,29 @@ class FileSink(SinkTarget):
         return self._committed
 
 
+class DeviceBlackholeSinkExecutor(Executor):
+    """Benchmark/terminal sink that consumes the changelog WITHOUT host
+    readback: chunks stay device arrays, only a reference to the last
+    column is kept so callers can block_until_ready() for drain syncs.
+    The reference's blackhole sink serves the same role in its benches;
+    on a tunneled TPU this is also the only sink that cannot poison
+    dispatch with d2h fetches."""
+
+    def __init__(self, input: Executor):
+        self.input = input
+        self.schema = input.schema
+        self.pk_indices = getattr(input, "pk_indices", ())
+        self.identity = "DeviceBlackholeSink"
+        self.last = None
+
+    async def execute(self):
+        from ..common.chunk import StreamChunk
+        async for msg in self.input.execute():
+            if isinstance(msg, StreamChunk) and msg.columns:
+                self.last = msg.columns[-1].data
+            yield msg
+
+
 class SinkExecutor(Executor):
     """Terminal executor: buffers the epoch's changelog on the host and
     delivers it at the barrier (rows leave the system here, so the d2h
