@@ -303,6 +303,25 @@ def _run_agg(rel: _Rel, sel: ast.Select, items):
             ee = bind_scalar(e.args[0], rel.scope)
             v, valid = eval_numpy(ee, rel.cols, rel.valids)
             vals = np.asarray(v)
+            if (ee.ret_type is DataType.VARCHAR
+                    and e.name in ("min", "max")):
+                # dict ids are insertion-ordered; min/max over VARCHAR
+                # must rank lexicographically (ADVICE r3 #3): reduce over
+                # ranks of the decoded strings, then map the winning rank
+                # back to its dict id
+                uniq, inv = np.unique(vals, return_inverse=True)
+                if len(uniq) == 0:
+                    return (np.zeros(n_groups, dtype=np.int64),
+                            np.zeros(n_groups, dtype=bool))
+                strs = np.asarray(GLOBAL_DICT.decode_many(uniq))
+                order = np.argsort(strs)          # rank -> uniq position
+                rank_of = np.empty(len(uniq), dtype=np.int64)
+                rank_of[order] = np.arange(len(uniq))
+                ranks, out_valid = _agg_reduce(_AGG_KINDS[e.name],
+                                               rank_of[inv], valid,
+                                               seg_id, n_groups)
+                safe = np.clip(ranks, 0, len(uniq) - 1)
+                return uniq[order][safe].astype(np.int64), out_valid
         out, out_valid = _agg_reduce(_AGG_KINDS[e.name], vals, valid,
                                      seg_id, n_groups)
         return out, out_valid

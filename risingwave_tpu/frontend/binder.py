@@ -784,6 +784,15 @@ class StreamPlanner:
             idx = names.index(e.name)
         if idx is None or not 0 <= idx < len(names):
             raise BindError("streaming ORDER BY must name an output column")
+        if types[idx] is DataType.VARCHAR:
+            # dict ids order by insertion, not lexicographically; a
+            # streaming TopN over them would silently return wrong rows
+            # (ADVICE r3 #2) — the batch path ranks decoded strings, so
+            # point users there
+            raise BindError(
+                "streaming ORDER BY over VARCHAR is unsupported (dict "
+                "encoding is not lexicographic); ORDER BY in a batch "
+                "SELECT over the MV instead")
         if pk_hint is None:
             raise BindError(
                 "streaming TopN over a keyless stream is unsupported "
@@ -854,6 +863,16 @@ class StreamPlanner:
                     a = add_arg(e.args[0])
                     kind = AggKind.MIN if e.name == "min" else AggKind.MAX
                     at = pre_exprs[a].ret_type
+                    if at is DataType.VARCHAR:
+                        # same hazard as the streaming ORDER BY guard:
+                        # dict ids are not lexicographic, and the stream
+                        # agg reduces raw ids — batch SELECTs rank the
+                        # decoded strings instead
+                        raise BindError(
+                            f"streaming {e.name}() over VARCHAR is "
+                            "unsupported (dict encoding is not "
+                            "lexicographic); aggregate in a batch "
+                            "SELECT over the MV instead")
                     items_plan.append(("agg", add_call(kind, a, at)))
             else:
                 # must be one of the group-by expressions

@@ -1,10 +1,21 @@
-"""Sink executor — changelog egress with exactly-once epoch commits.
+"""Sink executor — changelog egress with AT-LEAST-ONCE epoch delivery.
 
 Reference: src/connector/src/sink/ (trait Sink + 12 connectors; mod.rs)
-and the sink executor (stream/src/executor/sink.rs) with log-store
-decoupling: rows buffer per epoch and deliver transactionally at the
-checkpoint barrier, so a crash replays from the last committed epoch and
-the target never sees a half-epoch.
+and the sink executor (stream/src/executor/sink.rs).
+
+Delivery semantics (ADVICE r3 #1, documented honestly): each epoch's rows
+deliver ATOMICALLY at its checkpoint barrier, ascending, and a restart
+never hands the target a half-epoch — but delivery happens when the
+barrier REACHES the sink, before the coordinator has durably committed
+the epoch, and post-crash replays mint fresh (wall-clock) epoch ids. The
+`committed_epoch()` dedupe therefore cannot match replayed rows, and the
+crash window delivers twice: at-least-once with per-epoch atomicity.
+Exactly-once requires the reference's log-store decoupling (persist the
+epoch batch in sink state committed WITH the checkpoint, deliver from
+the log after commit, target-side sequence dedupe) — not yet built.
+Delivering only after commit is NOT an alternative: a crash between
+commit and delivery would silently DROP the epoch (at-most-once), since
+recovery does not replay committed epochs.
 
 Targets here:
   * BlackholeSink   — counts rows (the reference's blackhole connector,
@@ -18,7 +29,8 @@ Targets here:
 Delivery contract: `write(epoch, rows)` with rows = list of (op, values)
 in changelog order, called once per epoch at its CHECKPOINT barrier,
 ascending epochs; `committed_epoch()` lets the executor skip epochs the
-target already has (exactly-once across restarts)."""
+target already saw WITHIN one incarnation (cross-restart dedupe limited
+as described above)."""
 
 from __future__ import annotations
 
@@ -61,7 +73,8 @@ class CallbackSink(SinkTarget):
 class FileSink(SinkTarget):
     """JSONL with per-epoch records: {"epoch": E, "rows": [[op, [...]], ...]}.
     The append-only file doubles as the delivery log: recovery reads the
-    last epoch and skips re-delivered ones (exactly-once)."""
+    last epoch and skips SAME-ID re-deliveries (see module docstring for
+    why crash-window rows can still appear twice under fresh epoch ids)."""
 
     def __init__(self, path: str, schema=None):
         self.path = path
