@@ -55,6 +55,12 @@ async def repl(args) -> None:
                 return
 
     tick_task = asyncio.create_task(ticker())
+    pg = None
+    if args.pgwire:
+        from .frontend.pgwire import PgServer
+        pg = await PgServer(session, port=args.pgwire).start()
+        print(f"pgwire listening on {pg.addr[0]}:{pg.addr[1]} "
+              f"(psql -h {pg.addr[0]} -p {pg.addr[1]})")
     print("risingwave_tpu playground — SQL statements end with ';', "
           "\\q quits")
     loop = asyncio.get_event_loop()
@@ -102,6 +108,8 @@ async def repl(args) -> None:
                 print(f"CREATE {kind} ok")
     stop.set()
     await tick_task
+    if pg is not None:
+        await pg.stop()
     await (session.shutdown() if args.data else session.drop_all())
     # the stdin executor thread may still be blocked in input(); a normal
     # interpreter exit would wait for it until the user presses Enter
@@ -116,6 +124,9 @@ def main() -> None:
                    help="durable state directory (default: in-memory)")
     p.add_argument("--tick-ms", type=int, default=1000,
                    help="barrier interval (reference barrier_interval_ms)")
+    p.add_argument("--pgwire", type=int, default=None, metavar="PORT",
+                   help="serve the PostgreSQL wire protocol on PORT "
+                        "(reference default: 4566)")
     asyncio.run(repl(p.parse_args()))
 
 

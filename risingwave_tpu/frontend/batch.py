@@ -161,6 +161,12 @@ _AGG_KINDS = {"count": AggKind.COUNT, "sum": AggKind.SUM,
 
 
 def run_batch_select(catalog, sel: ast.Select) -> list[tuple]:
+    return run_batch_select_full(catalog, sel)[2]
+
+
+def run_batch_select_full(catalog, sel: ast.Select):
+    """-> (names, DataTypes, rows) — the wire layer needs the row
+    description, not just the rows."""
     rel = _bind_rel(catalog, sel.rel)
     if sel.where is not None:
         pred = bind_scalar(sel.where, rel.scope)
@@ -225,8 +231,8 @@ def run_batch_select(catalog, sel: ast.Select) -> list[tuple]:
             return GLOBAL_DICT.decode(int(v))
         return v
 
-    return [tuple(cell(j, i) for j in range(len(out_cols)))
-            for i in range(n)]
+    return out_names, out_types, [
+        tuple(cell(j, i) for j in range(len(out_cols))) for i in range(n)]
 
 
 def _order_col(e, out_cols, out_names) -> int:

@@ -1,0 +1,198 @@
+"""pgwire — the PostgreSQL wire protocol server (layer 1, client protocol).
+
+Reference: src/utils/pgwire/src/pg_server.rs:173 (tcp accept loop),
+pg_protocol.rs:391 (message dispatch), :548 (simple query). This is NOT a
+port of that 6k-LoC crate: it implements the subset a stock `psql`/driver
+needs for the simple-query flow —
+
+  SSLRequest            -> 'N' (no TLS)
+  StartupMessage        -> AuthenticationOk, ParameterStatus*,
+                           BackendKeyData, ReadyForQuery
+  Query ('Q')           -> RowDescription + DataRow* + CommandComplete
+                           (SELECT) or CommandComplete (DDL) or
+                           ErrorResponse, then ReadyForQuery
+  Terminate ('X')       -> close
+
+Extended protocol (Parse/Bind/Execute) is answered with ErrorResponse so
+drivers fall back to simple queries where possible. All values transfer
+in text format (format code 0), NULL as the -1 length sentinel.
+
+The server shares the Session's asyncio loop: DDL statements await
+`Session.execute` (which runs barrier rounds), SELECTs call the batch
+engine over committed snapshots — identical semantics to the REPL.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Optional
+
+from ..common.types import DataType
+from . import sql as ast
+from .binder import BindError
+from .sql import SqlError
+
+# text-format type OIDs (pg_catalog): int8, float8, text, bool
+_OID = {
+    DataType.INT64: 20, DataType.INT32: 23, DataType.INT16: 21,
+    DataType.FLOAT64: 701, DataType.FLOAT32: 700,
+    DataType.VARCHAR: 25, DataType.BOOLEAN: 16,
+}
+
+
+def _oid(t) -> int:
+    return _OID.get(t, 20)      # timestamps/decimals ride as int8 micros
+
+
+def _msg(tag: bytes, payload: bytes) -> bytes:
+    return tag + struct.pack("!i", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+class PgServer:
+    """asyncio TCP server speaking the v3 protocol against one Session."""
+
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 4566):
+        self.session = session
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> "PgServer":
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    @property
+    def addr(self):
+        return self._server.sockets[0].getsockname()
+
+    # ------------------------------------------------------- connection
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            if not await self._startup(reader, writer):
+                return
+            while True:
+                hdr = await reader.readexactly(5)
+                tag, ln = hdr[:1], struct.unpack("!i", hdr[1:])[0]
+                payload = await reader.readexactly(ln - 4)
+                if tag == b"X":
+                    return
+                if tag == b"Q":
+                    sql_text = payload.rstrip(b"\x00").decode()
+                    await self._simple_query(writer, sql_text)
+                else:
+                    # extended protocol / unknown: error + ready
+                    self._error(writer, "0A000",
+                                f"unsupported message {tag!r} (simple "
+                                f"query protocol only)")
+                    self._ready(writer)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    async def _startup(self, reader, writer) -> bool:
+        while True:
+            ln = struct.unpack("!i", await reader.readexactly(4))[0]
+            body = await reader.readexactly(ln - 4)
+            code = struct.unpack("!i", body[:4])[0]
+            if code in (80877103, 80877104):  # SSLRequest / GSSENCRequest
+                writer.write(b"N")
+                await writer.drain()
+                continue
+            if code == 80877102:              # CancelRequest
+                return False
+            break                              # StartupMessage
+        writer.write(_msg(b"R", struct.pack("!i", 0)))   # AuthenticationOk
+        for k, v in (("server_version", "9.5.0"),
+                     ("server_encoding", "UTF8"),
+                     ("client_encoding", "UTF8"),
+                     ("DateStyle", "ISO"),
+                     ("standard_conforming_strings", "on"),
+                     ("integer_datetimes", "on")):
+            writer.write(_msg(b"S", _cstr(k) + _cstr(v)))
+        writer.write(_msg(b"K", struct.pack("!ii", 0, 0)))
+        self._ready(writer)
+        await writer.drain()
+        return True
+
+    def _ready(self, writer) -> None:
+        writer.write(_msg(b"Z", b"I"))
+
+    def _error(self, writer, code: str, message: str) -> None:
+        fields = (b"S" + _cstr("ERROR") + b"C" + _cstr(code)
+                  + b"M" + _cstr(message) + b"\x00")
+        writer.write(_msg(b"E", fields))
+
+    # ------------------------------------------------------ simple query
+    async def _simple_query(self, writer, sql_text: str) -> None:
+        sql_text = sql_text.strip()
+        if not sql_text or sql_text == ";":
+            writer.write(_msg(b"I", b""))     # EmptyQueryResponse
+            self._ready(writer)
+            return
+        try:
+            stmt = ast.parse(sql_text)
+            if isinstance(stmt, ast.Select):
+                from .batch import run_batch_select_full
+                names, types, rows = run_batch_select_full(
+                    self.session.catalog, stmt)
+                self._row_description(writer, names, types)
+                for row in rows:
+                    self._data_row(writer, row)
+                writer.write(_msg(b"C", _cstr(f"SELECT {len(rows)}")))
+            else:
+                await self.session.execute(sql_text)
+                writer.write(_msg(b"C", _cstr(_tag_of(stmt))))
+        except (BindError, SqlError) as e:
+            self._error(writer, "42601", str(e))
+        except Exception as e:  # noqa: BLE001 — surface, don't kill conn
+            self._error(writer, "XX000", f"{type(e).__name__}: {e}")
+        self._ready(writer)
+
+    def _row_description(self, writer, names, types) -> None:
+        body = struct.pack("!h", len(names))
+        for name, t in zip(names, types):
+            body += (_cstr(name)
+                     + struct.pack("!ihihih", 0, 0, _oid(t),
+                                   -1, -1, 0))
+        writer.write(_msg(b"T", body))
+
+    def _data_row(self, writer, row) -> None:
+        body = struct.pack("!h", len(row))
+        for v in row:
+            if v is None:
+                body += struct.pack("!i", -1)
+            else:
+                # pg text format: booleans are 't'/'f' (OID 16 contract)
+                s = (b"t" if v else b"f") if isinstance(v, bool) \
+                    else str(v).encode()
+                body += struct.pack("!i", len(s)) + s
+        writer.write(_msg(b"D", body))
+
+
+def _tag_of(stmt) -> str:
+    if isinstance(stmt, ast.CreateSource):
+        return "CREATE_SOURCE"
+    if isinstance(stmt, ast.CreateMV):
+        return "CREATE_MATERIALIZED_VIEW"
+    if isinstance(stmt, ast.CreateSink):
+        return "CREATE_SINK"
+    if isinstance(stmt, ast.AlterParallelism):
+        return "ALTER_MATERIALIZED_VIEW"
+    if isinstance(stmt, ast.SetVar):
+        return "SET"
+    return "OK"

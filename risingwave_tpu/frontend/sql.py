@@ -26,7 +26,8 @@ KEYWORDS = {
     "not", "tumble", "hop", "count", "sum", "min", "max", "avg", "limit",
     "order", "desc", "asc", "offset", "between", "emit", "table", "sink",
     "alter", "set", "parallelism", "left", "right", "full", "outer",
-    "inner",
+    "inner", "over", "partition", "rows", "unbounded", "preceding",
+    "current", "row",
 }
 
 _TOKEN_RE = re.compile(r"""
@@ -131,6 +132,16 @@ class JoinRel:
     right: object
     on: object                  # None = comma join (ON comes from WHERE)
     join_type: str = "inner"    # inner | left | right | full
+
+
+@dataclass
+class WindowFunc:
+    """func(...) OVER (PARTITION BY ... ORDER BY ... [frame])."""
+
+    func: "Func"
+    partition_by: list
+    order_by: list              # [(expr, descending)]
+    preceding: Optional[int] = None   # None = UNBOUNDED PRECEDING
 
 
 @dataclass
@@ -489,12 +500,52 @@ class Parser:
                     while self.accept("op", ","):
                         args.append(self._expr())
                     self.expect("op", ")")
-                return Func(name, args)
+                f = Func(name, args)
+                if self.accept("kw", "over"):
+                    return self._over_clause(f)
+                return f
             if self.accept("op", "."):
                 col = self.next().val
                 return ColRef(col, qualifier=name)
             return ColRef(name)
         raise SqlError(f"unexpected token {t.val!r}")
+
+    def _over_clause(self, f: Func) -> WindowFunc:
+        """OVER (PARTITION BY cols ORDER BY col [DESC], ...
+        [ROWS BETWEEN n PRECEDING AND CURRENT ROW
+         | ROWS UNBOUNDED PRECEDING])"""
+        self.expect("op", "(")
+        partition_by, order_by, preceding = [], [], None
+        if self.accept("kw", "partition"):
+            self.expect("kw", "by")
+            partition_by.append(self._expr())
+            while self.accept("op", ","):
+                partition_by.append(self._expr())
+        if self.accept("kw", "order"):
+            self.expect("kw", "by")
+            while True:
+                e = self._expr()
+                desc = bool(self.accept("kw", "desc"))
+                if not desc:
+                    self.accept("kw", "asc")
+                order_by.append((e, desc))
+                if not self.accept("op", ","):
+                    break
+        if self.accept("kw", "rows"):
+            if self.accept("kw", "between"):
+                if self.accept("kw", "unbounded"):
+                    self.expect("kw", "preceding")
+                else:
+                    preceding = int(self.expect("num").val)
+                    self.expect("kw", "preceding")
+                self.expect("kw", "and")
+                self.expect("kw", "current")
+                self.expect("kw", "row")
+            else:
+                self.expect("kw", "unbounded")
+                self.expect("kw", "preceding")
+        self.expect("op", ")")
+        return WindowFunc(f, partition_by, order_by, preceding)
 
 
 def parse(sql: str):

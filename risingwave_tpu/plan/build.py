@@ -414,6 +414,21 @@ def _build_top_n(args, inputs, ctx: ActorCtx, key):
         watchdog_interval=args.get("watchdog_interval", 1))
 
 
+@register_builder("general_over_window")
+def _build_general_over_window(args, inputs, ctx: ActorCtx, key):
+    from ..stream.general_over_window import GeneralOverWindowExecutor
+    pk = tuple(args["pk_indices"])
+    st = None
+    if args.get("durable"):
+        st = ctx.env.state_table(ctx.table_id(key), inputs[0].schema, pk,
+                                 vnode_bitmap=ctx.vnode_bitmap)
+    return GeneralOverWindowExecutor(
+        inputs[0], args["partition_by"], args["order_specs"],
+        args["windows"], capacity=args.get("capacity", 1 << 14),
+        state_table=st, pk_indices=pk,
+        watchdog_interval=args.get("watchdog_interval", 1))
+
+
 @register_builder("dedup")
 def _build_dedup(args, inputs, ctx: ActorCtx, key):
     st = None
@@ -492,7 +507,9 @@ def _build_retract_top_n(args, inputs, ctx: ActorCtx, key):
                                  vnode_bitmap=ctx.vnode_bitmap)
     return RetractableTopNExecutor(
         inputs[0], args.get("group_key_indices", ()),
-        args["order_col"], args["limit"], offset=args.get("offset", 0),
+        order_col=args.get("order_col"),
+        order_specs=args.get("order_specs"),
+        limit=args["limit"], offset=args.get("offset", 0),
         descending=args.get("descending", False),
         capacity=args.get("capacity", 1 << 14),
         state_table=st, pk_indices=pk,

@@ -31,3 +31,4 @@ from .misc import (
     ExpandExecutor, FlowControlExecutor, NoOpExecutor, UnionExecutor,
     ValuesExecutor, WatermarkFilterExecutor,
 )
+from .general_over_window import GeneralOverWindowExecutor, WindowSpec  # noqa: E402,F401

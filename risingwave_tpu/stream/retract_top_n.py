@@ -34,11 +34,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..common.chunk import Column, StreamChunk, OP_DELETE, OP_INSERT, op_sign
+from functools import partial
+
+from ..common.chunk import Column, StreamChunk, OP_DELETE, OP_INSERT
 from ..ops.hash_table import stable_lexsort
 from .executor import Executor, StatefulUnaryExecutor
 from .message import Barrier, Watermark
-from .sorted_join import _HSENTINEL, _count_le, key_hash
+from .sorted_join import _HSENTINEL, key_hash
+from .sorted_store import sorted_store_apply
 
 
 class RetractableTopNExecutor(StatefulUnaryExecutor):
@@ -48,8 +51,9 @@ class RetractableTopNExecutor(StatefulUnaryExecutor):
 
     def __init__(self, input: Executor,
                  group_key_indices: Sequence[int],
-                 order_col: int, limit: int, offset: int = 0,
+                 order_col=None, limit: int = 0, offset: int = 0,
                  descending: bool = False,
+                 order_specs: Optional[Sequence[tuple]] = None,
                  capacity: int = 1 << 14,
                  state_table=None,
                  pk_indices: Optional[Sequence[int]] = None,
@@ -60,13 +64,18 @@ class RetractableTopNExecutor(StatefulUnaryExecutor):
             pk_indices if pk_indices is not None
             else (input.pk_indices or range(len(input.schema))))
         self.group_key_indices = tuple(group_key_indices)
-        self.order_col = order_col
+        # order_specs: [(col, descending)] most-significant first
+        # (top_n_cache.rs handles arbitrary order keys the same way);
+        # (order_col, descending) kept as the single-key shorthand
+        if order_specs is None:
+            assert order_col is not None
+            order_specs = [(order_col, descending)]
+        self.order_specs = tuple((int(c), bool(d)) for c, d in order_specs)
         self.limit = limit
         self.offset = offset
-        self.descending = descending
         self.capacity = capacity
         self.identity = (f"RetractTopN(g={self.group_key_indices}, "
-                         f"by={order_col}, k={limit})")
+                         f"by={self.order_specs}, k={limit})")
         C = capacity
         dts = tuple(f.data_type.jnp_dtype for f in input.schema)
         self._col_dtypes = dts
@@ -82,7 +91,9 @@ class RetractableTopNExecutor(StatefulUnaryExecutor):
         self.top_valids = tuple(jnp.zeros(C, dtype=bool) for _ in dts)
         self.top_n = jnp.int32(0)
         self._errs_dev = jnp.zeros(2, dtype=jnp.int32)  # [row_ovf, del_miss]
-        self._apply = jax.jit(self._apply_impl)
+        self._apply = jax.jit(partial(sorted_store_apply,
+                                      pk_idx=self.pk_indices,
+                                      capacity=self.capacity))
         self._flush = jax.jit(self._flush_impl)
         # durability: the state table materializes the FULL input row set
         # keyed by the stream key (the reference's TopN state table holds
@@ -90,98 +101,6 @@ class RetractableTopNExecutor(StatefulUnaryExecutor):
         # chunks apply to it at the barrier, recovery re-inserts them
         self._epoch_chunks: list[StreamChunk] = []
         self._init_stateful(state_table, watchdog_interval)
-
-    # ------------------------------------------------------------- apply
-    def _apply_impl(self, khash, cols, valids, n, errs, chunk: StreamChunk):
-        """Insert/retract chunk rows into the sorted dense store (the
-        own-side update of sorted_join._apply_impl, sans probe)."""
-        N = chunk.capacity
-        C = self.capacity
-        pk_idx = self.pk_indices
-        active = chunk.vis
-        signs = op_sign(chunk.ops)
-        row_ids = jnp.arange(N, dtype=jnp.int32)
-        h = key_hash([chunk.columns[i].data for i in pk_idx])
-
-        # within-chunk pk-run netting (sorted_join semantics)
-        sort_keys = [row_ids]
-        for p in pk_idx:
-            sort_keys.append(chunk.columns[p].data)
-        sort_keys.append(~active)
-        order = stable_lexsort(tuple(sort_keys))
-        s_act = active[order]
-        same = s_act[1:] & s_act[:-1]
-        for p in pk_idx:
-            d = chunk.columns[p].data[order]
-            same = same & (d[1:] == d[:-1])
-        run_start = jnp.concatenate([jnp.array([True]), ~same])
-        run_end = jnp.concatenate([~same, jnp.array([True])])
-        s_signs = signs[order]
-        is_del = jnp.zeros(N, dtype=bool).at[order].set(
-            run_start & (s_signs < 0) & s_act)
-        is_ins = jnp.zeros(N, dtype=bool).at[order].set(
-            run_end & (s_signs > 0) & s_act)
-
-        live = jnp.arange(C, dtype=jnp.int32) < n
-        keep = live
-        # deletes: exact (hash, pk) match
-        dlo = jnp.searchsorted(khash, h, side="left").astype(jnp.int32)
-        dhi = jnp.searchsorted(khash, h, side="right").astype(jnp.int32)
-        M = 2 * N
-        dlens = jnp.where(is_del, (dhi - dlo).astype(jnp.int64), 0)
-        doffs = jnp.cumsum(dlens)
-        dtot = doffs[N - 1]
-        j = jnp.arange(M, dtype=jnp.int64)
-        dsrc = jnp.searchsorted(doffs, j, side="right").astype(jnp.int32)
-        dsrcc = jnp.clip(dsrc, 0, N - 1)
-        dprev = jnp.where(dsrcc > 0, doffs[jnp.clip(dsrcc - 1, 0)], 0)
-        dpos = jnp.clip(dlo[dsrcc] + (j - dprev), 0, C - 1).astype(jnp.int32)
-        cand = (j < jnp.minimum(dtot, M)) & keep[dpos]
-        for p in pk_idx:
-            cand &= (cols[p][dpos]
-                     == chunk.columns[p].data[dsrcc].astype(cols[p].dtype))
-        victim = jnp.full(N, C, dtype=jnp.int32).at[
-            jnp.where(cand, dsrcc, N)].min(dpos, mode="drop")
-        found = victim < C
-        keep = keep.at[jnp.where(found, victim, C)].set(False, mode="drop")
-        n_del_miss = jnp.sum((is_del & ~found).astype(jnp.int32))
-
-        # merge inserts (stable, state rows before equal-hash new rows)
-        ins_h = jnp.where(is_ins, h, _HSENTINEL)
-        iorder = jnp.argsort(ins_h, stable=True)
-        nh = ins_h[iorder]
-        n_new = jnp.sum(is_ins.astype(jnp.int32))
-        dead_cum = jnp.cumsum((~keep).astype(jnp.int32))
-        kept_rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
-        n_kept = kept_rank[C - 1] + 1
-        new_lt = jnp.searchsorted(nh, khash, side="left").astype(jnp.int32)
-        pos_t = kept_rank + new_lt
-        kept_le = _count_le(khash, dead_cum, nh, side="right")
-        rr = jnp.arange(N, dtype=jnp.int32)
-        pos_r = rr + kept_le
-        new_ok = rr < n_new
-        n_after = n_kept + n_new
-        n_row_overflow = jnp.maximum(n_after - C, 0)
-        n_after = jnp.minimum(n_after, C)
-        tgt_t = jnp.where(keep & (pos_t < C), pos_t, C)
-        tgt_r = jnp.where(new_ok & (pos_r < C), pos_r, C)
-        kh2 = jnp.full(C, _HSENTINEL, dtype=jnp.int64)
-        kh2 = kh2.at[tgt_t].set(khash, mode="drop")
-        kh2 = kh2.at[tgt_r].set(nh, mode="drop")
-        cols2, valids2 = [], []
-        for ci, (sc, sv) in enumerate(zip(cols, valids)):
-            col = chunk.columns[ci]
-            c2 = jnp.zeros(C, dtype=sc.dtype).at[tgt_t].set(sc, mode="drop")
-            c2 = c2.at[tgt_r].set(col.data[iorder].astype(sc.dtype),
-                                  mode="drop")
-            v2 = jnp.zeros(C, dtype=bool).at[tgt_t].set(sv, mode="drop")
-            v2 = v2.at[tgt_r].set(col.valid_mask()[iorder], mode="drop")
-            cols2.append(c2)
-            valids2.append(v2)
-        errs = errs + jnp.stack([n_row_overflow, n_del_miss]).astype(
-            jnp.int32)
-        return (kh2, tuple(cols2), tuple(valids2),
-                n_after.astype(jnp.int32), errs)
 
     # ------------------------------------------------------------- flush
     def _flush_impl(self, khash, cols, valids, n, top_hash, top_cols,
@@ -192,12 +111,20 @@ class RetractableTopNExecutor(StatefulUnaryExecutor):
         ghash = (key_hash([cols[i] for i in self.group_key_indices])
                  if self.group_key_indices
                  else jnp.zeros(C, dtype=jnp.int64))
-        oval = cols[self.order_col]
-        okey = -oval if self.descending else oval
-        # sort live rows by (group, order, row hash); dead rows last
-        order = stable_lexsort((khash, okey,
-                                jnp.where(live, ghash, jnp.iinfo(
-                                    jnp.int64).max)))
+        # order keys least-significant first for the lexsort; DESC via
+        # bitwise complement (overflow-free order reversal on ints)
+        okeys = []
+        for c, desc in reversed(self.order_specs):
+            oval = cols[c]
+            if jnp.issubdtype(oval.dtype, jnp.floating):
+                okeys.append(-oval if desc else oval)
+            else:
+                # bitwise complement reverses int order overflow-free
+                okeys.append(~oval if desc else oval)
+        # sort live rows by (group, order..., row hash); dead rows last
+        order = stable_lexsort(tuple(
+            [khash] + okeys
+            + [jnp.where(live, ghash, jnp.iinfo(jnp.int64).max)]))
         s_g = jnp.where(live, ghash, jnp.iinfo(jnp.int64).max)[order]
         new_run = jnp.concatenate([jnp.array([True]),
                                    s_g[1:] != s_g[:-1]])

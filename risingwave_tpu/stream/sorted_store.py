@@ -1,0 +1,128 @@
+"""Dense sorted row store — the shared state layout for retraction-capable
+executors that must hold their FULL input (retractable TopN, general
+OverWindow).
+
+Rows live in a dense prefix [0, n) of fixed-capacity arrays sorted by a
+63-bit hash of the STREAM KEY (retractions address rows by it), maintained
+with the same searchsorted/merge machinery as sorted_join.py's own-side
+update: per chunk, one jitted program nets within-chunk pk runs, finds
+delete victims by (hash, pk) match, and merge-inserts the survivors —
+static shapes, no data-dependent control flow.
+
+Reference analogue: the row-holding state tables behind
+top_n_state.rs / over_window's partition cache — re-designed dense for
+the TPU instead of per-key BTree ranges.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from ..common.chunk import StreamChunk, op_sign
+from ..ops.hash_table import stable_lexsort
+from .sorted_join import _HSENTINEL, _count_le, key_hash
+
+
+def sorted_store_apply(khash, cols, valids, n, errs, chunk: StreamChunk,
+                       pk_idx: tuple, capacity: int):
+    """Insert/retract chunk rows into the sorted dense store. Returns
+    (khash', cols', valids', n', errs' + [row_overflow, del_miss])."""
+    N = chunk.capacity
+    C = capacity
+    active = chunk.vis
+    signs = op_sign(chunk.ops)
+    row_ids = jnp.arange(N, dtype=jnp.int32)
+    h = key_hash([chunk.columns[i].data for i in pk_idx])
+
+    # within-chunk pk-run netting (sorted_join semantics)
+    sort_keys = [row_ids]
+    for p in pk_idx:
+        sort_keys.append(chunk.columns[p].data)
+    sort_keys.append(~active)
+    order = stable_lexsort(tuple(sort_keys))
+    s_act = active[order]
+    same = s_act[1:] & s_act[:-1]
+    for p in pk_idx:
+        d = chunk.columns[p].data[order]
+        same = same & (d[1:] == d[:-1])
+    run_start = jnp.concatenate([jnp.array([True]), ~same])
+    run_end = jnp.concatenate([~same, jnp.array([True])])
+    s_signs = signs[order]
+    is_del = jnp.zeros(N, dtype=bool).at[order].set(
+        run_start & (s_signs < 0) & s_act)
+    is_ins = jnp.zeros(N, dtype=bool).at[order].set(
+        run_end & (s_signs > 0) & s_act)
+
+    live = jnp.arange(C, dtype=jnp.int32) < n
+    keep = live
+    # deletes: exact (hash, pk) match
+    dlo = jnp.searchsorted(khash, h, side="left").astype(jnp.int32)
+    dhi = jnp.searchsorted(khash, h, side="right").astype(jnp.int32)
+    M = 2 * N
+    dlens = jnp.where(is_del, (dhi - dlo).astype(jnp.int64), 0)
+    doffs = jnp.cumsum(dlens)
+    dtot = doffs[N - 1]
+    j = jnp.arange(M, dtype=jnp.int64)
+    dsrc = jnp.searchsorted(doffs, j, side="right").astype(jnp.int32)
+    dsrcc = jnp.clip(dsrc, 0, N - 1)
+    dprev = jnp.where(dsrcc > 0, doffs[jnp.clip(dsrcc - 1, 0)], 0)
+    dpos = jnp.clip(dlo[dsrcc] + (j - dprev), 0, C - 1).astype(jnp.int32)
+    cand = (j < jnp.minimum(dtot, M)) & keep[dpos]
+    for p in pk_idx:
+        cand &= (cols[p][dpos]
+                 == chunk.columns[p].data[dsrcc].astype(cols[p].dtype))
+    victim = jnp.full(N, C, dtype=jnp.int32).at[
+        jnp.where(cand, dsrcc, N)].min(dpos, mode="drop")
+    found = victim < C
+    keep = keep.at[jnp.where(found, victim, C)].set(False, mode="drop")
+    n_del_miss = jnp.sum((is_del & ~found).astype(jnp.int32))
+
+    # merge inserts (stable, state rows before equal-hash new rows)
+    ins_h = jnp.where(is_ins, h, _HSENTINEL)
+    iorder = jnp.argsort(ins_h, stable=True)
+    nh = ins_h[iorder]
+    n_new = jnp.sum(is_ins.astype(jnp.int32))
+    dead_cum = jnp.cumsum((~keep).astype(jnp.int32))
+    kept_rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    n_kept = kept_rank[C - 1] + 1
+    new_lt = jnp.searchsorted(nh, khash, side="left").astype(jnp.int32)
+    pos_t = kept_rank + new_lt
+    kept_le = _count_le(khash, dead_cum, nh, side="right")
+    rr = jnp.arange(N, dtype=jnp.int32)
+    pos_r = rr + kept_le
+    new_ok = rr < n_new
+    n_after = n_kept + n_new
+    n_row_overflow = jnp.maximum(n_after - C, 0)
+    n_after = jnp.minimum(n_after, C)
+    tgt_t = jnp.where(keep & (pos_t < C), pos_t, C)
+    tgt_r = jnp.where(new_ok & (pos_r < C), pos_r, C)
+    kh2 = jnp.full(C, _HSENTINEL, dtype=jnp.int64)
+    kh2 = kh2.at[tgt_t].set(khash, mode="drop")
+    kh2 = kh2.at[tgt_r].set(nh, mode="drop")
+    cols2, valids2 = [], []
+    for ci, (sc, sv) in enumerate(zip(cols, valids)):
+        col = chunk.columns[ci]
+        c2 = jnp.zeros(C, dtype=sc.dtype).at[tgt_t].set(sc, mode="drop")
+        c2 = c2.at[tgt_r].set(col.data[iorder].astype(sc.dtype),
+                              mode="drop")
+        v2 = jnp.zeros(C, dtype=bool).at[tgt_t].set(sv, mode="drop")
+        v2 = v2.at[tgt_r].set(col.valid_mask()[iorder], mode="drop")
+        cols2.append(c2)
+        valids2.append(v2)
+    errs = errs + jnp.stack([n_row_overflow, n_del_miss]).astype(jnp.int32)
+    return (kh2, tuple(cols2), tuple(valids2),
+            n_after.astype(jnp.int32), errs)
+
+
+def segment_starts(sorted_group_ids: jnp.ndarray):
+    """For an array sorted by group id: (new_run mask, run_start positions
+    broadcast per element) — the standard segmented-scan primitives."""
+    import jax
+    C = sorted_group_ids.shape[0]
+    new_run = jnp.concatenate([jnp.array([True]),
+                               sorted_group_ids[1:] != sorted_group_ids[:-1]])
+    pos = jnp.arange(C, dtype=jnp.int32)
+    run_start = jax.lax.cummax(jnp.where(new_run, pos, 0))
+    return new_run, run_start
