@@ -2,3 +2,4 @@ from .nexmark import (
     NexmarkGenerator, NexmarkConfig, BID_SCHEMA, PERSON_SCHEMA, AUCTION_SCHEMA,
 )
 from .datagen import ColumnSpec, DatagenConnector
+from .tpch import TpchGenerator, TPCH_SCHEMAS  # noqa: E402,F401

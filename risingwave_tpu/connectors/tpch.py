@@ -1,0 +1,101 @@
+"""TPC-H datagen connector — deterministic, seekable part/lineitem
+streams for the q17 workload (BASELINE staged config 5).
+
+Reference workload: /root/reference/e2e_test/tpch/ and the ci q17 SQL.
+The reference feeds TPC-H through Kafka from dbgen files; here the rows
+are generated on device from the offset counter (counter-based
+splitmix64, same scheme as nexmark.py) so the stream is deterministic,
+seekable for exactly-once replay, and needs no external system.
+
+Simplifications vs dbgen (documented, not hidden): a fixed part universe
+of NUM_PARTS keys that lineitems draw from uniformly; brand/container
+derived from the partkey hash so any prefix of both streams agrees with
+a host oracle; prices are integers (the engine's decimal = scaled int).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..common.chunk import Column, StreamChunk
+from ..common.types import DataType, schema
+from .nexmark import _register_vocab, _splitmix64
+
+NUM_PARTS = 1000
+BRANDS = [f"Brand#{i}{j}" for i in range(1, 6) for j in range(1, 6)]
+CONTAINERS = [f"{s} {t}" for s in ("SM", "MED", "LG", "JUMBO")
+              for t in ("CASE", "BOX", "PACK", "DRUM")]
+
+PART_SCHEMA = schema(
+    ("p_partkey", DataType.INT64),
+    ("p_brand", DataType.VARCHAR),
+    ("p_container", DataType.VARCHAR),
+    ("p_retailprice", DataType.INT64),
+)
+
+LINEITEM_SCHEMA = schema(
+    ("l_orderkey", DataType.INT64),
+    ("l_partkey", DataType.INT64),
+    ("l_quantity", DataType.INT64),
+    ("l_extendedprice", DataType.INT64),
+)
+
+TPCH_SCHEMAS = {"part": PART_SCHEMA, "lineitem": LINEITEM_SCHEMA}
+
+
+def _part_cols(keys: jnp.ndarray, brand_ids, container_ids):
+    """Columns for part rows keyed by `keys` (shared by both tables'
+    derivations so lineitem oracles can recompute brand/container)."""
+    h = _splitmix64(keys.astype(jnp.uint64) ^ jnp.uint64(0xA5A5))
+    brand = jnp.take(brand_ids, (h % len(BRANDS)).astype(jnp.int32))
+    h2 = _splitmix64(keys.astype(jnp.uint64) ^ jnp.uint64(0x5A5A))
+    container = jnp.take(container_ids,
+                         (h2 % len(CONTAINERS)).astype(jnp.int32))
+    price = 900 + (h % jnp.uint64(200)).astype(jnp.int64)
+    return brand.astype(jnp.int64), container.astype(jnp.int64), price
+
+
+class TpchGenerator:
+    """Connector protocol: next_chunk() / seek(offset) / offset."""
+
+    def __init__(self, table: str, chunk_size: int = 4096,
+                 start_offset: int = 0):
+        assert table in TPCH_SCHEMAS, table
+        self.table = table
+        self.chunk_size = chunk_size
+        self.offset = start_offset
+        self.schema = TPCH_SCHEMAS[table]
+        self._brand_ids = jnp.asarray(
+            _register_vocab("tpch_brand", BRANDS), dtype=jnp.int64)
+        self._container_ids = jnp.asarray(
+            _register_vocab("tpch_container", CONTAINERS), dtype=jnp.int64)
+        self._vis = jnp.ones(chunk_size, dtype=bool)
+        self._ops = jnp.zeros(chunk_size, dtype=jnp.int8)
+        self._gen = jax.jit(self._gen_impl, static_argnums=(1,))
+
+    def _gen_impl(self, offset, n, brand_ids, container_ids):
+        rid = offset + jnp.arange(n, dtype=jnp.int64)
+        if self.table == "part":
+            keys = rid + 1
+            brand, container, price = _part_cols(keys, brand_ids,
+                                                 container_ids)
+            return keys, brand, container, price
+        h = _splitmix64(rid.astype(jnp.uint64) ^ jnp.uint64(0x71F3))
+        partkey = 1 + (h % jnp.uint64(NUM_PARTS)).astype(jnp.int64)
+        hq = _splitmix64(rid.astype(jnp.uint64) ^ jnp.uint64(0x9D2C))
+        quantity = 1 + (hq % jnp.uint64(50)).astype(jnp.int64)
+        _, _, price = _part_cols(partkey, brand_ids, container_ids)
+        extended = quantity * price
+        orderkey = rid // 4 + 1
+        return orderkey, partkey, quantity, extended
+
+    def next_chunk(self) -> StreamChunk:
+        cols = self._gen(jnp.int64(self.offset), self.chunk_size,
+                         self._brand_ids, self._container_ids)
+        self.offset += self.chunk_size
+        return StreamChunk(tuple(Column(c) for c in cols), self._ops,
+                           self._vis, self.schema)
+
+    def seek(self, offset: int) -> None:
+        self.offset = offset

@@ -193,8 +193,9 @@ class StreamPlanner:
                 "no_op", {}, inputs=(Exchange(sfid),)),
                 dispatch="broadcast"))
             wm = frozenset()
-            if src.options.get("emit_watermarks"):
-                wm = frozenset({_NEXMARK_WM_COL[src.options["table"]]})
+            wmcol = _NEXMARK_WM_COL.get(src.options["table"])
+            if src.options.get("emit_watermarks") and wmcol is not None:
+                wm = frozenset({wmcol})
             pk_opt = src.options.get("primary_key")
             return (f.fid, Scope.of(src.schema, rel.alias or rel.name),
                     RelInfo(None if pk_opt is None else (pk_opt,), True,
@@ -345,6 +346,13 @@ class StreamPlanner:
                 np.issubdtype(sc.schema[i].data_type.np_dtype, np.integer)
                 for sc, keys in ((ls, lkeys), (rs, rkeys)) for i in keys)
             wd = 1 if self.cfg("streaming_watchdog", 1) else None
+            # per-side match buffers: probing a side whose rows are
+            # UNIQUE per join key (stream key covered by its equi keys)
+            # yields at most one match per probe row; the wide default
+            # factor is only for skewed many-per-key sides
+            mf = self.cfg("streaming_join_match_factor", 64)
+            mf_l = min(2, mf) if set(rpk) <= set(rkeys) else mf
+            mf_r = min(2, mf) if set(lpk) <= set(lkeys) else mf
             if key_int:
                 node = Node("sorted_join", dict(
                     left_key_indices=lkeys, right_key_indices=rkeys,
@@ -352,7 +360,7 @@ class StreamPlanner:
                     right_pk_indices=list(rpk),
                     condition=cond, join_type=jt,
                     capacity=self.cfg("streaming_join_capacity", 1 << 17),
-                    match_factor=self.cfg("streaming_join_match_factor", 64),
+                    match_factor=mf, match_factors=(mf_l, mf_r),
                     append_only=(li.append_only, ri.append_only),
                     clean_specs=(clean_l, clean_r),
                     watchdog_interval=wd,
@@ -966,52 +974,83 @@ class StreamPlanner:
                                      append_only=info.append_only))
             return len(agg_calls) - 1
 
-        for it in sel.items:
-            e = it.expr
+        nk = len(keys)
+
+        def agg_post(e) -> Expr:
+            """One aggregate call -> its post-project expression over
+            [keys..., agg outputs...]."""
+            if e.name == "count":
+                idx = add_call(AggKind.COUNT,
+                               None if e.star else add_arg(e.args[0]),
+                               DataType.INT64)
+                return col(nk + idx, DataType.INT64)
+            if e.name == "avg":
+                a = add_arg(e.args[0])
+                s = add_call(AggKind.SUM, a, DataType.FLOAT64)
+                c = add_call(AggKind.COUNT, a, DataType.INT64)
+                return call("divide", col(nk + s, DataType.FLOAT64),
+                            col(nk + c, DataType.INT64))
+            if e.name == "sum":
+                a = add_arg(e.args[0])
+                at = pre_exprs[a].ret_type
+                ret = (DataType.FLOAT64
+                       if at in (DataType.FLOAT64, DataType.FLOAT32)
+                       else DataType.INT64)
+                return col(nk + add_call(AggKind.SUM, a, ret), ret)
+            a = add_arg(e.args[0])
+            kind = AggKind.MIN if e.name == "min" else AggKind.MAX
+            at = pre_exprs[a].ret_type
+            if at is DataType.VARCHAR:
+                # same hazard as the streaming ORDER BY guard: dict ids
+                # are not lexicographic, and the stream agg reduces raw
+                # ids — batch SELECTs rank the decoded strings instead
+                raise BindError(
+                    f"streaming {e.name}() over VARCHAR is unsupported "
+                    "(dict encoding is not lexicographic); aggregate in "
+                    "a batch SELECT over the MV instead")
+            return col(nk + add_call(kind, a, at), at)
+
+        def post_of(e) -> Expr:
+            """Scalar expression OVER aggregates/keys (sum(x)/7.0,
+            0.2*avg(q), sum(x)*(k+1), ...) -> post-project expression
+            (reference: the planner splits such items into LogicalAgg +
+            LogicalProject the same way). A GROUP BY key may match at
+            ANY level; other agg-free leaves must be literal-only."""
             if isinstance(e, ast.Func) and e.name in AGG_FUNCS:
-                if e.name == "count":
-                    idx = add_call(AggKind.COUNT,
-                                   None if e.star else add_arg(e.args[0]),
-                                   DataType.INT64)
-                    items_plan.append(("agg", idx))
-                elif e.name == "avg":
-                    a = add_arg(e.args[0])
-                    s = add_call(AggKind.SUM, a, DataType.FLOAT64)
-                    c = add_call(AggKind.COUNT, a, DataType.INT64)
-                    items_plan.append(("avg", s, c))
-                elif e.name == "sum":
-                    a = add_arg(e.args[0])
-                    at = pre_exprs[a].ret_type
-                    ret = (DataType.FLOAT64
-                           if at in (DataType.FLOAT64, DataType.FLOAT32)
-                           else DataType.INT64)
-                    items_plan.append(("agg", add_call(AggKind.SUM, a, ret)))
-                else:
-                    a = add_arg(e.args[0])
-                    kind = AggKind.MIN if e.name == "min" else AggKind.MAX
-                    at = pre_exprs[a].ret_type
-                    if at is DataType.VARCHAR:
-                        # same hazard as the streaming ORDER BY guard:
-                        # dict ids are not lexicographic, and the stream
-                        # agg reduces raw ids — batch SELECTs rank the
-                        # decoded strings instead
-                        raise BindError(
-                            f"streaming {e.name}() over VARCHAR is "
-                            "unsupported (dict encoding is not "
-                            "lexicographic); aggregate in a batch "
-                            "SELECT over the MV instead")
-                    items_plan.append(("agg", add_call(kind, a, at)))
-            else:
-                # must be one of the group-by expressions
+                return agg_post(e)
+            if isinstance(e, ast.Lit):
+                return lit(e.value)
+            if not contains_agg(e):
+                bound = bind_scalar(e, scope)
+                for kj, ke in enumerate(keys):
+                    if repr(ke) == repr(bound):
+                        return col(kj, keys[kj].ret_type)
+            if isinstance(e, ast.BinOp):
+                return call(e.op, post_of(e.left), post_of(e.right))
+            if isinstance(e, ast.UnOp):
+                return call(e.op, post_of(e.arg))
+            raise BindError(
+                f"{e}: non-aggregate parts of a SELECT item must appear "
+                f"in GROUP BY")
+
+        post, names = [], []
+        for j, it in enumerate(sel.items):
+            e = it.expr
+            names.append(it.alias or auto_name(e, j))
+            if not contains_agg(e):
                 bound = bind_scalar(e, scope)
                 for kj, ke in enumerate(keys):
                     if repr(ke) == repr(bound):
                         items_plan.append(("key", kj))
+                        post.append(col(kj, keys[kj].ret_type))
                         break
                 else:
                     raise BindError(
-                        f"{it.alias or e}: non-aggregate SELECT item must "
-                        f"appear in GROUP BY")
+                        f"{it.alias or e}: non-aggregate SELECT item "
+                        f"must appear in GROUP BY")
+            else:
+                items_plan.append(("expr",))
+                post.append(post_of(e))
 
         frag.root = Node("project", dict(exprs=pre_exprs, names=pre_names),
                          inputs=(frag.root,))
@@ -1047,23 +1086,6 @@ class StreamPlanner:
                 inputs=(Exchange(fid),)),
                 dispatch="simple"))
 
-        # post-project: SELECT order, AVG division
-        nk = len(keys)
-        post, names = [], []
-        for j, (it, plan) in enumerate(zip(sel.items, items_plan)):
-            name = it.alias or auto_name(it.expr, j)
-            names.append(name)
-            if plan[0] == "key":
-                post.append(col(plan[1],
-                                keys[plan[1]].ret_type))
-            elif plan[0] == "agg":
-                c0 = agg_calls[plan[1]]
-                post.append(col(nk + plan[1], c0.ret_type))
-            else:
-                _, s, c = plan
-                post.append(call("divide",
-                                 col(nk + s, DataType.FLOAT64),
-                                 col(nk + c, DataType.INT64)))
         # MV pk = the group keys, which must survive projection: append any
         # key not already selected
         pk = []

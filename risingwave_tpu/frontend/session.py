@@ -236,12 +236,24 @@ class Session:
     def _create_source(self, stmt: ast.CreateSource) -> SourceDef:
         opts = dict(stmt.options)
         connector = opts.pop("connector", "nexmark")
-        if connector != "nexmark":
+        if connector == "tpch":
+            from ..connectors.tpch import TPCH_SCHEMAS
+            schemas = TPCH_SCHEMAS
+        elif connector == "nexmark":
+            schemas = _NEXMARK_SCHEMAS
+        else:
             raise BindError(f"unknown connector {connector!r}")
         table = opts.pop("table", stmt.name)
-        if table not in _NEXMARK_SCHEMAS:
-            raise BindError(f"unknown nexmark table {table!r}")
-        args = {"table": table,
+        if table not in schemas:
+            raise BindError(f"unknown {connector} table {table!r}")
+        if connector == "tpch":
+            bad = {"emit_watermarks", "watermark_lag_us", "inter_event_us",
+                   "base_time_us"} & set(opts)
+            if bad:
+                raise BindError(
+                    f"options {sorted(bad)} are not supported by the "
+                    "tpch connector (no event-time column)")
+        args = {"connector": connector, "table": table,
                 "chunk_size": int(opts.pop("chunk_size", 4096))}
         cfg = {}
         for k in ("inter_event_us", "base_time_us"):
@@ -256,14 +268,14 @@ class Session:
             # reference: PRIMARY KEY on CREATE TABLE/SOURCE — declares a
             # unique column so downstream state needs no generated row id
             pk_name = opts.pop("primary_key")
-            names = list(_NEXMARK_SCHEMAS[table].names)
+            names = list(schemas[table].names)
             if pk_name not in names:
                 raise BindError(f"primary_key {pk_name!r} not a column")
             args["primary_key"] = names.index(pk_name)
         for k in ("watermark_lag_us", "rate_limit"):
             if k in opts:
                 args[k] = int(opts.pop(k))
-        src = SourceDef(stmt.name, _NEXMARK_SCHEMAS[table], args)
+        src = SourceDef(stmt.name, schemas[table], args)
         self.catalog.sources[stmt.name] = src
         return src
 

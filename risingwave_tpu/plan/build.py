@@ -251,10 +251,16 @@ def _build_source(args, inputs, ctx: ActorCtx, key):
     from ..connectors.nexmark import NexmarkConfig
     assert ctx.fragment.parallelism == 1, \
         "parallel sources need split assignment (future: SourceManager)"
-    cfg = NexmarkConfig(**args.get("cfg", {})) if args.get("cfg") else None
-    gen = NexmarkGenerator(args["table"],
-                           chunk_size=args.get("chunk_size", 8192),
-                           **({"cfg": cfg} if cfg else {}))
+    if args.get("connector") == "tpch":
+        from ..connectors.tpch import TpchGenerator
+        gen = TpchGenerator(args["table"],
+                            chunk_size=args.get("chunk_size", 8192))
+    else:
+        cfg = (NexmarkConfig(**args.get("cfg", {}))
+               if args.get("cfg") else None)
+        gen = NexmarkGenerator(args["table"],
+                               chunk_size=args.get("chunk_size", 8192),
+                               **({"cfg": cfg} if cfg else {}))
     barrier_q: asyncio.Queue = asyncio.Queue()
     ctx.env.coord.register_source(barrier_q)
     ctx.env.pending_source_queues.append(barrier_q)
@@ -383,6 +389,7 @@ def _build_sorted_join(args, inputs, ctx: ActorCtx, key):
         right_pk_indices=args["right_pk_indices"],
         capacity=args.get("capacity", 1 << 17),
         match_factor=args.get("match_factor", 2),
+        match_factors=args.get("match_factors"),
         condition=args.get("condition"),
         join_type=args.get("join_type", "inner"),
         output_indices=args.get("output_indices"),

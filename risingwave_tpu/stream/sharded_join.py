@@ -76,8 +76,20 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
                            shard)))
 
         applies = {LEFT: make_apply(LEFT), RIGHT: make_apply(RIGHT)}
-        self._apply = (lambda own, other, errs, chunk, wm, side:
-                       applies[side](own, other, errs, chunk, wm))
+
+        def apply_dispatch(own, other, errs, chunk, wm, side,
+                           match_factor=None):
+            # the sharded programs are traced with the constructor's
+            # factor; a caller asking for a DIFFERENT one (recovery's
+            # generous replay buffer) must fail loudly, not silently
+            # under-buffer and corrupt degrees
+            if match_factor not in (None, self.match_factor):
+                raise NotImplementedError(
+                    "sharded sorted join cannot override match_factor "
+                    f"per call (asked {match_factor}, traced "
+                    f"{self.match_factor})")
+            return applies[side](own, other, errs, chunk, wm)
+        self._apply = apply_dispatch
 
         def make_evict(side):
             def evict_sharded(own, wm):

@@ -163,6 +163,7 @@ class SortedJoinExecutor(Executor):
                  right_pk_indices: Sequence[int],
                  capacity: int = 1 << 17,
                  match_factor: int = 2,
+                 match_factors: Optional[tuple] = None,
                  condition=None,
                  join_type: str = "inner",
                  output_indices: Optional[Sequence[int]] = None,
@@ -205,6 +206,13 @@ class SortedJoinExecutor(Executor):
             self.pk_indices = ()
         self.capacity = [capacity, capacity]
         self.match_factor = match_factor
+        # per-side probe buffers: side s's matches are bounded by 1 per
+        # row when the OTHER side's rows are unique per join key (its
+        # stream key is covered by its equi keys) — the planner passes
+        # (2, 64)-style asymmetric factors so a wide chunk probing a
+        # unique side doesn't allocate a match_factor-times-wider buffer
+        self.match_factors = (tuple(match_factors) if match_factors
+                              else (match_factor, match_factor))
         self.condition = condition
         assert join_type in ("inner", "left", "right", "full")
         # Cleaning specs generalize clean_watermark_cols (which maps to
@@ -747,7 +755,7 @@ class SortedJoinExecutor(Executor):
                 (self.sides[s], oth_degree, cols, ops, vis, self._errs_dev,
                  self._n_dev[s]) = self._apply(
                     self.sides[s], self.sides[1 - s], self._errs_dev, msg,
-                    wm, side=s)
+                    wm, side=s, match_factor=self.match_factors[s])
                 o = self.sides[1 - s]
                 self.sides[1 - s] = SortedSideState(
                     o.khash, o.cols, o.valids, oth_degree, o.n)
