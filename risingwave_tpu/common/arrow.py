@@ -1,0 +1,130 @@
+"""Arrow interop — the zero-copy on/off ramp for chunks.
+
+Reference: src/common/src/array/arrow/arrow_impl.rs:55 (Array <-> arrow
+conversions powering UDFs, sinks and the iceberg path). SURVEY calls this
+"the DLPack on-ramp for TPU": fixed-width columns convert without copying
+(numpy view -> arrow buffer and back), and the engine's dict-encoded
+VARCHAR maps 1:1 onto Arrow dictionary arrays — the dictionary IS
+GLOBAL_DICT's decode table, so string payloads never materialize per row.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+from .chunk import StreamChunk
+from .types import DataType, Field, GLOBAL_DICT, Schema
+
+_ARROW_TYPES = {
+    DataType.BOOLEAN: pa.bool_(),
+    DataType.INT16: pa.int16(),
+    DataType.INT32: pa.int32(),
+    DataType.INT64: pa.int64(),
+    DataType.SERIAL: pa.int64(),
+    DataType.FLOAT32: pa.float32(),
+    DataType.FLOAT64: pa.float64(),
+    DataType.DECIMAL: pa.int64(),          # scaled int (engine encoding)
+    DataType.TIMESTAMP: pa.timestamp("us"),
+}
+
+
+def arrow_schema(schema: Schema) -> pa.Schema:
+    fields = []
+    for f in schema:
+        if f.data_type is DataType.VARCHAR:
+            t = pa.dictionary(pa.int32(), pa.string())
+        else:
+            t = _ARROW_TYPES[f.data_type]
+        fields.append(pa.field(f.name, t))
+    return pa.schema(fields)
+
+
+def chunk_to_arrow(chunk: StreamChunk) -> pa.RecordBatch:
+    """Visible rows -> RecordBatch. Fixed-width columns transfer as one
+    buffer each (no per-row python); VARCHAR becomes a DictionaryArray
+    whose dictionary is the prefix of GLOBAL_DICT covering the ids."""
+    vis = np.asarray(chunk.vis)
+    arrays = []
+    for f, col in zip(chunk.schema, chunk.columns):
+        data = np.asarray(col.data)[vis]
+        valid = np.asarray(col.valid_mask())[vis]
+        mask = ~valid if not valid.all() else None
+        if f.data_type is DataType.VARCHAR:
+            ids = data.astype(np.int32)
+            hi = int(ids.max(initial=-1))
+            dictionary = pa.array(
+                GLOBAL_DICT.decode_many(np.arange(hi + 1)),
+                type=pa.string())
+            idx = pa.array(ids, type=pa.int32(), mask=mask)
+            arrays.append(pa.DictionaryArray.from_arrays(idx, dictionary))
+        elif f.data_type is DataType.TIMESTAMP:
+            arrays.append(pa.array(data, type=pa.timestamp("us"),
+                                   mask=mask))
+        else:
+            arrays.append(pa.array(data, type=_ARROW_TYPES[f.data_type],
+                                   mask=mask))
+    return pa.RecordBatch.from_arrays(arrays, schema=arrow_schema(
+        chunk.schema))
+
+
+def batch_to_chunk(batch: pa.RecordBatch, schema: Schema,
+                   capacity: Optional[int] = None) -> StreamChunk:
+    """RecordBatch -> StreamChunk (all rows visible, op Insert). String
+    and dictionary columns intern through GLOBAL_DICT; fixed-width
+    columns convert as whole buffers."""
+    n = batch.num_rows
+    arrays, valids = [], []
+    for f, col in zip(schema, batch.columns):
+        if isinstance(col, pa.ChunkedArray):
+            col = col.combine_chunks()
+        valid = np.asarray(col.is_valid())
+        if f.data_type is DataType.VARCHAR:
+            if pa.types.is_dictionary(col.type):
+                dic = col.dictionary.to_pylist()
+                remap = np.asarray(
+                    [GLOBAL_DICT.get_or_insert(s if s is not None else "")
+                     for s in dic], dtype=np.int32)
+                idx = np.asarray(col.indices.fill_null(0))
+                arrays.append(remap[idx])
+            else:
+                arrays.append(np.asarray(
+                    [GLOBAL_DICT.get_or_insert(s) if s is not None else 0
+                     for s in col.to_pylist()], dtype=np.int32))
+        elif f.data_type is DataType.TIMESTAMP:
+            arrays.append(np.asarray(col.cast(pa.int64()).fill_null(0),
+                                     dtype=np.int64))
+        else:
+            arrays.append(np.asarray(
+                col.fill_null(0).cast(_ARROW_TYPES[f.data_type]),
+                dtype=f.data_type.np_dtype))
+        valids.append(None if valid.all() else valid)
+    cap = capacity or max(1, 1 << max(0, (n - 1).bit_length()))
+    return StreamChunk.from_numpy(schema, arrays, capacity=cap,
+                                  valids=valids)
+
+
+def schema_from_arrow(aschema: pa.Schema) -> Schema:
+    fields = []
+    for f in aschema:
+        if pa.types.is_dictionary(f.type) or pa.types.is_string(f.type) \
+                or pa.types.is_large_string(f.type):
+            t = DataType.VARCHAR
+        elif pa.types.is_timestamp(f.type):
+            t = DataType.TIMESTAMP
+        elif pa.types.is_boolean(f.type):
+            t = DataType.BOOLEAN
+        elif pa.types.is_float32(f.type):
+            t = DataType.FLOAT32
+        elif pa.types.is_floating(f.type):
+            t = DataType.FLOAT64
+        elif pa.types.is_int16(f.type):
+            t = DataType.INT16
+        elif pa.types.is_int32(f.type):
+            t = DataType.INT32
+        else:
+            t = DataType.INT64
+        fields.append(Field(f.name, t))
+    return Schema(tuple(fields))

@@ -3,3 +3,4 @@ from .nexmark import (
 )
 from .datagen import ColumnSpec, DatagenConnector
 from .tpch import TpchGenerator, TPCH_SCHEMAS  # noqa: E402,F401
+from .arrow_source import ArrowSource  # noqa: E402,F401

@@ -1,0 +1,53 @@
+"""ArrowSource — a source connector over in-memory Arrow data.
+
+Reference: the reference ingests Arrow through its UDF/iceberg surfaces
+(arrow_impl.rs); here any pyarrow Table / RecordBatch list becomes a
+seekable stream (the offset is the row index), so external systems that
+speak Arrow can feed the engine with one conversion at the boundary.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from ..common.arrow import batch_to_chunk, schema_from_arrow
+from ..common.chunk import StreamChunk
+
+
+class ArrowSource:
+    def __init__(self, data, chunk_size: int = 4096):
+        if isinstance(data, pa.RecordBatch):
+            data = pa.Table.from_batches([data])
+        elif isinstance(data, list):
+            data = pa.Table.from_batches(data)
+        self.table: pa.Table = data.combine_chunks()
+        self.chunk_size = chunk_size
+        self.schema = schema_from_arrow(self.table.schema)
+        self.offset = 0
+
+    def seek(self, offset: int) -> None:
+        self.offset = offset
+
+    @property
+    def last_chunk_rows(self) -> int:
+        return getattr(self, "_last_rows", 0)
+
+    @property
+    def exhausted(self) -> bool:
+        return self.offset >= self.table.num_rows
+
+    def next_chunk(self) -> StreamChunk:
+        n = self.table.num_rows
+        lo = min(self.offset, n)
+        hi = min(lo + self.chunk_size, n)
+        self.offset = hi
+        self._last_rows = hi - lo
+        if hi > lo:
+            batch = (self.table.slice(lo, hi - lo).combine_chunks()
+                     .to_batches()[0])
+        else:       # exhausted: an empty (all-invisible) chunk
+            batch = pa.RecordBatch.from_pylist(
+                [], schema=self.table.schema)
+        return batch_to_chunk(batch, self.schema,
+                              capacity=self.chunk_size)

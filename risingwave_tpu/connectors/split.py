@@ -1,0 +1,52 @@
+"""Split readers — partitioning one logical stream across source actors.
+
+Reference: src/connector/src/source/base.rs (SplitEnumerator/SplitReader)
++ src/meta/src/stream/source_manager.rs (split discovery & assignment).
+Kafka-style sources have broker-defined partitions; the deterministic
+generators here are partitioned by BLOCK INTERLEAVING instead: split k of
+S owns every chunk-sized block b with b % S == k. The union over splits
+is the whole stream, disjoint, and each split is independently seekable —
+the per-split offset (rows consumed BY THIS SPLIT) is the exactly-once
+state, exactly like a Kafka partition offset.
+"""
+
+from __future__ import annotations
+
+
+class BlockSplitConnector:
+    """Wrap a seekable contiguous connector as split k of S."""
+
+    def __init__(self, inner, split_id: int, n_splits: int):
+        assert 0 <= split_id < n_splits
+        self.inner = inner
+        self.split_id = split_id
+        self.n_splits = n_splits
+        self.schema = inner.schema
+        self.chunk_size = inner.chunk_size
+        self.offset = 0                  # rows consumed by THIS split
+        self.table = getattr(inner, "table", None)
+
+    def _global_offset(self) -> int:
+        block = self.offset // self.chunk_size
+        return (block * self.n_splits + self.split_id) * self.chunk_size
+
+    def next_chunk(self):
+        self.inner.seek(self._global_offset())
+        chunk = self.inner.next_chunk()
+        self.offset += self.chunk_size
+        return chunk
+
+    def seek(self, offset: int) -> None:
+        assert offset % self.chunk_size == 0, \
+            "split offsets advance in whole blocks"
+        self.offset = offset
+
+    @property
+    def watermark_col(self) -> int:
+        return self.inner.watermark_col
+
+    def current_watermark(self) -> int:
+        # the inner connector sits right after this split's last block —
+        # its frontier is exact for the rows THIS split emitted; the
+        # source takes the min across splits
+        return self.inner.current_watermark()

@@ -165,8 +165,12 @@ class StreamPlanner:
         if name not in self._source_frags:
             src = self.catalog.source(name)
             node = Node("nexmark_source", dict(src.options, durable=True))
-            f = self.graph.add(Fragment(self.fid(), node,
-                                        dispatch="broadcast"))
+            # split-managed sources scale with the session parallelism,
+            # bounded by their split count (source_manager.rs assignment)
+            n_splits = int(src.options.get("splits", 1))
+            f = self.graph.add(Fragment(
+                self.fid(), node, dispatch="broadcast",
+                parallelism=max(1, min(self.parallelism, n_splits))))
             self._source_frags[name] = f.fid
         return self._source_frags[name]
 

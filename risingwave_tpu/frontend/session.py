@@ -255,6 +255,8 @@ class Session:
                     "tpch connector (no event-time column)")
         args = {"connector": connector, "table": table,
                 "chunk_size": int(opts.pop("chunk_size", 4096))}
+        if "splits" in opts:
+            args["splits"] = int(opts.pop("splits"))
         cfg = {}
         for k in ("inter_event_us", "base_time_us"):
             if k in opts:

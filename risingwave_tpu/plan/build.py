@@ -249,18 +249,23 @@ class FanoutDispatcher:
 def _build_source(args, inputs, ctx: ActorCtx, key):
     from ..connectors import NexmarkGenerator
     from ..connectors.nexmark import NexmarkConfig
-    assert ctx.fragment.parallelism == 1, \
-        "parallel sources need split assignment (future: SourceManager)"
-    if args.get("connector") == "tpch":
-        from ..connectors.tpch import TpchGenerator
-        gen = TpchGenerator(args["table"],
-                            chunk_size=args.get("chunk_size", 8192))
-    else:
+    from ..connectors.split import BlockSplitConnector
+
+    def make_gen():
+        if args.get("connector") == "tpch":
+            from ..connectors.tpch import TpchGenerator
+            return TpchGenerator(args["table"],
+                                 chunk_size=args.get("chunk_size", 8192))
         cfg = (NexmarkConfig(**args.get("cfg", {}))
                if args.get("cfg") else None)
-        gen = NexmarkGenerator(args["table"],
-                               chunk_size=args.get("chunk_size", 8192),
-                               **({"cfg": cfg} if cfg else {}))
+        return NexmarkGenerator(args["table"],
+                                chunk_size=args.get("chunk_size", 8192),
+                                **({"cfg": cfg} if cfg else {}))
+
+    n_splits = int(args.get("splits", 1))
+    P = ctx.fragment.parallelism
+    assert n_splits >= P, \
+        f"source parallelism {P} exceeds its {n_splits} split(s)"
     barrier_q: asyncio.Queue = asyncio.Queue()
     ctx.env.coord.register_source(barrier_q)
     ctx.env.pending_source_queues.append(barrier_q)
@@ -268,13 +273,27 @@ def _build_source(args, inputs, ctx: ActorCtx, key):
     if args.get("durable"):
         tid = ctx.table_id(key)
         st = ctx.env.state_table(
-            tid, Schema((SchemaField("source_id", DataType.INT64),
+            tid, Schema((SchemaField("split_id", DataType.INT64),
                          SchemaField("offset", DataType.INT64))), (0,))
+    if n_splits == 1 and P == 1:
+        return SourceExecutor(
+            ctx.actor_id, make_gen(), barrier_q, state_table=st,
+            emit_watermarks=args.get("emit_watermarks", False),
+            watermark_lag_us=args.get("watermark_lag_us", 0),
+            rate_limit_rows_per_barrier=args.get("rate_limit"))
+    # split assignment: split k -> actor (k % P); a re-assigned split
+    # recovers its committed offset wherever it lands (reference:
+    # source_manager.rs split (re)assignment)
+    my_splits = [(k, BlockSplitConnector(make_gen(), k, n_splits))
+                 for k in range(n_splits) if k % P == ctx.actor_idx]
+    rate = args.get("rate_limit")
     return SourceExecutor(
-        ctx.actor_id, gen, barrier_q, state_table=st,
+        ctx.actor_id, barrier_queue=barrier_q, state_table=st,
+        splits=my_splits,
         emit_watermarks=args.get("emit_watermarks", False),
         watermark_lag_us=args.get("watermark_lag_us", 0),
-        rate_limit_rows_per_barrier=args.get("rate_limit"))
+        rate_limit_rows_per_barrier=(None if rate is None
+                                     else max(1, rate // P)))
 
 
 @register_builder("project")

@@ -43,7 +43,8 @@ from ..ops.hash_table import stable_lexsort
 from .executor import Executor, StatefulUnaryExecutor
 from .message import Barrier, Watermark
 from .sorted_join import _HSENTINEL, key_hash
-from .sorted_store import segment_starts, sorted_store_apply
+from .sorted_store import (GrowableSortedStore, segment_starts,
+                           sorted_store_apply)
 
 
 @dataclass(frozen=True)
@@ -68,7 +69,8 @@ class WindowSpec:
         return DataType.INT64
 
 
-class GeneralOverWindowExecutor(StatefulUnaryExecutor):
+class GeneralOverWindowExecutor(GrowableSortedStore,
+                                StatefulUnaryExecutor):
     def __init__(self, input: Executor,
                  partition_by: Sequence[int],
                  order_specs: Sequence[tuple],     # [(col, desc)]
@@ -114,6 +116,9 @@ class GeneralOverWindowExecutor(StatefulUnaryExecutor):
         self._apply = jax.jit(partial(sorted_store_apply,
                                       pk_idx=self.pk_indices,
                                       capacity=self.capacity))
+        # ONE d2h fetch per barrier: errs and the live count ride together
+        self._wd_pack = jax.jit(
+            lambda e, n: jnp.concatenate([e, n[None].astype(jnp.int32)]))
         self._flush = jax.jit(self._flush_impl)
         self._epoch_chunks: list[StreamChunk] = []
         self._init_stateful(state_table, watchdog_interval)
@@ -276,6 +281,7 @@ class GeneralOverWindowExecutor(StatefulUnaryExecutor):
         rows = [r for _, r in self.state_table.iter_all()]
         if not rows:
             return
+        self._presize_for(len(rows))
         from ..state.storage_table import rows_to_columns
         in_schema = Schema(tuple(self.schema)[:self.in_width])
         cap = 1 << max(6, (len(rows) - 1).bit_length())
@@ -296,8 +302,10 @@ class GeneralOverWindowExecutor(StatefulUnaryExecutor):
             self.khash, self.cols, self.valids, self.n,
             self.em_hash, self.em_cols, self.em_valids, self.em_n)
 
+    _SECONDARY = ("em_hash", "em_cols", "em_valids")
+
     def check_watchdog(self) -> None:
-        vals = np.asarray(self._errs_dev)
+        vals = np.asarray(self._wd_pack(self._errs_dev, self.n))
         if int(vals[0]):
             raise RuntimeError(
                 f"over-window store overflow ({int(vals[0])} rows "
@@ -305,6 +313,7 @@ class GeneralOverWindowExecutor(StatefulUnaryExecutor):
         if int(vals[1]):
             raise RuntimeError(
                 f"over-window: {int(vals[1])} deletes matched no row")
+        self._maybe_grow(int(vals[2]))
 
     def fence_tokens(self) -> list:
         return [self.n, self.em_n] + super().fence_tokens()

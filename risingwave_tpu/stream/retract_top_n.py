@@ -41,10 +41,11 @@ from ..ops.hash_table import stable_lexsort
 from .executor import Executor, StatefulUnaryExecutor
 from .message import Barrier, Watermark
 from .sorted_join import _HSENTINEL, key_hash
-from .sorted_store import sorted_store_apply
+from .sorted_store import GrowableSortedStore, sorted_store_apply
 
 
-class RetractableTopNExecutor(StatefulUnaryExecutor):
+class RetractableTopNExecutor(GrowableSortedStore,
+                              StatefulUnaryExecutor):
     """Output: the rows whose rank within their group (by order_col,
     direction) falls in [offset, offset+limit), maintained incrementally
     under inserts AND retractions."""
@@ -94,6 +95,9 @@ class RetractableTopNExecutor(StatefulUnaryExecutor):
         self._apply = jax.jit(partial(sorted_store_apply,
                                       pk_idx=self.pk_indices,
                                       capacity=self.capacity))
+        # ONE d2h fetch per barrier: errs and the live count ride together
+        self._wd_pack = jax.jit(
+            lambda e, n: jnp.concatenate([e, n[None].astype(jnp.int32)]))
         self._flush = jax.jit(self._flush_impl)
         # durability: the state table materializes the FULL input row set
         # keyed by the stream key (the reference's TopN state table holds
@@ -192,6 +196,7 @@ class RetractableTopNExecutor(StatefulUnaryExecutor):
         rows = [r for _, r in self.state_table.iter_all()]
         if not rows:
             return
+        self._presize_for(len(rows))
         from ..state.storage_table import rows_to_columns
         cap = 1 << max(6, (len(rows) - 1).bit_length())
         for ofs in range(0, len(rows), cap):
@@ -222,8 +227,10 @@ class RetractableTopNExecutor(StatefulUnaryExecutor):
             self.top_hash, self.top_cols, self.top_valids, self.top_n)
         return StreamChunk(out_cols, ops, vis, self.schema)
 
+    _SECONDARY = ("top_hash", "top_cols", "top_valids")
+
     def check_watchdog(self) -> None:
-        vals = np.asarray(self._errs_dev)
+        vals = np.asarray(self._wd_pack(self._errs_dev, self.n))
         if int(vals[0]):
             raise RuntimeError(
                 f"retractable TopN overflow ({int(vals[0])} rows dropped; "
@@ -231,6 +238,7 @@ class RetractableTopNExecutor(StatefulUnaryExecutor):
         if int(vals[1]):
             raise RuntimeError(
                 f"retractable TopN: {int(vals[1])} deletes matched no row")
+        self._maybe_grow(int(vals[2]))
 
     def fence_tokens(self) -> list:
         return [self.n, self.top_n] + super().fence_tokens()

@@ -70,6 +70,41 @@ class CallbackSink(SinkTarget):
         self.fn(epoch, rows)
 
 
+class ArrowCallbackSink(SinkTarget):
+    """Delivers each epoch as a pyarrow RecordBatch (ops as an extra
+    int8 'op' column) — the Arrow egress ramp (arrow_impl.rs role)."""
+
+    def __init__(self, fn: Callable, schema):
+        import pyarrow as pa
+        from ..common.arrow import arrow_schema
+        self.fn = fn
+        self.schema = schema
+        self._asch = arrow_schema(schema)
+        self._out_schema = self._asch.append(pa.field("op", pa.int8()))
+        self._committed = 0
+
+    def write(self, epoch: int, rows: list) -> None:
+        import pyarrow as pa
+        cols = list(zip(*[vals for _, vals in rows])) if rows else [
+            [] for _ in self.schema]
+        arrays = []
+        for f, af, vals in zip(self.schema, self._asch, cols):
+            if f.data_type is DataType.VARCHAR:
+                arrays.append(pa.array(
+                    [None if v is None else GLOBAL_DICT.decode(int(v))
+                     for v in vals], type=pa.string()).dictionary_encode())
+            else:
+                arrays.append(pa.array(list(vals), type=af.type))
+        arrays.append(pa.array([op for op, _ in rows], type=pa.int8()))
+        batch = pa.RecordBatch.from_arrays(arrays,
+                                           schema=self._out_schema)
+        self.fn(epoch, batch)
+        self._committed = epoch
+
+    def committed_epoch(self) -> int:
+        return self._committed
+
+
 class FileSink(SinkTarget):
     """JSONL with per-epoch records: {"epoch": E, "rows": [[op, [...]], ...]}.
     The append-only file doubles as the delivery log: recovery reads the

@@ -143,6 +143,23 @@ def _empty_sorted_side(capacity: int, col_dtypes: Sequence) -> SortedSideState:
     )
 
 
+def grow_sorted_arrays(khash, cols, valids, new_capacity: int):
+    """Reallocate a sorted dense store at a larger capacity (live prefix
+    unchanged, padding = hash sentinel / zeros). Device-side concat —
+    subsequent programs re-jit at the new static shape (reference role:
+    src/common/src/estimate_size/ + cache growth; here growth is the
+    memory-pressure response instead of fail-stop)."""
+    pad = new_capacity - khash.shape[0]
+    assert pad > 0
+    kh = jnp.concatenate([khash, jnp.full(pad, _HSENTINEL,
+                                          dtype=khash.dtype)])
+    cols2 = tuple(jnp.concatenate([c, jnp.zeros(pad, dtype=c.dtype)])
+                  for c in cols)
+    valids2 = tuple(jnp.concatenate([v, jnp.zeros(pad, dtype=bool)])
+                    for v in valids)
+    return kh, cols2, valids2
+
+
 def _count_le(sorted_arr: jnp.ndarray, dead_cum: jnp.ndarray,
               vals: jnp.ndarray, side: str) -> jnp.ndarray:
     """Count of LIVE entries of `sorted_arr` </<= vals, where `dead_cum`
@@ -727,11 +744,37 @@ class SortedJoinExecutor(Executor):
             if v is not None and v > self._pending_clean[t]:
                 self._pending_clean[t] = v
 
+    def _maybe_grow(self) -> None:
+        """Double a side's capacity at 0.7 occupancy (memory-pressure
+        growth instead of fail-stop; needs the watchdog's barrier fetch
+        for the live count — transfer-free mode keeps fixed capacity,
+        the same contract as hash_join's rebuild gating)."""
+        known = getattr(self, "_n_known", None)
+        if known is None:
+            return
+        for s in (LEFT, RIGHT):
+            if known[s] <= 0.7 * self.capacity[s]:
+                continue
+            new_c = self.capacity[s] * 2
+            for attr, st in (("sides", self.sides), ("_snap", self._snap)):
+                side = st[s]
+                if side is None or side.capacity >= new_c:
+                    continue
+                kh, cols, valids = grow_sorted_arrays(
+                    side.khash, side.cols, side.valids, new_c)
+                deg = jnp.concatenate([
+                    side.degree,
+                    jnp.zeros(new_c - side.capacity, dtype=jnp.int32)])
+                st[s] = SortedSideState(kh, cols, valids, deg, side.n)
+            self.capacity[s] = new_c
+            self.rebuilds += 1
+
     # --------------------------------------------------------- watchdog
     def _check_watchdog(self) -> None:
         vals = np.asarray(self._watchdog_pack(
             self._errs_dev, self._n_dev[LEFT], self._n_dev[RIGHT]))
         n_mo, n_miss, n_ro = (int(x) for x in vals[:3])
+        self._n_known = [int(vals[3]), int(vals[4])]
         if n_mo:
             raise RuntimeError(
                 f"sorted-join match-buffer overflow ({n_mo} matches "
@@ -792,6 +835,7 @@ class SortedJoinExecutor(Executor):
                 # this epoch's checkpoint (hash_join.py contract)
                 if self.watchdog_interval and (stopping or dirty_any):
                     self._check_watchdog()
+                    self._maybe_grow()
                 self._persist(barrier)
                 yield barrier
             else:

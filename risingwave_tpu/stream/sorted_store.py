@@ -126,3 +126,44 @@ def segment_starts(sorted_group_ids: jnp.ndarray):
     pos = jnp.arange(C, dtype=jnp.int32)
     run_start = jax.lax.cummax(jnp.where(new_run, pos, 0))
     return new_run, run_start
+
+
+class GrowableSortedStore:
+    """Mixin for executors holding the dense sorted store plus one
+    same-capacity secondary (the last-emitted set): doubles both at 0.7
+    occupancy instead of fail-stopping, and pre-sizes before a recovery
+    replay so state that grew past the constructor capacity recovers.
+    Subclasses set _SECONDARY to the (hash, cols, valids) attr names."""
+
+    _SECONDARY: tuple = ()
+
+    def _grow_to(self, new_c: int) -> None:
+        import jax
+        from functools import partial
+        from .sorted_join import grow_sorted_arrays
+        self.khash, self.cols, self.valids = grow_sorted_arrays(
+            self.khash, self.cols, self.valids, new_c)
+        h, c, v = self._SECONDARY
+        kh2, c2, v2 = grow_sorted_arrays(
+            getattr(self, h), getattr(self, c), getattr(self, v), new_c)
+        setattr(self, h, kh2)
+        setattr(self, c, c2)
+        setattr(self, v, v2)
+        self.capacity = new_c
+        self._apply = jax.jit(partial(sorted_store_apply,
+                                      pk_idx=self.pk_indices,
+                                      capacity=new_c))
+
+    def _maybe_grow(self, n_live: int) -> None:
+        if n_live > 0.7 * self.capacity:
+            self._grow_to(self.capacity * 2)
+
+    def _presize_for(self, n_rows: int) -> None:
+        """Before a recovery replay: make room for every persisted row
+        (the store may have grown past the constructor capacity before
+        the crash)."""
+        c = self.capacity
+        while n_rows > 0.7 * c:
+            c *= 2
+        if c != self.capacity:
+            self._grow_to(c)

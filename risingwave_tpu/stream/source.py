@@ -29,16 +29,24 @@ class Connector(Protocol):
 
 
 class SourceExecutor(Executor):
-    def __init__(self, source_id: int, connector: Connector,
-                 barrier_queue: "asyncio.Queue[Barrier]",
+    def __init__(self, source_id: int, connector: Optional[Connector] = None,
+                 barrier_queue: "asyncio.Queue[Barrier]" = None,
                  state_table: Optional[StateTable] = None,
                  rate_limit_rows_per_barrier: Optional[int] = None,
                  emit_watermarks: bool = False,
                  watermark_lag_us: int = 0,
-                 max_inflight_chunks: int = 16):
+                 max_inflight_chunks: int = 16,
+                 splits: Optional[list] = None):
+        """Single-connector form (connector=...) or split-assigned form
+        (splits=[(split_id, connector), ...] — reference: the actor's
+        split assignment from SourceManager)."""
         self.source_id = source_id
-        self.connector = connector
-        self.schema = connector.schema
+        if splits is None:
+            splits = [(0, connector)]
+        assert splits and all(c is not None for _, c in splits)
+        self.splits = list(splits)
+        self.connector = self.splits[0][1]
+        self.schema = self.connector.schema
         self.barrier_queue = barrier_queue
         self.state_table = state_table
         self.rate_limit = rate_limit_rows_per_barrier
@@ -47,7 +55,12 @@ class SourceExecutor(Executor):
         # Connector-declared watermarks (reference: WATERMARK FOR clause on
         # sources + WatermarkFilterExecutor). The connector computes them on
         # host (no device readback); the source emits after each chunk.
-        self.emit_watermarks = emit_watermarks and hasattr(connector, "current_watermark")
+        def has_wm(c):
+            # probe through split wrappers: the wrapper defines the
+            # method unconditionally, the capability lives on the inner
+            return hasattr(getattr(c, "inner", c), "current_watermark")
+        self.emit_watermarks = emit_watermarks and all(
+            has_wm(c) for _, c in self.splits)
         # watermark lag (reference: WATERMARK FOR ts AS ts - interval):
         # downstream lookback joins/windows need rows to outlive the raw
         # event-time frontier by their window span
@@ -86,20 +99,22 @@ class SourceExecutor(Executor):
     def _recover_offset(self) -> None:
         if self.state_table is None:
             return
-        # constant slot key: the offset table is exclusive to this source
-        # NODE; actor ids are NOT stable across rebuilds (rescale/recovery
-        # reallocate them), so keying by actor id would orphan the offset
-        # and silently replay the stream from 0
-        row = self.state_table.get_row((0,))
-        if row is not None:
-            self.connector.seek(row[1])
+        # keyed by SPLIT ID: split ids are stable across rebuilds while
+        # actor ids are not (rescale/recovery reallocate them) — a
+        # re-assigned split finds its committed offset wherever it lands
+        # (reference: state_table_handler.rs keyed by split id)
+        for sid, conn in self.splits:
+            row = self.state_table.get_row((sid,))
+            if row is not None:
+                conn.seek(row[1])
 
     def _commit_offset(self, barrier: Barrier) -> None:
         if self.state_table is None:
             return
-        # upsert (slot, next_offset); offset rides the same epoch commit
-        # as operator state => exactly-once resume.
-        self.state_table.write_chunk_rows([(0, (0, self.connector.offset))])
+        # upsert (split_id, next_offset) per owned split; offsets ride
+        # the same epoch commit as operator state => exactly-once resume
+        self.state_table.write_chunk_rows(
+            [(0, (sid, conn.offset)) for sid, conn in self.splits])
         self.state_table.commit(barrier.epoch.curr)
 
     async def execute(self):
@@ -142,8 +157,26 @@ class SourceExecutor(Executor):
                 if barrier.is_stop(self.source_id):
                     return
                 continue
+            if all(getattr(c, "exhausted", False)
+                   for _, c in self.splits):
+                # finite connectors (ArrowSource): nothing to read until
+                # something external appends — block on barriers instead
+                # of busy-spinning empty chunks through the dataflow
+                barrier = await self.barrier_queue.get()
+                self._apply_mutation(barrier)
+                self._commit_offset(barrier)
+                sent_this_interval = 0
+                yield barrier
+                if barrier.is_stop(self.source_id):
+                    return
+                continue
             await self._acquire_credit()
-            chunk = self.connector.next_chunk()
+            # round-robin across owned splits (reference: the reader
+            # stream interleaves its assigned splits)
+            self._rr = getattr(self, "_rr", 0)
+            conn = self.splits[self._rr % len(self.splits)][1]
+            self._rr += 1
+            chunk = conn.next_chunk()
             self._tokens.append(chunk.columns[0].data)
             # Visible rows come from HOST knowledge only: a d2h sync per
             # chunk is forbidden in the steady state on tunneled TPUs. A
@@ -152,7 +185,7 @@ class SourceExecutor(Executor):
             # otherwise padded capacity is used, which OVER-counts partial
             # chunks by their padding — the conservative direction for the
             # rate limiter, and documented in the metric name below.
-            rows_host = getattr(self.connector, "last_chunk_rows", None)
+            rows_host = getattr(conn, "last_chunk_rows", None)
             if rows_host is None:
                 rows_host = chunk.capacity
             self._rows_metric.inc(rows_host)
@@ -160,12 +193,15 @@ class SourceExecutor(Executor):
                 sent_this_interval += rows_host
             yield chunk
             if self.emit_watermarks:
-                wm = self.connector.current_watermark() - self.watermark_lag_us
+                # safe frontier = MIN over owned splits (a lagging split
+                # may still hold earlier rows)
+                wm = min(c.current_watermark()
+                         for _, c in self.splits) - self.watermark_lag_us
                 if self._last_wm is None or wm > self._last_wm:
                     self._last_wm = wm
                     from ..common.types import DataType
                     from .message import Watermark
-                    yield Watermark(self.connector.watermark_col,
+                    yield Watermark(self.splits[0][1].watermark_col,
                                     DataType.TIMESTAMP, wm)
             # let barriers/other actors in
             await asyncio.sleep(0)

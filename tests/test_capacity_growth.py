@@ -1,0 +1,114 @@
+"""Memory-pressure capacity growth (VERDICT r3 #9): sorted-state
+executors double their device arrays at 0.7 occupancy instead of
+fail-stopping — state runs 4x+ past the initial capacity.
+
+Reference role: src/common/src/estimate_size/ + cache growth under
+memory pressure (here: grow, since HBM state is the engine's memory).
+"""
+
+import asyncio
+from collections import Counter
+
+import numpy as np
+
+from risingwave_tpu.common import DataType, schema
+from risingwave_tpu.common.chunk import OP_INSERT, StreamChunk
+from risingwave_tpu.common.epoch import EpochPair
+from risingwave_tpu.stream import Barrier, BarrierKind
+from risingwave_tpu.stream.executor import Executor
+from risingwave_tpu.stream.retract_top_n import RetractableTopNExecutor
+from risingwave_tpu.stream.sorted_join import SortedJoinExecutor
+
+L_SCHEMA = schema(("k", DataType.INT64), ("lv", DataType.INT64))
+R_SCHEMA = schema(("k", DataType.INT64), ("rv", DataType.INT64))
+
+
+class Script(Executor):
+    def __init__(self, sch, messages):
+        self.schema = sch
+        self.messages = messages
+        self.identity = "Script"
+        self.pk_indices = (1,)
+
+    async def execute(self):
+        for m in self.messages:
+            yield m
+            await asyncio.sleep(0)
+
+
+def chunk(sch, rows, cap=64):
+    ops = np.asarray([OP_INSERT] * len(rows), dtype=np.int8)
+    cols = [np.asarray([r[i] for r in rows], dtype=np.int64)
+            for i in range(len(sch))]
+    return StreamChunk.from_numpy(sch, cols, ops=ops, capacity=cap)
+
+
+def barrier(curr, prev, kind=BarrierKind.CHECKPOINT):
+    return Barrier(EpochPair(curr, prev), kind)
+
+
+def test_sorted_join_grows_past_capacity():
+    """64-capacity join ingests 4x64 rows per side: growth at barriers
+    keeps the watchdog green and the full cross-matching correct."""
+    n_rows = 256          # 4x the initial capacity
+    l_msgs = [barrier(1, 0, BarrierKind.INITIAL)]
+    r_msgs = [barrier(1, 0, BarrierKind.INITIAL)]
+    ep = 2
+    for base in range(0, n_rows, 32):
+        l_msgs.append(chunk(L_SCHEMA, [(i, i) for i in
+                                       range(base, base + 32)]))
+        r_msgs.append(chunk(R_SCHEMA, [(i, 1000 + i) for i in
+                                       range(base, base + 32)]))
+        l_msgs.append(barrier(ep, ep - 1))
+        r_msgs.append(barrier(ep, ep - 1))
+        ep += 1
+
+    async def go():
+        join = SortedJoinExecutor(
+            Script(L_SCHEMA, l_msgs), Script(R_SCHEMA, r_msgs),
+            left_key_indices=[0], right_key_indices=[0],
+            left_pk_indices=[1], right_pk_indices=[1],
+            capacity=64, match_factor=4)
+        out = []
+        async for m in join.execute():
+            out.append(m)
+        return join, out
+    join, out = asyncio.run(go())
+    assert join.capacity[0] >= n_rows and join.capacity[1] >= n_rows, \
+        join.capacity
+    assert join.rebuilds >= 2
+    got = Counter()
+    for m in out:
+        if isinstance(m, StreamChunk):
+            for op, vals in m.to_rows():
+                got[vals] += 1
+    assert got == Counter({(i, i, i, 1000 + i): 1 for i in range(n_rows)})
+
+
+def test_retract_top_n_grows_past_capacity():
+    n_rows = 300          # >4x initial capacity 64
+    msgs = [barrier(1, 0, BarrierKind.INITIAL)]
+    ep = 2
+    for base in range(0, n_rows, 30):
+        msgs.append(chunk(L_SCHEMA, [(i, i) for i in
+                                     range(base, base + 30)]))
+        msgs.append(barrier(ep, ep - 1))
+        ep += 1
+
+    async def go():
+        top = RetractableTopNExecutor(
+            Script(L_SCHEMA, msgs), (), order_col=0, limit=5,
+            descending=True, capacity=64, pk_indices=(1,))
+        out = []
+        async for m in top.execute():
+            out.append(m)
+        return top, out
+    top, out = asyncio.run(go())
+    assert top.capacity >= n_rows
+    acc = Counter()
+    for m in out:
+        if isinstance(m, StreamChunk):
+            for op, vals in m.to_rows():
+                acc[vals] += 1 if op == OP_INSERT else -1
+    final = {k for k, v in acc.items() if v}
+    assert final == {(i, i) for i in range(n_rows - 5, n_rows)}
