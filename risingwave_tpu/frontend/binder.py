@@ -298,6 +298,15 @@ class StreamPlanner:
                     e = ast.BinOp("and", e, r)
                 cond = bind_scalar(e, jscope)
             jt = getattr(rel, "join_type", "inner")
+            temporal = getattr(rel, "temporal", False)
+            if temporal and jt not in ("inner", "left"):
+                raise BindError("temporal joins are INNER or LEFT")
+            if temporal and not li.append_only:
+                # a retractable stream side would emit deletes for rows
+                # downstream never saw (the table side's emissions are
+                # suppressed) — the reference requires append-only too
+                raise BindError(
+                    "temporal joins need an append-only stream side")
             # --- watermark-driven state cleaning (reference: the stream
             # planner's watermark inference + interval-join condition
             # analysis, optimizer/plan_node/stream_hash_join.rs clean_*):
@@ -362,7 +371,7 @@ class StreamPlanner:
                     left_key_indices=lkeys, right_key_indices=rkeys,
                     left_pk_indices=list(lpk),
                     right_pk_indices=list(rpk),
-                    condition=cond, join_type=jt,
+                    condition=cond, join_type=jt, temporal=temporal,
                     capacity=self.cfg("streaming_join_capacity", 1 << 17),
                     match_factor=mf, match_factors=(mf_l, mf_r),
                     append_only=(li.append_only, ri.append_only),
@@ -371,9 +380,10 @@ class StreamPlanner:
                     durable=self.durable()),
                     inputs=(Exchange(lf), Exchange(rf)))
             else:
-                if jt != "inner":
+                if jt != "inner" or temporal:
                     raise BindError(
-                        "outer joins require integer-comparable keys")
+                        "outer/temporal joins require integer-comparable "
+                        "keys")
                 node = Node("hash_join", dict(
                     left_key_indices=lkeys, right_key_indices=rkeys,
                     left_pk_indices=list(lpk),
@@ -405,10 +415,13 @@ class StreamPlanner:
                 for lk, rk in zip(lkeys, rkeys):
                     if lk in li.wm_cols and rk in ri.wm_cols:
                         out_wm |= {lk, off + rk}
+            # temporal: the table side's updates emit nothing, so the
+            # output is append-only iff the STREAM side is
+            ao_out = ((li.append_only and jt == "inner") if temporal
+                      else (li.append_only and ri.append_only
+                            and jt == "inner"))
             return (f.fid, jscope,
-                    RelInfo(stream_key=jkey,
-                            append_only=(li.append_only and ri.append_only
-                                         and jt == "inner"),
+                    RelInfo(stream_key=jkey, append_only=ao_out,
                             wm_cols=frozenset(out_wm)))
         if isinstance(rel, ast.SubqueryRel):
             # FROM (SELECT ...) alias — plan the inner query WITHOUT

@@ -27,7 +27,7 @@ KEYWORDS = {
     "order", "desc", "asc", "offset", "between", "emit", "table", "sink",
     "alter", "set", "parallelism", "left", "right", "full", "outer",
     "inner", "over", "partition", "rows", "unbounded", "preceding",
-    "current", "row",
+    "current", "row", "for", "system_time", "of", "proctime",
 }
 
 _TOKEN_RE = re.compile(r"""
@@ -132,6 +132,7 @@ class JoinRel:
     right: object
     on: object                  # None = comma join (ON comes from WHERE)
     join_type: str = "inner"    # inner | left | right | full
+    temporal: bool = False      # FOR SYSTEM_TIME AS OF PROCTIME()
 
 
 @dataclass
@@ -358,9 +359,18 @@ class Parser:
                 break
             self.expect("kw", "join")
             right = self._rel_primary()
+            temporal = False
+            if self.accept("kw", "for"):
+                self.expect("kw", "system_time")
+                self.expect("kw", "as")
+                self.expect("kw", "of")
+                self.expect("kw", "proctime")
+                self.expect("op", "(")
+                self.expect("op", ")")
+                temporal = True
             self.expect("kw", "on")
             on = self._expr()
-            rel = JoinRel(rel, right, on, jt)
+            rel = JoinRel(rel, right, on, jt, temporal)
         return rel
 
     def _rel_primary(self):

@@ -418,6 +418,7 @@ def _build_sorted_join(args, inputs, ctx: ActorCtx, key):
         clean_specs=(tuple(args["clean_specs"])
                      if args.get("clean_specs") is not None else None),
         state_tables=state_tables,
+        temporal=args.get("temporal", False),
         watchdog_interval=args.get("watchdog_interval", 1))
 
 
@@ -452,6 +453,25 @@ def _build_general_over_window(args, inputs, ctx: ActorCtx, key):
         inputs[0], args["partition_by"], args["order_specs"],
         args["windows"], capacity=args.get("capacity", 1 << 14),
         state_table=st, pk_indices=pk,
+        watchdog_interval=args.get("watchdog_interval", 1))
+
+
+@register_builder("project_set")
+def _build_project_set(args, inputs, ctx, key):
+    from ..stream.project_set import ProjectSetExecutor
+    return ProjectSetExecutor(inputs[0], args["items"],
+                              max_rows_per_input=args.get("max_k", 16),
+                              names=args.get("names"))
+
+
+@register_builder("dynamic_filter")
+def _build_dynamic_filter(args, inputs, ctx, key):
+    from ..stream.dynamic import DynamicFilterExecutor
+    return DynamicFilterExecutor(
+        inputs[0], inputs[1], args["key_col"],
+        op=args.get("op", "greater_than"),
+        capacity=args.get("capacity", 1 << 14),
+        pk_indices=args.get("pk_indices"),
         watchdog_interval=args.get("watchdog_interval", 1))
 
 

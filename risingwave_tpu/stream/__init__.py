@@ -32,3 +32,5 @@ from .misc import (
     ValuesExecutor, WatermarkFilterExecutor,
 )
 from .general_over_window import GeneralOverWindowExecutor, WindowSpec  # noqa: E402,F401
+from .dynamic import DynamicFilterExecutor, NowExecutor  # noqa: E402,F401
+from .project_set import ProjectSetExecutor  # noqa: E402,F401

@@ -1,0 +1,91 @@
+"""ProjectSet — table functions in the select list.
+
+Reference: src/stream/src/executor/project_set.rs: each input row
+produces 0..k output rows (set-returning functions like
+generate_series / unnest), plus ordinary scalar projections and the
+`projected_row_id` ordinal column that keeps the output stream keyed.
+
+TPU re-design: the row fan-out is STATIC — with a declared per-row bound
+K, the output is an [N*K] lane grid (row i, ordinal j at lane i*K+j)
+with visibility j < count(i). No data-dependent shapes; ops replicate to
+every lane of their row, so retractions retract the whole set.
+
+Select items:
+  ("scalar", expr)                       one value per row
+  ("series", start_expr, stop_expr)      generate_series(start, stop):
+                                         ordinals start..stop-1, bounded
+                                         by max_rows_per_input
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..common.chunk import Column, StreamChunk
+from ..common.types import DataType, Field, Schema
+from .executor import StatelessUnaryExecutor
+from .message import Watermark
+
+
+class ProjectSetExecutor(StatelessUnaryExecutor):
+    def __init__(self, input, items: Sequence[tuple],
+                 max_rows_per_input: int = 16,
+                 names=None):
+        super().__init__(input)
+        self.items = tuple(items)
+        assert any(it[0] == "series" for it in self.items), \
+            "ProjectSet without a set-returning item is just Project"
+        self.k = max_rows_per_input
+        fields = [Field("projected_row_id", DataType.INT64)]
+        for j, it in enumerate(self.items):
+            name = (names[j] if names else f"p{j}")
+            # series values compute in int64 (start + ordinal)
+            fields.append(Field(name, it[1].ret_type if it[0] == "scalar"
+                                else DataType.INT64))
+        self.schema = Schema(tuple(fields))
+        self.identity = f"ProjectSet(k={self.k})"
+        self._step = jax.jit(self._step_impl)
+
+    def _step_impl(self, chunk: StreamChunk) -> StreamChunk:
+        N = chunk.capacity
+        K = self.k
+        lane = jnp.arange(N * K, dtype=jnp.int64)
+        src = (lane // K).astype(jnp.int32)
+        ordinal = lane % K
+        # per-row output count = max over series items of their lengths
+        count = jnp.zeros(N, dtype=jnp.int64)
+        series_vals = {}
+        for j, it in enumerate(self.items):
+            if it[0] != "series":
+                continue
+            start = it[1].eval(chunk.columns)
+            stop = it[2].eval(chunk.columns)
+            ln = jnp.clip(stop.data.astype(jnp.int64)
+                          - start.data.astype(jnp.int64), 0, K)
+            ok = start.valid_mask() & stop.valid_mask()
+            ln = jnp.where(ok, ln, 0)
+            count = jnp.maximum(count, ln)
+            series_vals[j] = (start.data.astype(jnp.int64), ln)
+        vis = jnp.take(chunk.vis, src) & (ordinal < jnp.take(count, src))
+        ops = jnp.take(chunk.ops, src)
+        cols = [Column(ordinal)]
+        for j, it in enumerate(self.items):
+            if it[0] == "scalar":
+                c = it[1].eval(chunk.columns)
+                cols.append(Column(jnp.take(c.data, src, axis=0),
+                                   jnp.take(c.valid_mask(), src, axis=0)))
+            else:
+                start, ln = series_vals[j]
+                val = jnp.take(start, src) + ordinal
+                valid = ordinal < jnp.take(ln, src)
+                cols.append(Column(val, valid))
+        return StreamChunk(tuple(cols), ops, vis, self.schema)
+
+    def map_chunk(self, chunk):
+        return self._step(chunk)
+
+    def map_watermark(self, wm: Watermark):
+        return None      # ordinals break monotonicity; keep it simple

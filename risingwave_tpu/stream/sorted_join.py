@@ -188,6 +188,7 @@ class SortedJoinExecutor(Executor):
                  clean_watermark_cols: tuple[Optional[int], Optional[int]] = (None, None),
                  clean_specs: Optional[tuple] = None,
                  state_tables: Optional[tuple] = None,
+                 temporal: bool = False,
                  watchdog_interval: Optional[int] = 1):
         self.inputs = (left, right)
         self.key_indices = (tuple(left_key_indices), tuple(right_key_indices))
@@ -265,6 +266,14 @@ class SortedJoinExecutor(Executor):
             assert self.clean_specs == (None, None), \
                 "outer joins do not support watermark state cleaning"
         self.join_type = join_type
+        # Temporal join (reference: temporal_join.rs — FOR SYSTEM_TIME AS
+        # OF PROCTIME()): the right side is a TABLE snapshot; its updates
+        # maintain state but emit NOTHING (no retroactive fixes of
+        # earlier outputs), so only left arrivals produce rows. Left
+        # probes read the right side's state as of processing time.
+        if temporal:
+            assert join_type in ("inner", "left"),                 "temporal joins are inner or left"
+        self.temporal = temporal
         # side s "preserves" its unmatched rows (emits NULL-padded output)
         self._outer = (join_type in ("left", "full"),
                        join_type in ("right", "full"))
@@ -804,6 +813,8 @@ class SortedJoinExecutor(Executor):
                     o.khash, o.cols, o.valids, oth_degree, o.n)
                 self._dirty[s] = True
                 self._flush_dirty[s] = True
+                if self.temporal and s == RIGHT:
+                    continue        # table-side updates emit nothing
                 yield StreamChunk(
                     tuple(cols[i] for i in self.output_indices), ops, vis,
                     self.schema)

@@ -1,0 +1,199 @@
+"""Now, DynamicFilter, ProjectSet, TemporalJoin (VERDICT r3 missing #7).
+
+References: now.rs, dynamic_filter.rs, project_set.rs, temporal_join.rs
+under /root/reference/src/stream/src/executor/.
+"""
+
+import asyncio
+from collections import Counter
+
+import numpy as np
+
+from risingwave_tpu.common import DataType, schema
+from risingwave_tpu.common.chunk import (
+    OP_DELETE, OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT, StreamChunk,
+)
+from risingwave_tpu.common.epoch import EpochPair
+from risingwave_tpu.stream import (
+    Barrier, BarrierKind, DynamicFilterExecutor, NowExecutor,
+    ProjectSetExecutor,
+)
+from risingwave_tpu.stream.executor import Executor
+from risingwave_tpu.stream.sorted_join import SortedJoinExecutor
+
+
+class Script(Executor):
+    def __init__(self, sch, messages, pk=(0,)):
+        self.schema = sch
+        self.messages = messages
+        self.identity = "Script"
+        self.pk_indices = pk
+
+    async def execute(self):
+        for m in self.messages:
+            yield m
+            await asyncio.sleep(0)
+
+
+def chunk(sch, rows, cap=16):
+    ops = np.asarray([r[0] for r in rows], dtype=np.int8)
+    cols = [np.asarray([r[1 + i] for r in rows], dtype=np.int64)
+            for i in range(len(sch))]
+    return StreamChunk.from_numpy(sch, cols, ops=ops, capacity=cap)
+
+
+def barrier(curr, prev, kind=BarrierKind.CHECKPOINT):
+    return Barrier(EpochPair(curr, prev), kind)
+
+
+def net(out):
+    acc = Counter()
+    for m in out:
+        if isinstance(m, StreamChunk):
+            for op, vals in m.to_rows():
+                acc[vals] += (1 if op in (OP_INSERT, OP_UPDATE_INSERT)
+                              else -1)
+    return Counter({k: v for k, v in acc.items() if v})
+
+
+def test_now_executor_updates_per_epoch():
+    async def go():
+        q = asyncio.Queue()
+        # epochs carry physical ms in the high 48 bits
+        for e, p in ((1 << 16, 0), (2 << 16, 1 << 16), (3 << 16, 2 << 16)):
+            await q.put(Barrier(EpochPair(e, p),
+                                BarrierKind.INITIAL if p == 0
+                                else BarrierKind.CHECKPOINT))
+        from risingwave_tpu.stream.message import StopMutation
+        stop = Barrier(EpochPair(4 << 16, 3 << 16),
+                       BarrierKind.CHECKPOINT,
+                       mutation=StopMutation(frozenset({0})))
+        await q.put(stop)
+        now = NowExecutor(q)
+        out = []
+        async for m in now.execute():
+            out.append(m)
+        return out
+    out = asyncio.run(go())
+    rows = [r for m in out if isinstance(m, StreamChunk)
+            for r in m.to_rows()]
+    # first emission inserts; later epochs update-in-place
+    assert rows[0][0] == OP_INSERT
+    final = net(out)
+    assert len(final) == 1
+    (ts,), = final.keys()
+    assert ts == 4000      # last barrier: 4ms -> 4000us
+
+
+def test_dynamic_filter_moving_threshold():
+    L = schema(("k", DataType.INT64), ("v", DataType.INT64))
+    R = schema(("m", DataType.INT64))
+    l_msgs = [barrier(1, 0, BarrierKind.INITIAL),
+              chunk(L, [(OP_INSERT, i, i * 10) for i in range(10)]),
+              barrier(2, 1),
+              barrier(3, 2),
+              chunk(L, [(OP_DELETE, 8, 80)]),
+              barrier(4, 3)]
+    r_msgs = [barrier(1, 0, BarrierKind.INITIAL),
+              chunk(R, [(OP_INSERT, 3)]),
+              barrier(2, 1),
+              # threshold rises: rows 4..7 must be retracted
+              chunk(R, [(OP_UPDATE_DELETE, 3), (OP_UPDATE_INSERT, 7)]),
+              barrier(3, 2),
+              barrier(4, 3)]
+
+    async def go():
+        f = DynamicFilterExecutor(Script(L, l_msgs), Script(R, r_msgs),
+                                  key_col=0, op="greater_than",
+                                  capacity=64)
+        out = []
+        async for m in f.execute():
+            out.append(m)
+        return out
+    out = asyncio.run(go())
+    # final: k > 7, k != 8 (deleted) -> {9}
+    assert net(out) == Counter({(9, 90): 1})
+
+
+def test_project_set_generate_series():
+    from risingwave_tpu.expr import col, lit
+    S = schema(("k", DataType.INT64), ("n", DataType.INT64))
+    msgs = [barrier(1, 0, BarrierKind.INITIAL),
+            chunk(S, [(OP_INSERT, 1, 3), (OP_INSERT, 2, 0),
+                      (OP_INSERT, 3, 2)]),
+            barrier(2, 1),
+            chunk(S, [(OP_DELETE, 3, 2)]),
+            barrier(3, 2)]
+
+    async def go():
+        ps = ProjectSetExecutor(
+            Script(S, msgs),
+            [("scalar", col(0)), ("series", lit(0), col(1))],
+            max_rows_per_input=8)
+        out = []
+        async for m in ps.execute():
+            out.append(m)
+        return out
+    out = asyncio.run(go())
+    # k=1 -> ordinals 0,1,2; k=2 -> none; k=3 inserted then retracted
+    assert net(out) == Counter({
+        (0, 1, 0): 1, (1, 1, 1): 1, (2, 1, 2): 1})
+
+
+def test_temporal_join_right_updates_emit_nothing():
+    L = schema(("k", DataType.INT64), ("lv", DataType.INT64))
+    R = schema(("k", DataType.INT64), ("rv", DataType.INT64))
+    l_msgs = [barrier(1, 0, BarrierKind.INITIAL),
+              barrier(2, 1),
+              chunk(L, [(OP_INSERT, 1, 10)]),       # rv=100 snapshot
+              barrier(3, 2),
+              barrier(4, 3),
+              chunk(L, [(OP_INSERT, 1, 11)]),       # rv=200 snapshot
+              barrier(5, 4)]
+    r_msgs = [barrier(1, 0, BarrierKind.INITIAL),
+              chunk(R, [(OP_INSERT, 1, 100)]),
+              barrier(2, 1),
+              barrier(3, 2),
+              chunk(R, [(OP_UPDATE_DELETE, 1, 100),
+                        (OP_UPDATE_INSERT, 1, 200)]),
+              barrier(4, 3),
+              barrier(5, 4)]
+
+    async def go():
+        join = SortedJoinExecutor(
+            Script(L, l_msgs, pk=(1,)), Script(R, r_msgs, pk=(0,)),
+            left_key_indices=[0], right_key_indices=[0],
+            left_pk_indices=[1], right_pk_indices=[0],
+            capacity=64, temporal=True)
+        out = []
+        async for m in join.execute():
+            out.append(m)
+        return out
+    out = asyncio.run(go())
+    got = net(out)
+    # first arrival saw rv=100 (never retracted), second saw rv=200
+    assert got == Counter({(1, 10, 1, 100): 1, (1, 11, 1, 200): 1})
+
+
+async def test_temporal_join_sql():
+    from risingwave_tpu.frontend import Session
+    s = Session()
+    await s.execute("CREATE SOURCE bid WITH (connector='nexmark', "
+                    "table='bid', chunk_size=128, rate_limit=128)")
+    await s.execute("CREATE SOURCE auction WITH (connector='nexmark', "
+                    "table='auction', primary_key='id', chunk_size=64, "
+                    "rate_limit=64)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW tj AS "
+        "SELECT B.auction, B.price, A.category FROM bid B "
+        "JOIN auction A FOR SYSTEM_TIME AS OF PROCTIME() "
+        "ON B.auction = A.id")
+    await s.tick(3)
+    rows = s.query("SELECT auction, price, category FROM tj")
+    assert rows
+    # auctions are append-only, so the proctime snapshot == final table:
+    # each auction id maps to exactly one category across the output
+    by_auction = {}
+    for auc, _, cat in rows:
+        assert by_auction.setdefault(auc, cat) == cat
+    await s.drop_all()
