@@ -505,6 +505,56 @@ class StreamPlanner:
         if jinfo is not None and frag.root is jinfo["node"]:
             scope, info, sel = self._optimize_join(jinfo, scope, info, sel)
 
+        # `col > now()`-style conjuncts lower to DynamicFilter against a
+        # Now fragment (reference: the NOW() rewrite producing
+        # StreamDynamicFilter + StreamNow); the rest become a plain filter
+        if sel.where is not None:
+            plain, dynamic = [], []
+            for conj in split_conjuncts(sel.where):
+                df = _now_conjunct(conj, scope)
+                if df is None:
+                    plain.append(conj)
+                else:
+                    dynamic.append(df)
+            # static predicates graft FIRST: rows they reject must never
+            # occupy the dynamic filter's bounded device state
+            if dynamic and plain:
+                e0 = plain[0]
+                for c in plain[1:]:
+                    e0 = ast.BinOp("and", e0, c)
+                frag.root = Node("filter",
+                                 dict(predicate=bind_scalar(e0, scope)),
+                                 inputs=(frag.root,))
+                plain = []
+            for key_col, op in dynamic:
+                if info.stream_key is None:
+                    if not info.append_only:
+                        raise BindError(
+                            "keyless retracting dynamic-filter input")
+                    from ..common.types import Field
+                    frag.root = Node("row_id_gen", {},
+                                     inputs=(frag.root,))
+                    sch2 = Schema(tuple(scope.schema) + (
+                        Field("_row_id", DataType.SERIAL),))
+                    scope = Scope(sch2, dict(scope.names))
+                    info = RelInfo((len(sch2) - 1,), True, info.wm_cols)
+                now_f = self.graph.add(Fragment(
+                    self.fid(), Node("now", {}), dispatch="broadcast"))
+                frag.root = Node("dynamic_filter", dict(
+                    key_col=key_col, op=op,
+                    pk_indices=list(info.stream_key),
+                    capacity=self.cfg("streaming_dynamic_filter_capacity",
+                                      1 << 14),
+                    watchdog_interval=(
+                        1 if self.cfg("streaming_watchdog", 1) else None)),
+                    inputs=(frag.root, Exchange(now_f.fid)))
+                # output retracts when the threshold moves
+                info = RelInfo(info.stream_key, False, info.wm_cols)
+            w = None
+            for c in plain:
+                w = c if w is None else ast.BinOp("and", w, c)
+            sel = ast.Select(sel.items, sel.rel, w, sel.group_by,
+                             sel.order_by, sel.limit, sel.offset)
         if sel.where is not None:
             pred = bind_scalar(sel.where, scope)
             frag.root = Node("filter", dict(predicate=pred),
@@ -1239,3 +1289,25 @@ def auto_name(e, j: int) -> str:
     if isinstance(e, ast.Func):
         return e.name
     return f"expr{j}"
+
+def _now_conjunct(conj, scope):
+    """`col OP now()` (either side) -> (col_index, dynamic-filter op)."""
+    if not isinstance(conj, ast.BinOp):
+        return None
+    ops = {"greater_than", "greater_than_or_equal", "less_than",
+           "less_than_or_equal"}
+    if conj.op not in ops:
+        return None
+
+    def is_now(e):
+        return isinstance(e, ast.Func) and e.name == "now" and not e.args
+
+    flip = {"greater_than": "less_than",
+            "greater_than_or_equal": "less_than_or_equal",
+            "less_than": "greater_than",
+            "less_than_or_equal": "greater_than_or_equal"}
+    if isinstance(conj.left, ast.ColRef) and is_now(conj.right):
+        return scope.resolve(conj.left)[0], conj.op
+    if is_now(conj.left) and isinstance(conj.right, ast.ColRef):
+        return scope.resolve(conj.right)[0], flip[conj.op]
+    return None

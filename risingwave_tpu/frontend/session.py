@@ -103,6 +103,7 @@ class Session:
         "streaming_watchdog": (1, int),      # 0 disables d2h error fetches
         "streaming_parallelism": (1, int),
         "streaming_over_window_capacity": (1 << 14, int),
+        "streaming_dynamic_filter_capacity": (1 << 14, int),
         # 0 = in-memory state backend for stateful executors (reference:
         # the in-memory hummock backend) — no per-barrier state-table
         # flush; crash recovery then replays sources from scratch

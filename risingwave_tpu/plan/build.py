@@ -456,6 +456,15 @@ def _build_general_over_window(args, inputs, ctx: ActorCtx, key):
         watchdog_interval=args.get("watchdog_interval", 1))
 
 
+@register_builder("now")
+def _build_now(args, inputs, ctx, key):
+    from ..stream.dynamic import NowExecutor
+    barrier_q: asyncio.Queue = asyncio.Queue()
+    ctx.env.coord.register_source(barrier_q)
+    ctx.env.pending_source_queues.append(barrier_q)
+    return NowExecutor(barrier_q)
+
+
 @register_builder("project_set")
 def _build_project_set(args, inputs, ctx, key):
     from ..stream.project_set import ProjectSetExecutor

@@ -47,9 +47,13 @@ class ProjectSetExecutor(StatelessUnaryExecutor):
                                 else DataType.INT64))
         self.schema = Schema(tuple(fields))
         self.identity = f"ProjectSet(k={self.k})"
+        # rows a series produced beyond the static bound — silently
+        # clipping would make the MV wrong with no signal (every bounded
+        # structure here fail-stops; see sorted-store overflow counters)
+        self._overflow_dev = jnp.zeros((), dtype=jnp.int32)
         self._step = jax.jit(self._step_impl)
 
-    def _step_impl(self, chunk: StreamChunk) -> StreamChunk:
+    def _step_impl(self, overflow, chunk: StreamChunk):
         N = chunk.capacity
         K = self.k
         lane = jnp.arange(N * K, dtype=jnp.int64)
@@ -63,10 +67,13 @@ class ProjectSetExecutor(StatelessUnaryExecutor):
                 continue
             start = it[1].eval(chunk.columns)
             stop = it[2].eval(chunk.columns)
-            ln = jnp.clip(stop.data.astype(jnp.int64)
-                          - start.data.astype(jnp.int64), 0, K)
-            ok = start.valid_mask() & stop.valid_mask()
-            ln = jnp.where(ok, ln, 0)
+            raw = jnp.clip(stop.data.astype(jnp.int64)
+                           - start.data.astype(jnp.int64), 0, None)
+            ok = start.valid_mask() & stop.valid_mask() & chunk.vis
+            raw = jnp.where(ok, raw, 0)
+            overflow = overflow + jnp.sum(
+                jnp.maximum(raw - K, 0)).astype(jnp.int32)
+            ln = jnp.minimum(raw, K)
             count = jnp.maximum(count, ln)
             series_vals[j] = (start.data.astype(jnp.int64), ln)
         vis = jnp.take(chunk.vis, src) & (ordinal < jnp.take(count, src))
@@ -82,10 +89,19 @@ class ProjectSetExecutor(StatelessUnaryExecutor):
                 val = jnp.take(start, src) + ordinal
                 valid = ordinal < jnp.take(ln, src)
                 cols.append(Column(val, valid))
-        return StreamChunk(tuple(cols), ops, vis, self.schema)
+        return overflow, StreamChunk(tuple(cols), ops, vis, self.schema)
 
     def map_chunk(self, chunk):
-        return self._step(chunk)
+        self._overflow_dev, out = self._step(self._overflow_dev, chunk)
+        return out
+
+    def on_barrier(self, barrier) -> None:
+        import numpy as np
+        n = int(np.asarray(self._overflow_dev))
+        if n:
+            raise RuntimeError(
+                f"ProjectSet series overflow: {n} rows beyond "
+                f"max_rows_per_input={self.k} were dropped")
 
     def map_watermark(self, wm: Watermark):
         return None      # ordinals break monotonicity; keep it simple
