@@ -179,12 +179,14 @@ def _numpy_q17(part_cols, li_chunks) -> float:
     want_c = GLOBAL_DICT.get_or_insert("MED BOX")
     pk, pb, pc = part_cols[0], part_cols[1], part_cols[2]
     # part keys are an unbounded serial (only the first NUM_PARTS are
-    # ever referenced by lineitems) — size by the actual max key
-    ok = np.zeros(int(pk.max()) + 1, dtype=bool)
+    # ever referenced by lineitems) — size EVERY per-part array by the
+    # same bound so the masks line up
+    width = max(int(pk.max()), NUM_PARTS) + 1
+    ok = np.zeros(width, dtype=bool)
     ok[pk[(pb == want_b) & (pc == want_c)]] = True
-    sumq = np.zeros(NUM_PARTS + 1, dtype=np.int64)
-    cnt = np.zeros(NUM_PARTS + 1, dtype=np.int64)
-    contrib = np.zeros(NUM_PARTS + 1, dtype=np.float64)
+    sumq = np.zeros(width, dtype=np.int64)
+    cnt = np.zeros(width, dtype=np.int64)
+    contrib = np.zeros(width, dtype=np.float64)
     all_pk = np.empty(0, dtype=np.int64)
     all_q = np.empty(0, dtype=np.int64)
     all_ep = np.empty(0, dtype=np.int64)
@@ -819,7 +821,11 @@ def main() -> None:
     # has ONE cpu core (nproc=1), so anything concurrent — device actors
     # or sibling baselines — depresses the numpy numbers 2-4x and
     # corrupts vs_baseline in either direction (round-4 measurement)
-    for q, (n, cs) in BASELINE_CHUNKS.items():
+    # priority order: q17's ratio is a staged-config deliverable and q1's
+    # is the least informative — if the budget runs out, lose q1 first
+    baseline_order = ["q17", "q7", "q8", "q5", "q1"]
+    for q in baseline_order:
+        n, cs = BASELINE_CHUNKS[q]
         base = None
         remaining = GLOBAL_BUDGET_S - (time.perf_counter() - t0) - 10
         if remaining <= 10:

@@ -148,6 +148,22 @@ class Session:
             return None
         return getattr(self.store, "_catalog_blob", None)
 
+    async def backup(self, dest_object_store) -> dict:
+        """Consistent backup of the session's durable state (manifest,
+        SSTs, catalog/DDL log) into another object store. Holds the
+        coordinator's rounds lock so no sync/compaction/manifest swap
+        runs mid-copy (reference: src/storage/backup/src/, the meta
+        snapshot taken under the barrier manager's pause)."""
+        from ..state.backup import backup_objects
+        objects = getattr(self.store, "objects", None)
+        if objects is None:
+            raise BindError("backup needs a durable (Hummock) store")
+        async with self.coord._rounds_lock:
+            # the lock quiesces rounds; the copy itself runs off-loop so
+            # pgwire/sinks/actors stay responsive during a large backup
+            return await asyncio.to_thread(backup_objects, objects,
+                                           dest_object_store)
+
     async def recover(self) -> None:
         """Replay the persisted DDL log: re-register sources, re-deploy
         every MV with its original table ids (their materialized state is

@@ -1,0 +1,47 @@
+"""Backup / restore of a Hummock deployment (manifest + SSTs + catalog).
+
+Reference: src/storage/backup/src/ (meta snapshot + SST manifest backup,
+restored into a fresh cluster). Here a backup is an object-store-level
+copy taken in dependency order — SSTs first, the MANIFEST and CATALOG
+last — so the copied manifest can only reference SSTs that were already
+copied (SST files are immutable once uploaded; the manifest swap is the
+only mutation). Callers must quiesce compaction/sync for full
+consistency; `Session.backup()` takes the coordinator's rounds lock to
+guarantee it.
+"""
+
+from __future__ import annotations
+
+from .object_store import ObjectStore
+
+
+def _manifest_last() -> tuple:
+    # imported, not re-hardcoded: a rename of either constant must keep
+    # the copy-ordering guarantee intact
+    from .hummock import MANIFEST_PATH
+    from ..frontend.session import CATALOG_PATH
+    return (MANIFEST_PATH, CATALOG_PATH)
+
+
+def backup_objects(src: ObjectStore, dst: ObjectStore) -> dict:
+    """Copy every object from src to dst, manifest/catalog LAST.
+    Returns a small summary manifest."""
+    last = _manifest_last()
+    names = src.list("")
+    ordinary = [n for n in names if n not in last]
+    copied = 0
+    for n in ordinary:
+        dst.upload(n, src.read(n))
+        copied += 1
+    for n in last:
+        if src.exists(n):
+            dst.upload(n, src.read(n))
+            copied += 1
+    return {"objects": copied}
+
+
+def restore_store(backup: ObjectStore):
+    """Open a HummockStateStore over a backup (or a copy of it) — the
+    catalog/DDL log restores through Session.recover() as usual."""
+    from .hummock import HummockStateStore
+    return HummockStateStore(backup)

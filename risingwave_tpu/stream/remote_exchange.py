@@ -1,0 +1,250 @@
+"""Remote exchange — the cross-host (DCN) tier of the communication
+backend.
+
+Reference: src/stream/src/executor/exchange/input.rs:103-120
+(RemoteInput), src/compute/src/rpc/service/exchange_service.rs:78
+(GetStream) and proto/task_service.proto:103-113 — gRPC streams with
+permit-based (credit) backpressure between compute nodes. Mesh-internal
+shuffles ride ICI as XLA collectives (parallel/exchange.py); THIS module
+carries fragment edges that cross process/host boundaries.
+
+TPU-first wire design: chunks serialize as Arrow IPC record batches
+(common/arrow.py — fixed-width columns move as whole buffers, VARCHAR as
+dictionary indices against each side's GLOBAL_DICT with the dictionary
+shipped in-band), ops ride as an extra int8 column, and only VISIBLE
+rows travel. Barriers/watermarks are small JSON frames. Flow control is
+credit-based exactly like permit.rs: the receiver grants chunk credits
+as its bounded queue drains; the sender awaits credits before writing,
+so a slow consumer backpressures through TCP instead of ballooning.
+
+Frame format: 1-byte type ('C' chunk | 'B' barrier | 'W' watermark |
+'K' credit grant) + 4-byte big-endian length + payload.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import json
+import struct
+from typing import Optional
+
+import numpy as np
+
+from ..common.chunk import StreamChunk
+from ..common.types import Schema
+from .executor import Executor
+from .message import (
+    Barrier, BarrierKind, PauseMutation, ResumeMutation, StopMutation,
+    ThrottleMutation, Watermark,
+)
+from ..common.epoch import EpochPair
+
+
+def _ser_mutation(m) -> Optional[dict]:
+    if m is None:
+        return None
+    if isinstance(m, StopMutation):
+        return {"type": "stop", "actor_ids": sorted(m.actor_ids)}
+    if isinstance(m, PauseMutation):
+        return {"type": "pause"}
+    if isinstance(m, ResumeMutation):
+        return {"type": "resume"}
+    if isinstance(m, ThrottleMutation):
+        return {"type": "throttle", "limits": [list(x) for x in m.limits]}
+    raise ValueError(f"unserializable mutation {m!r}")
+
+
+def _de_mutation(d):
+    if d is None:
+        return None
+    t = d["type"]
+    if t == "stop":
+        return StopMutation(frozenset(d["actor_ids"]))
+    if t == "pause":
+        return PauseMutation()
+    if t == "resume":
+        return ResumeMutation()
+    if t == "throttle":
+        return ThrottleMutation(tuple(tuple(x) for x in d["limits"]))
+    raise ValueError(t)
+
+
+def _chunk_payload(chunk: StreamChunk) -> bytes:
+    import pyarrow as pa
+    from ..common.arrow import chunk_to_arrow
+    batch = chunk_to_arrow(chunk)
+    ops = np.asarray(chunk.ops)[np.asarray(chunk.vis)]
+    batch = batch.append_column("__op", pa.array(ops, type=pa.int8()))
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, batch.schema) as w:
+        w.write_batch(batch)
+    return sink.getvalue()
+
+
+def _payload_chunk(payload: bytes, schema: Schema,
+                   capacity: int) -> StreamChunk:
+    import pyarrow as pa
+    from ..common.arrow import batch_to_chunk
+    with pa.ipc.open_stream(io.BytesIO(payload)) as r:
+        table = r.read_all()
+    batch = (table.combine_chunks().to_batches()[0]
+             if table.num_rows else
+             pa.RecordBatch.from_pylist([], schema=table.schema))
+    ops = np.asarray(batch.column("__op"), dtype=np.int8)
+    data = batch.drop_columns(["__op"])
+    cap = max(capacity, 1 << max(0, (batch.num_rows - 1).bit_length()))
+    chunk = batch_to_chunk(data, schema, capacity=cap)
+    full_ops = np.zeros(cap, dtype=np.int8)
+    full_ops[:len(ops)] = ops
+    import jax.numpy as jnp
+    return StreamChunk(chunk.columns, jnp.asarray(full_ops), chunk.vis,
+                       schema)
+
+
+async def _write_frame(writer, tag: bytes, payload: bytes) -> None:
+    writer.write(tag + struct.pack("!I", len(payload)) + payload)
+    await writer.drain()
+
+
+async def _read_frame(reader):
+    hdr = await reader.readexactly(5)
+    ln = struct.unpack("!I", hdr[1:])[0]
+    return hdr[:1], await reader.readexactly(ln)
+
+
+class RemoteOutput:
+    """Sender half (dispatch target, Channel-compatible `send`)."""
+
+    def __init__(self, host: str, port: int, credits: int = 0):
+        # credits start at ZERO: the receiver's initial grant (its queue
+        # depth) is the ONLY source of permits, exactly like permit.rs
+        self.host = host
+        self.port = port
+        self._credits = credits          # chunk permits in hand
+        self._credit_evt = asyncio.Event()
+        self._reader = self._writer = None
+        self._credit_task = None
+
+    async def connect(self) -> "RemoteOutput":
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port)
+        self._credit_task = asyncio.create_task(self._credit_loop())
+        return self
+
+    async def _credit_loop(self) -> None:
+        try:
+            while True:
+                tag, payload = await _read_frame(self._reader)
+                if tag == b"K":
+                    self._credits += struct.unpack("!I", payload)[0]
+                    self._credit_evt.set()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+
+    async def send(self, msg) -> None:
+        if isinstance(msg, StreamChunk):
+            while self._credits <= 0:     # permit-based backpressure
+                self._credit_evt.clear()
+                await self._credit_evt.wait()
+            self._credits -= 1
+            await _write_frame(self._writer, b"C", _chunk_payload(msg))
+        elif isinstance(msg, Barrier):
+            await _write_frame(self._writer, b"B", json.dumps({
+                "curr": msg.epoch.curr, "prev": msg.epoch.prev,
+                "kind": msg.kind.value,
+                "mutation": _ser_mutation(msg.mutation)}).encode())
+        elif isinstance(msg, Watermark):
+            await _write_frame(self._writer, b"W", json.dumps({
+                "col_idx": msg.col_idx, "dtype": msg.data_type.name,
+                "val": int(msg.val)}).encode())
+        else:
+            raise ValueError(f"unsendable message {type(msg)}")
+
+    async def close(self) -> None:
+        if self._credit_task:
+            self._credit_task.cancel()
+        if self._writer:
+            self._writer.close()
+
+
+class RemoteInput(Executor):
+    """Receiver half: a TCP server feeding this executor's stream
+    (exchange_service.rs GetStream). Grants credits as the consumer
+    drains — the bounded in-flight window IS the backpressure."""
+
+    def __init__(self, schema: Schema, host: str = "127.0.0.1",
+                 port: int = 0, capacity: int = 1024,
+                 queue_depth: int = 16, stop_on=None):
+        self.schema = schema
+        self.pk_indices = ()
+        self.host = host
+        self.port = port
+        self.capacity = capacity
+        self.queue_depth = queue_depth
+        self.stop_on = stop_on
+        self.identity = "RemoteInput"
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._server = None
+        self._conn_writer = None
+
+    async def start(self) -> "RemoteInput":
+        async def handle(reader, writer):
+            if self._conn_writer is not None:
+                # one producer per input (fan-in uses one RemoteInput per
+                # upstream edge) — a second connection would steal the
+                # credit channel and deadlock the first sender
+                writer.close()
+                return
+            self._conn_writer = writer
+            # initial credit window
+            await _write_frame(writer, b"K",
+                               struct.pack("!I", self.queue_depth))
+            try:
+                while True:
+                    tag, payload = await _read_frame(reader)
+                    await self._queue.put((tag, payload))
+            except (asyncio.IncompleteReadError, ConnectionResetError):
+                await self._queue.put((b"X", b""))
+
+        self._server = await asyncio.start_server(handle, self.host,
+                                                  self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def execute(self):
+        from ..common.types import DataType
+        while True:
+            tag, payload = await self._queue.get()
+            if tag == b"X":
+                return
+            if tag == b"C":
+                chunk = _payload_chunk(payload, self.schema,
+                                       self.capacity)
+                yield chunk
+                # grant the credit back once the chunk is in the pipeline
+                # (the peer may already be gone after its stop barrier)
+                if self._conn_writer is not None:
+                    try:
+                        await _write_frame(self._conn_writer, b"K",
+                                           struct.pack("!I", 1))
+                    except (ConnectionResetError, BrokenPipeError):
+                        self._conn_writer = None
+            elif tag == b"B":
+                d = json.loads(payload)
+                b = Barrier(EpochPair(d["curr"], d["prev"]),
+                            BarrierKind(d["kind"]),
+                            mutation=_de_mutation(d["mutation"]))
+                yield b
+                if isinstance(b.mutation, StopMutation) and (
+                        self.stop_on is None or self.stop_on(b)):
+                    return
+            elif tag == b"W":
+                d = json.loads(payload)
+                yield Watermark(d["col_idx"], DataType[d["dtype"]],
+                                d["val"])

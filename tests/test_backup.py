@@ -1,0 +1,48 @@
+"""Backup/restore (VERDICT r3 missing #12): a live session's durable
+state copies into a backup store; a FRESH session over the backup
+recovers every MV at the committed epoch and resumes.
+
+Reference: src/storage/backup/src/.
+"""
+
+from collections import Counter
+
+from risingwave_tpu.frontend import Session
+from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+
+
+async def test_backup_restore_resumes(tmp_path):
+    store = HummockStateStore(LocalFsObjectStore(str(tmp_path / "live")))
+    s = Session(store=store)
+    await s.execute("CREATE SOURCE bid WITH (connector='nexmark', "
+                    "table='bid', chunk_size=128, rate_limit=256)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW mv AS SELECT auction, price FROM bid "
+        "WHERE price > 5000000")
+    await s.tick(3)
+    snapshot = Counter(s.query("SELECT auction, price FROM mv"))
+    assert snapshot
+
+    backup_os = LocalFsObjectStore(str(tmp_path / "bak"))
+    meta = await s.backup(backup_os)
+    assert meta["objects"] >= 2          # >= manifest + catalog
+
+    # the ORIGINAL keeps running past the backup point
+    await s.tick(2)
+    later = Counter(s.query("SELECT auction, price FROM mv"))
+    assert sum(later.values()) > sum(snapshot.values())
+    await s.crash()
+
+    # a fresh session over the backup sees the state AS OF the backup,
+    # then resumes ingesting from the committed offsets
+    from risingwave_tpu.state.backup import restore_store
+    s2 = Session(store=restore_store(backup_os))
+    await s2.recover()
+    restored = Counter(s2.query("SELECT auction, price FROM mv"))
+    assert restored == snapshot, (
+        f"restore diverged: {len(restored)} vs {len(snapshot)} rows")
+    await s2.tick(2)
+    resumed = Counter(s2.query("SELECT auction, price FROM mv"))
+    assert sum(resumed.values()) > sum(snapshot.values())
+    assert all(resumed[k] >= v for k, v in snapshot.items())
+    await s2.drop_all()

@@ -177,3 +177,55 @@ async def test_pruned_q3_matches_unpruned_results():
     assert got == exp
     assert got, "q3 oracle vacuous"
     await s.drop_all()
+
+
+def _render_graph(plan):
+    """Stable text rendering of a whole plan (fragment order = fid)."""
+    lines = []
+    for fid in sorted(plan.graph.fragments):
+        f = plan.graph.fragments[fid]
+        lines.append(f"fragment {fid} dispatch={f.dispatch} "
+                     f"parallelism={f.parallelism} "
+                     f"dist={tuple(f.dist_key_indices or ())}")
+        lines.extend("  " + ln for ln in _render(f.root, 1))
+    return "\n".join(lines) + "\n"
+
+
+_GOLDEN_QUERIES = {
+    "q3": ("SELECT P.name, P.city, P.state, A.id "
+           "FROM auction AS A JOIN person AS P ON A.seller = P.id "
+           "WHERE A.category = 10 AND P.state = 'OR'"),
+    "q7_shape": ("SELECT B.auction, B.price FROM bid B JOIN ("
+                 "SELECT max(price) AS maxprice, window_end "
+                 "FROM TUMBLE(bid, date_time, 10000000) "
+                 "GROUP BY window_end) B1 ON B.price = B1.maxprice "
+                 "AND B.date_time <= B1.window_end"),
+    "left_join": ("SELECT A.id, P.name FROM auction A "
+                  "LEFT OUTER JOIN person P ON A.seller = P.id"),
+}
+
+
+async def test_plan_snapshots():
+    """Golden plan snapshots (reference: src/frontend/planner_test/).
+    Regenerate intentionally with REGEN_PLAN_GOLDENS=1 after reviewing
+    the diff — a surprise change here IS the signal."""
+    import os
+    import pathlib
+    s = await _nexmark_session()
+    gold_dir = pathlib.Path(__file__).parent / "goldens"
+    regen = os.environ.get("REGEN_PLAN_GOLDENS") == "1"
+    for name, sql_text in _GOLDEN_QUERIES.items():
+        _, plan = _plan(s, sql_text)
+        got = _render_graph(plan)
+        path = gold_dir / f"plan_{name}.txt"
+        if regen:
+            path.write_text(got)
+            continue
+        assert path.exists(), (
+            f"golden {path} missing — generate deliberately with "
+            f"REGEN_PLAN_GOLDENS=1 (a silently regenerated golden would "
+            f"bake regressions in)")
+        assert got == path.read_text(), (
+            f"plan snapshot {name} changed — review and regen with "
+            f"REGEN_PLAN_GOLDENS=1:\n{got}")
+    await s.drop_all()
