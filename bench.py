@@ -824,6 +824,8 @@ def main() -> None:
     # priority order: q17's ratio is a staged-config deliverable and q1's
     # is the least informative — if the budget runs out, lose q1 first
     baseline_order = ["q17", "q7", "q8", "q5", "q1"]
+    assert set(baseline_order) == set(BASELINE_CHUNKS), \
+        "baseline_order out of sync with BASELINE_CHUNKS"
     for q in baseline_order:
         n, cs = BASELINE_CHUNKS[q]
         base = None

@@ -334,6 +334,23 @@ def _run_agg(rel: _Rel, sel: ast.Select, items):
 
     def eval_item(e):
         """Scalar-over-aggregates evaluation at the group level."""
+        if isinstance(e, ast.Lit):
+            return np.full(n_groups, e.value), np.ones(n_groups, bool)
+        if not contains_agg(e):
+            # agg-free expressions match a GROUP BY key AS A WHOLE first
+            # (`auction % 7` with GROUP BY auction % 7 — found by the
+            # SQL fuzzer), then fall through to decomposition so
+            # expressions OVER keys (`auction + 1` with GROUP BY
+            # auction) still evaluate
+            eb = bind_scalar(e, rel.scope)
+            for j2, _k in enumerate(keys):
+                if repr(bind_scalar(sel.group_by[j2],
+                                    rel.scope)) == repr(eb):
+                    assert rep is not None
+                    return key_vals[j2][rep], key_valids[j2][rep]
+            if not isinstance(e, (ast.BinOp, ast.UnOp)):
+                raise BindError(
+                    f"{e!r} must be an aggregate or appear in GROUP BY")
         if isinstance(e, ast.Func) and e.name in AGG_FUNCS:
             v, valid = eval_agg(e)
             if valid is None:                  # COUNT: always valid
@@ -357,14 +374,6 @@ def _run_agg(rel: _Rel, sel: ast.Select, items):
                 raise BindError(
                     f"unsupported operator {e.op!r} over aggregates")
             return ops[e.op](np.asarray(a), np.asarray(b)), av & bv
-        if isinstance(e, ast.Lit):
-            return np.full(n_groups, e.value), np.ones(n_groups, bool)
-        # plain column: must be a group key
-        eb = bind_scalar(e, rel.scope)
-        for j, k in enumerate(keys):
-            if repr(bind_scalar(sel.group_by[j], rel.scope)) == repr(eb):
-                assert rep is not None
-                return key_vals[j][rep], key_valids[j][rep]
         raise BindError(f"{e!r} must be an aggregate or appear in GROUP BY")
 
     out_cols, out_valids, out_names, out_types = [], [], [], []

@@ -159,10 +159,23 @@ class Session:
         if objects is None:
             raise BindError("backup needs a durable (Hummock) store")
         async with self.coord._rounds_lock:
-            # the lock quiesces rounds; the copy itself runs off-loop so
-            # pgwire/sinks/actors stay responsive during a large backup
-            return await asyncio.to_thread(backup_objects, objects,
-                                           dest_object_store)
+            # the rounds lock quiesces sync/compaction (every MANIFEST
+            # swap), but DDL catalog uploads run outside it — snapshot
+            # the catalog NOW and write the snapshot last, so the backup
+            # is (catalog-as-of-start, manifest quiesced): concurrent
+            # DDL can only leave unreferenced extra state in the copy,
+            # never a catalog pointing at absent state
+            catalog = (objects.read(CATALOG_PATH)
+                       if objects.exists(CATALOG_PATH) else None)
+            # the copy itself runs off-loop so pgwire/sinks/actors stay
+            # responsive during a large backup
+            meta = await asyncio.to_thread(
+                backup_objects, objects, dest_object_store,
+                skip=(CATALOG_PATH,))
+            if catalog is not None:
+                dest_object_store.upload(CATALOG_PATH, catalog)
+                meta["objects"] += 1
+            return meta
 
     async def recover(self) -> None:
         """Replay the persisted DDL log: re-register sources, re-deploy

@@ -23,12 +23,14 @@ def _manifest_last() -> tuple:
     return (MANIFEST_PATH, CATALOG_PATH)
 
 
-def backup_objects(src: ObjectStore, dst: ObjectStore) -> dict:
-    """Copy every object from src to dst, manifest/catalog LAST.
-    Returns a small summary manifest."""
-    last = _manifest_last()
+def backup_objects(src: ObjectStore, dst: ObjectStore,
+                   skip: tuple = ()) -> dict:
+    """Copy every object from src to dst, manifest/catalog LAST (`skip`
+    lets the caller substitute its own snapshot of a name, e.g. the
+    catalog read under the rounds lock). Returns a summary manifest."""
+    last = [n for n in _manifest_last() if n not in skip]
     names = src.list("")
-    ordinary = [n for n in names if n not in last]
+    ordinary = [n for n in names if n not in last and n not in skip]
     copied = 0
     for n in ordinary:
         dst.upload(n, src.read(n))
