@@ -59,13 +59,17 @@ def _bind_rel(catalog, rel) -> _Rel:
     if isinstance(rel, ast.JoinRel):
         left = _bind_rel(catalog, rel.left)
         right = _bind_rel(catalog, rel.right)
-        return _hash_join(left, right, rel.on)
+        return _hash_join(left, right, rel.on,
+                          getattr(rel, "join_type", "inner"))
     raise BindError(f"batch queries cannot read {rel!r}")
 
 
-def _hash_join(left: _Rel, right: _Rel, on) -> _Rel:
-    """Inner equi-join (batch/src/executor/hash_join.rs): build on the
-    right, probe with the left, residue as a post-filter."""
+def _hash_join(left: _Rel, right: _Rel, on, join_type: str = "inner") -> _Rel:
+    """Equi-join, all JoinTypes (batch/src/executor/hash_join.rs): build
+    on the right, probe with the left. The ON residue filters MATCHED
+    pairs (outer-join semantics: a left row whose matches all fail the
+    residue still emits NULL-padded), then unmatched rows are appended
+    with the other side's columns NULL."""
     lkeys, rkeys, residue = [], [], []
     for conj in split_conjuncts(on):
         pair = equi_pair(conj, left.scope, right.scope)
@@ -113,17 +117,51 @@ def _hash_join(left: _Rel, right: _Rel, on) -> _Rel:
         np.cumsum(lens) - lens, lens)
     ri = order[starts + within]
 
-    cols = [c[li] for c in left.cols] + [c[ri] for c in right.cols]
-    valids = [v[li] for v in left.valids] + [v[ri] for v in right.valids]
-    out = _Rel(cols, valids, Scope.join(left.scope, right.scope))
+    scope = Scope.join(left.scope, right.scope)
     if residue:
         e = residue[0]
         for r in residue[1:]:
             e = ast.BinOp("and", e, r)
-        pred = bind_scalar(e, out.scope)
-        v, valid = eval_numpy(pred, out.cols, out.valids)
-        out = out.mask(np.asarray(v, dtype=bool) & valid)
-    return out
+        pred = bind_scalar(e, scope)
+        pcols = [c[li] for c in left.cols] + [c[ri] for c in right.cols]
+        pvalids = [v[li] for v in left.valids] + [v[ri] for v in right.valids]
+        v, valid = eval_numpy(pred, pcols, pvalids)
+        keep = np.asarray(v, dtype=bool) & valid
+        li, ri = li[keep], ri[keep]
+
+    if join_type == "inner":
+        cols = [c[li] for c in left.cols] + [c[ri] for c in right.cols]
+        valids = ([v[li] for v in left.valids]
+                  + [v[ri] for v in right.valids])
+        return _Rel(cols, valids, scope)
+
+    # outer joins: append unmatched rows with the other side NULL-padded
+    extra_l = np.empty(0, dtype=np.int64)
+    extra_r = np.empty(0, dtype=np.int64)
+    if join_type in ("left", "full"):
+        lmatched = np.zeros(left.n, dtype=bool)
+        lmatched[li] = True
+        extra_l = np.nonzero(~lmatched)[0]
+    if join_type in ("right", "full"):
+        rmatched = np.zeros(right.n, dtype=bool)
+        rmatched[ri] = True
+        extra_r = np.nonzero(~rmatched)[0]
+
+    def pad(c, n):
+        return np.zeros(n, dtype=np.asarray(c).dtype)
+
+    cols, valids = [], []
+    for c, v in zip(left.cols, left.valids):
+        c = np.asarray(c)
+        cols.append(np.concatenate([c[li], c[extra_l], pad(c, len(extra_r))]))
+        valids.append(np.concatenate(
+            [v[li], v[extra_l], np.zeros(len(extra_r), dtype=bool)]))
+    for c, v in zip(right.cols, right.valids):
+        c = np.asarray(c)
+        cols.append(np.concatenate([c[ri], pad(c, len(extra_l)), c[extra_r]]))
+        valids.append(np.concatenate(
+            [v[ri], np.zeros(len(extra_l), dtype=bool), v[extra_r]]))
+    return _Rel(cols, valids, scope)
 
 
 def _agg_reduce(kind: AggKind, vals, valid, seg_id, n_groups):

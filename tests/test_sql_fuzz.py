@@ -91,3 +91,53 @@ async def test_streaming_vs_batch_differential():
         await s.drop_mv(name)
     assert passed >= 15, f"only {passed} fuzz queries ran ({skipped} skipped)"
     await s.drop_all()
+
+
+async def test_streaming_vs_batch_join_differential():
+    """Join-shaped fuzzing incl. outer joins (VERDICT r4 #4): the newest
+    machinery — outer-join degrees on the streaming side, NULL padding on
+    the batch side — checks itself differentially."""
+    rng = random.Random(20260731)
+    s = Session()
+    await s.execute("CREATE SOURCE bid WITH (connector='nexmark', "
+                    "table='bid', chunk_size=256, rate_limit=512)")
+
+    passed = 0
+    saw_null = False
+    for i in range(6):
+        m = rng.randint(3, 17)
+        lf = rng.randint(2, 5)
+        rf = rng.randint(2, 5)
+        await s.execute(
+            f"CREATE MATERIALIZED VIEW ja{i} AS SELECT (auction % {m}) "
+            f"AS k, bidder, price FROM bid WHERE (bidder % {lf}) <> 0")
+        await s.execute(
+            f"CREATE MATERIALIZED VIEW jb{i} AS SELECT (auction % {m}) "
+            f"AS k, count(*) AS cnt, max(price) AS mp FROM bid "
+            f"WHERE (price % {rf}) = 0 GROUP BY (auction % {m})")
+        jt = rng.choice(["JOIN", "LEFT JOIN", "RIGHT JOIN", "FULL JOIN"])
+        sql_text = (f"SELECT A.bidder, A.price, B.cnt, B.mp "
+                    f"FROM ja{i} A {jt} jb{i} B ON A.k = B.k")
+        try:
+            await s.execute(
+                f"CREATE MATERIALIZED VIEW jm{i} AS {sql_text}")
+        except BindError:
+            await s.drop_mv(f"jb{i}")
+            await s.drop_mv(f"ja{i}")
+            continue
+        await s.tick(1)
+        got = Counter(s.query(f"SELECT bidder, price, cnt, mp FROM jm{i}"))
+        exp = Counter(s.query(sql_text))
+        assert got == exp, (
+            f"join divergence on {sql_text!r}: streaming={sum(got.values())}"
+            f" rows, batch={sum(exp.values())} rows; sample diff "
+            f"{list((got - exp).items())[:3]} / "
+            f"{list((exp - got).items())[:3]}")
+        saw_null |= any(None in row for row in got)
+        passed += 1
+        await s.drop_mv(f"jm{i}")
+        await s.drop_mv(f"jb{i}")
+        await s.drop_mv(f"ja{i}")
+    assert passed >= 4, f"only {passed} join fuzz queries ran"
+    assert saw_null, "no NULL-padded outer rows seen — outer fuzz vacuous"
+    await s.drop_all()
