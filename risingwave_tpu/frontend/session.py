@@ -104,6 +104,10 @@ class Session:
         "streaming_parallelism": (1, int),
         "streaming_over_window_capacity": (1 << 14, int),
         "streaming_dynamic_filter_capacity": (1 << 14, int),
+        # 0 disables the snapshot join-agg fusion (binder.py
+        # _try_snapshot_join_agg) — the q17 shape then plans the
+        # generic changelog join cascade
+        "streaming_snapshot_fuse": (1, int),
         # 0 = in-memory state backend for stateful executors (reference:
         # the in-memory hummock backend) — no per-barrier state-table
         # flush; crash recovery then replays sources from scratch

@@ -520,6 +520,37 @@ def _build_stateless_agg(args, inputs, ctx, key):
     return StatelessSimpleAggExecutor(inputs[0], args["agg_calls"])
 
 
+@register_builder("snapshot_join_agg")
+def _build_snapshot_join_agg(args, inputs, ctx: ActorCtx, key):
+    from ..stream.snapshot_join_agg import SnapshotJoinAggExecutor
+    state_tables = None
+    if args.get("durable"):
+        fact_sch = Schema(
+            (SchemaField("_pos", DataType.SERIAL),)
+            + tuple(inputs[0].schema)
+            + (SchemaField("_validbits", DataType.INT64),))
+        dim_sch = Schema((SchemaField("_pos", DataType.SERIAL),
+                          SchemaField("_key", DataType.INT64)))
+        state_tables = (
+            ctx.env.state_table(ctx.table_id((key, 0)), fact_sch, (0,)),
+            ctx.env.state_table(ctx.table_id((key, 1)), dim_sch, (0,)))
+    return SnapshotJoinAggExecutor(
+        inputs[0], inputs[1],
+        fact_key=args["fact_key"], dim_key=args["dim_key"],
+        sub_agg_calls=args["sub_agg_calls"],
+        sub_items=args["sub_items"], residue=args["residue"],
+        final_agg_calls=args["final_agg_calls"],
+        final_items=args["final_items"],
+        out_names=args["out_names"], out_types=args["out_types"],
+        fact_filter=args.get("fact_filter"),
+        sub_filter=args.get("sub_filter"),
+        dim_filter=args.get("dim_filter"),
+        capacity=args.get("capacity", 1 << 17),
+        dim_capacity=args.get("dim_capacity", 1 << 14),
+        state_tables=state_tables,
+        watchdog_interval=args.get("watchdog_interval", 1))
+
+
 @register_builder("row_id_gen")
 def _build_row_id(args, inputs, ctx: ActorCtx, key):
     return RowIdGenExecutor(inputs[0], instance=ctx.actor_id)

@@ -51,14 +51,15 @@ def _prefix(table, n):
     return [np.asarray(col.data)[:n] for col in c.columns]
 
 
-def _oracle(part_n, li_n):
+def _oracle(part_n, li_n, container: bool = True):
     from risingwave_tpu.common.types import GLOBAL_DICT
     p = _prefix("part", part_n)
     li = _prefix("lineitem", li_n)
     want_brand = GLOBAL_DICT.get_or_insert("Brand#23")
     want_cont = GLOBAL_DICT.get_or_insert("MED BOX")
     parts_ok = {int(k) for k, b, c in zip(p[0], p[1], p[2])
-                if int(b) == want_brand and int(c) == want_cont}
+                if int(b) == want_brand
+                and (not container or int(c) == want_cont)}
     by_part: dict[int, list] = {}
     for pk, q, ep in zip(li[1], li[2], li[3]):
         by_part.setdefault(int(pk), []).append((int(q), int(ep)))
@@ -99,13 +100,18 @@ async def test_q17_survives_crash_recovery(tmp_path):
     store = HummockStateStore(LocalFsObjectStore(str(tmp_path / "d")))
     s = Session(store=store)
     await s.execute("SET streaming_join_capacity = 32768")
+    # brand-only filter: the full brand+container predicate passes ~1/400
+    # parts, so at unit-test volumes ZERO rows qualify and sum() is NULL
+    # (SQL semantics) — a vacuous recovery check. (The exact q17 text is
+    # covered by the golden test above.)
     await s.execute(
         "CREATE SOURCE part WITH (connector='tpch', table='part', "
-        "chunk_size=128, rate_limit=128, primary_key='p_partkey')")
+        "chunk_size=512, rate_limit=512, primary_key='p_partkey')")
     await s.execute(
         "CREATE SOURCE lineitem WITH (connector='tpch', "
         "table='lineitem', chunk_size=256, rate_limit=512)")
-    await s.execute(Q17)
+    await s.execute(Q17.replace(
+        " AND P.p_container = 'MED BOX'", ""))
     await s.tick(3)
     victim = s.catalog.mvs["q17"].deployment.tasks[-1]
     victim.cancel()
@@ -117,8 +123,9 @@ async def test_q17_survives_crash_recovery(tmp_path):
     assert s.recoveries >= 1
     got = s.query("SELECT avg_yearly FROM q17")
     offs = _committed_offsets(s, "q17")
-    exp = _oracle(offs["part"], offs["lineitem"])
-    assert len(got) == 1 and got[0][0] is not None
+    exp = _oracle(offs["part"], offs["lineitem"], container=False)
+    assert len(got) == 1 and got[0][0] is not None, \
+        "no qualifying rows after recovery — check is vacuous"
     assert abs(got[0][0] - exp) < 1e-6 * max(1.0, abs(exp)), \
         f"q17 diverged after recovery: {got[0][0]} vs {exp}"
     await s.drop_all()
