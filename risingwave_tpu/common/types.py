@@ -180,3 +180,62 @@ class StringDictionary:
 # A process-global dictionary: ids are consistent across all columns, which
 # lets dict-encoded values flow between operators without re-encoding.
 GLOBAL_DICT = StringDictionary()
+
+
+# ------------------------------------------------------- dict durability
+# Open-vocabulary sources (connectors/file_source.py) mint dict ids at
+# parse time; MV state then stores those ids. The dictionary is
+# append-only with stable ids, so durability is an append-only DELTA LOG
+# in the object store: each checkpoint persists the strings minted since
+# the last one (meta/barrier_manager.py calls persist_dict_delta before
+# the epoch's manifest commit), and recovery replays the log IN ORDER
+# before anything re-encodes (frontend/session.py calls load_dict_log at
+# store-open). Reference: the dictionary the reference never needs —
+# its VARCHAR cells are inline bytes; dict encoding is the TPU design's
+# device representation, so its durability is a TPU-design obligation.
+
+_DICT_LOG_PREFIX = "dict/"
+
+
+def persist_dict_delta(objects, cursor: int) -> int:
+    """Append strings [cursor, len) to the log; returns the new cursor."""
+    import json as _json
+    n = len(GLOBAL_DICT)
+    if n > cursor:
+        blob = _json.dumps(GLOBAL_DICT._strings[cursor:n]).encode()
+        objects.upload(f"{_DICT_LOG_PREFIX}{cursor:012d}-{n:012d}", blob)
+        cursor = n
+    return cursor
+
+
+def load_dict_log(objects) -> int:
+    """Replay the delta log into GLOBAL_DICT; returns the restored
+    length. Tolerates overlapping ranges (re-persisted prefixes) but
+    REQUIRES content agreement — a mismatch means two incompatible
+    dictionaries and must fail loudly, not decode garbage."""
+    import json as _json
+    paths = sorted(objects.list(_DICT_LOG_PREFIX))
+    covered = 0      # ids the LOG covers — pre-existing in-process
+    #                  strings beyond it still need a first delta
+    for p in paths:
+        name = p[len(_DICT_LOG_PREFIX):] if p.startswith(_DICT_LOG_PREFIX) \
+            else p.rsplit("/", 1)[-1]
+        start = int(name.split("-")[0])
+        covered = max(covered, int(name.split("-")[1]))
+        strings = _json.loads(objects.read(p))
+        have = len(GLOBAL_DICT)
+        if start > have:
+            raise RuntimeError(
+                f"dict log gap: segment starts at {start}, have {have}")
+        for k, s in enumerate(strings):
+            i = start + k
+            if i < have:
+                if GLOBAL_DICT._strings[i] != s:
+                    raise RuntimeError(
+                        f"dict log mismatch at id {i}: "
+                        f"{GLOBAL_DICT._strings[i]!r} != {s!r}")
+            else:
+                got = GLOBAL_DICT.get_or_insert(s)
+                assert got == i, f"dict id drift: {got} != {i}"
+                have = got + 1
+    return covered

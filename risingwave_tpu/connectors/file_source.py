@@ -1,0 +1,147 @@
+"""File-tailing JSONL source — the external-connector path.
+
+Reference: src/connector/src/source/kafka/source/reader.rs:40-50 (a
+SplitReader pulling an append-only partition from a committed offset)
++ parser/json_parser.rs (JSON bytes -> typed rows). The faithful local
+stand-in for a Kafka partition is an append-only JSONL file: a split is
+one file, the offset is the LINE number, the reader tails the file and
+re-seeks on recovery, and writers append whole lines (a partial last
+line — a write caught mid-append — is left for the next poll, the same
+way a partial Kafka record never surfaces).
+
+Unlike the deterministic generators, this source has an OPEN string
+vocabulary: VARCHAR cells dict-encode through GLOBAL_DICT at parse
+time, which is exactly what forces the dictionary to be part of the
+checkpoint (common/types.py persist_dict_delta / load_dict_log —
+recovery must restore id->string before any MV row can decode).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..common.chunk import StreamChunk
+from ..common.types import DataType, GLOBAL_DICT, Schema
+
+
+def parse_columns(spec: str) -> Schema:
+    """'name type, name type, ...' -> Schema (the CREATE SOURCE
+    `columns` option; external files carry no schema of their own)."""
+    from ..common.types import Field
+    fields = []
+    for part in spec.split(","):
+        nm, _, ty = part.strip().partition(" ")
+        if not nm or not ty:
+            raise ValueError(
+                f"columns entry {part.strip()!r} is not 'name type'")
+        try:
+            dt = DataType(ty.strip().lower())
+        except ValueError:
+            raise ValueError(f"unknown column type {ty.strip()!r}")
+        fields.append(Field(nm.strip(), dt))
+    return Schema(tuple(fields))
+
+
+class JsonlFileConnector:
+    """Connector protocol (stream/source.py): next_chunk / seek / offset.
+
+    `offset` is the number of CONSUMED lines; `exhausted` flips whenever
+    the tail is reached and clears when the file grows (the source
+    executor re-checks it at every barrier, so appended data is picked
+    up at barrier cadence without busy-spinning)."""
+
+    def __init__(self, path: str, schema: Schema, chunk_size: int = 256):
+        self.path = path
+        self.schema = schema
+        self.chunk_size = chunk_size
+        self.offset = 0
+        self._byte_pos = 0
+        self._last_rows = 0
+
+    @property
+    def last_chunk_rows(self) -> int:
+        return self._last_rows
+
+    @property
+    def exhausted(self) -> bool:
+        try:
+            return os.path.getsize(self.path) <= self._byte_pos
+        except OSError:
+            return True
+
+    def seek(self, offset: int) -> None:
+        """Re-position to line `offset` by scanning from the start
+        (recovery-time only; the steady state never seeks)."""
+        self.offset = 0
+        self._byte_pos = 0
+        if offset <= 0:
+            return
+        with open(self.path, "rb") as f:
+            for _ in range(offset):
+                line = f.readline()
+                if not line or not line.endswith(b"\n"):
+                    break
+                self.offset += 1
+                self._byte_pos += len(line)
+
+    def _read_lines(self) -> list[bytes]:
+        out = []
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self._byte_pos)
+                while len(out) < self.chunk_size:
+                    line = f.readline()
+                    if not line or not line.endswith(b"\n"):
+                        break   # EOF or partial append: retry next poll
+                    out.append(line)
+                    self._byte_pos += len(line)
+        except OSError:
+            pass
+        return out
+
+    def next_chunk(self) -> StreamChunk:
+        lines = self._read_lines()
+        n = len(lines)
+        self.offset += n
+        self._last_rows = n
+        cols: list[np.ndarray] = []
+        valids: list[Optional[np.ndarray]] = []
+        rows = []
+        for ln in lines:
+            try:
+                obj = json.loads(ln)
+                if not isinstance(obj, dict):
+                    obj = None
+            except ValueError:
+                obj = None   # malformed line -> all-NULL row (the
+                #              reference's json parser skips bad records;
+                #              a NULL row keeps offsets line-aligned)
+            rows.append(obj)
+        for f in self.schema:
+            vals = np.zeros(n, dtype=f.data_type.np_dtype)
+            valid = np.zeros(n, dtype=bool)
+            for i, obj in enumerate(rows):
+                v = None if obj is None else obj.get(f.name)
+                if v is None:
+                    continue
+                try:
+                    if f.data_type is DataType.VARCHAR:
+                        vals[i] = GLOBAL_DICT.get_or_insert(str(v))
+                    elif f.data_type in (DataType.FLOAT32,
+                                         DataType.FLOAT64):
+                        vals[i] = float(v)
+                    elif f.data_type is DataType.BOOLEAN:
+                        vals[i] = bool(v)
+                    else:
+                        vals[i] = int(v)
+                    valid[i] = True
+                except (TypeError, ValueError, OverflowError):
+                    continue   # type-mismatched cell -> NULL
+            cols.append(vals)
+            valids.append(valid)
+        return StreamChunk.from_numpy(
+            self.schema, cols, capacity=self.chunk_size, valids=valids)

@@ -103,6 +103,8 @@ class FuncCall(Expr):
 def _lit(v) -> Expr:
     if isinstance(v, Expr):
         return v
+    if v is None:
+        return Literal(None, DataType.INT64)   # typeless SQL NULL
     if isinstance(v, bool):
         return Literal(v, DataType.BOOLEAN)
     if isinstance(v, int):

@@ -197,7 +197,7 @@ class StreamPlanner:
                 "no_op", {}, inputs=(Exchange(sfid),)),
                 dispatch="broadcast"))
             wm = frozenset()
-            wmcol = _NEXMARK_WM_COL.get(src.options["table"])
+            wmcol = _NEXMARK_WM_COL.get(src.options.get("table"))
             if src.options.get("emit_watermarks") and wmcol is not None:
                 wm = frozenset({wmcol})
             pk_opt = src.options.get("primary_key")
@@ -376,6 +376,8 @@ class StreamPlanner:
                     match_factor=mf, match_factors=(mf_l, mf_r),
                     append_only=(li.append_only, ri.append_only),
                     clean_specs=(clean_l, clean_r),
+                    mesh_devices=self.cfg(
+                        "streaming_parallelism_devices", 1),
                     watchdog_interval=wd,
                     durable=self.durable()),
                     inputs=(Exchange(lf), Exchange(rf)))
@@ -1426,6 +1428,10 @@ class StreamPlanner:
         if keys:
             frag.dispatch = "hash"
             frag.dist_key_indices = tuple(range(len(keys)))
+            # mesh mode: ONE actor whose state shards over an N-device
+            # jax Mesh inside the executor (the dispatcher+merge pair
+            # collapses into the jitted step; SURVEY §2.3)
+            md = self.cfg("streaming_parallelism_devices", 1)
             agg = self.graph.add(Fragment(self.fid(), Node(
                 "hash_agg", dict(
                     group_key_indices=list(range(len(keys))),
@@ -1433,11 +1439,12 @@ class StreamPlanner:
                     capacity=self.cfg("streaming_agg_capacity", 1 << 16),
                     cleaning_watermark_col=(wm_keys[0] if wm_keys
                                             else None),
+                    mesh_devices=md,
                     watchdog_interval=wd),
                 inputs=(Exchange(fid),)),
                 dispatch="hash",
                 dist_key_indices=tuple(range(len(keys))),
-                parallelism=self.parallelism))
+                parallelism=(1 if md > 1 else self.parallelism)))
         else:
             # global aggregation: a singleton SimpleAgg fragment
             # (reference: DistId::Singleton, simple_agg.rs)

@@ -8,13 +8,19 @@ needs for the simple-query flow —
   SSLRequest            -> 'N' (no TLS)
   StartupMessage        -> AuthenticationOk, ParameterStatus*,
                            BackendKeyData, ReadyForQuery
-  Query ('Q')           -> RowDescription + DataRow* + CommandComplete
-                           (SELECT) or CommandComplete (DDL) or
-                           ErrorResponse, then ReadyForQuery
+  Query ('Q')           -> per ';'-separated statement: RowDescription +
+                           DataRow* + CommandComplete (SELECT) or
+                           CommandComplete (DDL) or ErrorResponse; ONE
+                           ReadyForQuery at the end
   Terminate ('X')       -> close
 
-Extended protocol (Parse/Bind/Execute) is answered with ErrorResponse so
-drivers fall back to simple queries where possible. All values transfer
+Extended protocol (pg_protocol.rs:394-412): Parse/Bind/Describe/
+Execute/Close/Flush/Sync with named or unnamed statements/portals and
+TEXT-format parameters ($1..$n substituted at bind). Describe(portal)
+of a SELECT runs the batch query and caches the rows for Execute (the
+libpq PQexecParams flow: Parse, Bind, Describe, Execute, Sync). After
+an error, messages are skipped until Sync (the protocol's error
+recovery rule). Binary format codes are refused. All values transfer
 in text format (format code 0), NULL as the -1 length sentinel.
 
 The server shares the Session's asyncio loop: DDL statements await
@@ -80,24 +86,60 @@ class PgServer:
     # ------------------------------------------------------- connection
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        # per-connection extended-protocol state
+        stmts: dict[str, str] = {}       # name -> sql text
+        portals: dict[str, dict] = {}    # name -> {sql, cached}
+        skip_to_sync = False
         try:
             if not await self._startup(reader, writer):
                 return
             while True:
                 hdr = await reader.readexactly(5)
                 tag, ln = hdr[:1], struct.unpack("!i", hdr[1:])[0]
+                if ln < 4 or ln > (1 << 26):
+                    return               # malformed frame: close cleanly
                 payload = await reader.readexactly(ln - 4)
                 if tag == b"X":
                     return
-                if tag == b"Q":
-                    sql_text = payload.rstrip(b"\x00").decode()
-                    await self._simple_query(writer, sql_text)
-                else:
-                    # extended protocol / unknown: error + ready
-                    self._error(writer, "0A000",
-                                f"unsupported message {tag!r} (simple "
-                                f"query protocol only)")
-                    self._ready(writer)
+                if skip_to_sync and tag != b"S":
+                    # protocol error recovery: discard until Sync
+                    continue
+                try:
+                    if tag == b"Q":
+                        sql_text = payload.rstrip(b"\x00").decode()
+                        await self._simple_query(writer, sql_text)
+                    elif tag == b"P":
+                        self._parse_msg(writer, payload, stmts)
+                    elif tag == b"B":
+                        self._bind_msg(writer, payload, stmts, portals)
+                    elif tag == b"D":
+                        await self._describe_msg(writer, payload, stmts,
+                                                 portals)
+                    elif tag == b"E":
+                        await self._execute_msg(writer, payload, portals)
+                    elif tag == b"C":
+                        kind = payload[:1]
+                        name = payload[1:].split(b"\x00")[0].decode()
+                        (stmts if kind == b"S" else portals).pop(
+                            name, None)
+                        writer.write(_msg(b"3", b""))   # CloseComplete
+                    elif tag == b"H":                    # Flush
+                        pass
+                    elif tag == b"S":                    # Sync
+                        skip_to_sync = False
+                        self._ready(writer)
+                    else:
+                        self._error(writer, "0A000",
+                                    f"unsupported message {tag!r}")
+                        skip_to_sync = True
+                except _PgUserError as e:
+                    self._error(writer, e.code, str(e))
+                    skip_to_sync = True
+                except (ValueError, struct.error, IndexError,
+                        UnicodeDecodeError) as e:
+                    self._error(writer, "08P01",
+                                f"malformed message: {e}")
+                    skip_to_sync = True
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
@@ -139,29 +181,141 @@ class PgServer:
 
     # ------------------------------------------------------ simple query
     async def _simple_query(self, writer, sql_text: str) -> None:
-        sql_text = sql_text.strip()
-        if not sql_text or sql_text == ";":
+        parts = [p for p in _split_statements(sql_text) if p.strip()]
+        if not parts:
             writer.write(_msg(b"I", b""))     # EmptyQueryResponse
             self._ready(writer)
             return
+        for part in parts:
+            try:
+                stmt = ast.parse(part)
+                if isinstance(stmt, ast.Select):
+                    from .batch import run_batch_select_full
+                    names, types, rows = run_batch_select_full(
+                        self.session.catalog, stmt)
+                    self._row_description(writer, names, types)
+                    for row in rows:
+                        self._data_row(writer, row)
+                    writer.write(_msg(b"C", _cstr(f"SELECT {len(rows)}")))
+                else:
+                    await self.session.execute(part)
+                    writer.write(_msg(b"C", _cstr(_tag_of(stmt))))
+            except (BindError, SqlError) as e:
+                self._error(writer, "42601", str(e))
+                break     # v3: a failing statement aborts the rest
+            except Exception as e:  # noqa: BLE001 — surface, don't kill
+                self._error(writer, "XX000", f"{type(e).__name__}: {e}")
+                break
+        self._ready(writer)
+
+    # -------------------------------------------------- extended protocol
+    def _parse_msg(self, writer, payload: bytes, stmts: dict) -> None:
+        name, rest = payload.split(b"\x00", 1)
+        sql_text, rest = rest.split(b"\x00", 1)
+        noids = struct.unpack_from("!h", rest, 0)[0] if len(rest) >= 2 \
+            else 0
+        oids = struct.unpack_from(f"!{noids}i", rest, 2) if noids else ()
+        stmts[name.decode()] = (sql_text.decode(), tuple(oids))
+        writer.write(_msg(b"1", b""))         # ParseComplete
+
+    def _bind_msg(self, writer, payload: bytes, stmts: dict,
+                  portals: dict) -> None:
+        portal, rest = payload.split(b"\x00", 1)
+        stmt_name, rest = rest.split(b"\x00", 1)
+        if stmt_name.decode() not in stmts:
+            raise _PgUserError(
+                "26000", f"unknown statement {stmt_name.decode()!r}")
+        off = 0
+        nfmt = struct.unpack_from("!h", rest, off)[0]
+        off += 2
+        fmts = struct.unpack_from(f"!{nfmt}h", rest, off)
+        off += 2 * nfmt
+        if any(f == 1 for f in fmts):
+            raise _PgUserError("0A000", "binary parameters unsupported")
+        nparams = struct.unpack_from("!h", rest, off)[0]
+        off += 2
+        params: list[Optional[str]] = []
+        for _ in range(nparams):
+            plen = struct.unpack_from("!i", rest, off)[0]
+            off += 4
+            if plen == -1:
+                params.append(None)
+            else:
+                params.append(rest[off:off + plen].decode())
+                off += plen
+        # result-format codes: text only (a silently-ignored binary
+        # request would make the client decode ASCII as binary)
+        nrfmt = struct.unpack_from("!h", rest, off)[0]
+        off += 2
+        rfmts = struct.unpack_from(f"!{nrfmt}h", rest, off)
+        if any(f == 1 for f in rfmts):
+            raise _PgUserError("0A000", "binary result format unsupported")
+        sql_text, oids = stmts[stmt_name.decode()]
+        sql_text = _substitute_params(sql_text, params, oids)
+        portals[portal.decode()] = {"sql": sql_text, "cached": None}
+        writer.write(_msg(b"2", b""))         # BindComplete
+
+    async def _describe_msg(self, writer, payload: bytes, stmts: dict,
+                            portals: dict) -> None:
+        kind, name = payload[:1], payload[1:].split(b"\x00")[0].decode()
+        if kind == b"S":
+            if name not in stmts:
+                raise _PgUserError("26000", f"unknown statement {name!r}")
+            n = _count_params(stmts[name][0])
+            writer.write(_msg(b"t", struct.pack("!h", n)
+                              + b"".join(struct.pack("!i", 25)
+                                         for _ in range(n))))
+            writer.write(_msg(b"n", b""))     # NoData (rows described
+            #                                    at the portal level)
+            return
+        if name not in portals:
+            raise _PgUserError("34000", f"unknown portal {name!r}")
+        p = portals[name]
         try:
-            stmt = ast.parse(sql_text)
-            if isinstance(stmt, ast.Select):
-                from .batch import run_batch_select_full
+            stmt = ast.parse(p["sql"])
+        except (BindError, SqlError) as e:
+            raise _PgUserError("42601", str(e))
+        if isinstance(stmt, ast.Select):
+            from .batch import run_batch_select_full
+            try:
                 names, types, rows = run_batch_select_full(
                     self.session.catalog, stmt)
-                self._row_description(writer, names, types)
-                for row in rows:
-                    self._data_row(writer, row)
-                writer.write(_msg(b"C", _cstr(f"SELECT {len(rows)}")))
-            else:
-                await self.session.execute(sql_text)
-                writer.write(_msg(b"C", _cstr(_tag_of(stmt))))
+            except (BindError, SqlError) as e:
+                raise _PgUserError("42601", str(e))
+            p["cached"] = (names, types, rows)
+            self._row_description(writer, names, types)
+        else:
+            writer.write(_msg(b"n", b""))     # NoData
+
+    async def _execute_msg(self, writer, payload: bytes,
+                           portals: dict) -> None:
+        name = payload.split(b"\x00")[0].decode()
+        if name not in portals:
+            raise _PgUserError("34000", f"unknown portal {name!r}")
+        p = portals[name]
+        try:
+            stmt = ast.parse(p["sql"])
         except (BindError, SqlError) as e:
-            self._error(writer, "42601", str(e))
-        except Exception as e:  # noqa: BLE001 — surface, don't kill conn
-            self._error(writer, "XX000", f"{type(e).__name__}: {e}")
-        self._ready(writer)
+            raise _PgUserError("42601", str(e))
+        if isinstance(stmt, ast.Select):
+            if p["cached"] is None:
+                from .batch import run_batch_select_full
+                try:
+                    p["cached"] = run_batch_select_full(
+                        self.session.catalog, stmt)
+                except (BindError, SqlError) as e:
+                    raise _PgUserError("42601", str(e))
+            _, _, rows = p["cached"]
+            p["cached"] = None       # a re-Execute re-runs the query
+            for row in rows:
+                self._data_row(writer, row)
+            writer.write(_msg(b"C", _cstr(f"SELECT {len(rows)}")))
+        else:
+            try:
+                await self.session.execute(p["sql"])
+            except (BindError, SqlError) as e:
+                raise _PgUserError("42601", str(e))
+            writer.write(_msg(b"C", _cstr(_tag_of(stmt))))
 
     def _row_description(self, writer, names, types) -> None:
         body = struct.pack("!h", len(names))
@@ -182,6 +336,97 @@ class PgServer:
                     else str(v).encode()
                 body += struct.pack("!i", len(s)) + s
         writer.write(_msg(b"D", body))
+
+
+class _PgUserError(Exception):
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+def _split_statements(text: str) -> list[str]:
+    """Split on top-level ';' (quotes respected) — one 'Q' frame may
+    carry several statements (psql -c 'a; b')."""
+    out, cur, in_q = [], [], False
+    for ch in text:
+        if ch == "'":
+            in_q = not in_q
+            cur.append(ch)
+        elif ch == ";" and not in_q:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    out.append("".join(cur))
+    return out
+
+
+import re
+
+_NUMERIC = re.compile(r"-?\d+(\.\d+)?\Z")
+
+
+def _param_spans(sql_text: str):
+    """(start, end, index) for every $n OUTSIDE string literals."""
+    out, i, n, in_q = [], 0, len(sql_text), False
+    while i < n:
+        ch = sql_text[i]
+        if ch == "'":
+            in_q = not in_q
+        elif ch == "$" and not in_q:
+            j = i + 1
+            while j < n and sql_text[j].isdigit():
+                j += 1
+            if j > i + 1:
+                out.append((i, j, int(sql_text[i + 1:j])))
+                i = j
+                continue
+        i += 1
+    return out
+
+
+def _count_params(sql_text: str) -> int:
+    ids = [k for _, _, k in _param_spans(sql_text)]
+    return max(ids) if ids else 0
+
+
+_TEXT_OIDS = {25, 1043, 1042, 18, 19}     # text, varchar, bpchar, ...
+_NUM_OIDS = {20, 21, 23, 26, 700, 701, 1700}
+
+
+def _substitute_params(sql_text: str, params: list, oids=()) -> str:
+    """$n -> SQL literal (text-format params). A Parse-declared text
+    OID always quotes; a numeric OID inlines bare; with no declared
+    type, only strict SQL numerics inline (Python's int()/float()
+    accept '1_0', 'inf', '1e5', which the SQL lexer does not) and
+    everything else quotes with '' escaping. $n inside string literals
+    is left alone."""
+
+    def lit(i: int, v) -> str:
+        if v is None:
+            return "NULL"
+        oid = oids[i] if i < len(oids) else 0
+        if oid in _TEXT_OIDS:
+            return "'" + v.replace("'", "''") + "'"
+        if oid in _NUM_OIDS or _NUMERIC.match(v):
+            if not _NUMERIC.match(v):
+                raise _PgUserError(
+                    "22P02", f"invalid numeric parameter ${i + 1}: {v!r}")
+            return v
+        return "'" + v.replace("'", "''") + "'"
+
+    out, last = [], 0
+    for start, end, k in _param_spans(sql_text):
+        i = k - 1
+        if i < 0 or i >= len(params):
+            raise _PgUserError(
+                "08P01", f"parameter ${k} not bound "
+                f"({len(params)} supplied)")
+        out.append(sql_text[last:start])
+        out.append(lit(i, params[i]))
+        last = end
+    out.append(sql_text[last:])
+    return "".join(out)
 
 
 def _tag_of(stmt) -> str:

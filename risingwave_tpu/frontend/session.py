@@ -102,6 +102,12 @@ class Session:
         "streaming_agg_capacity": (1 << 16, int),
         "streaming_watchdog": (1, int),      # 0 disables d2h error fetches
         "streaming_parallelism": (1, int),
+        # >1 deploys hash-distributed agg/join fragments as SINGLE
+        # actors whose state is sharded over an N-device jax Mesh on the
+        # vnode axis (stream/sharded_agg.py, sharded_join.py) — the TPU
+        # analogue of the reference's parallel-unit placement
+        # (meta/src/stream/stream_graph/schedule.rs)
+        "streaming_parallelism_devices": (1, int),
         "streaming_over_window_capacity": (1 << 14, int),
         "streaming_dynamic_filter_capacity": (1 << 14, int),
         # 0 disables the snapshot join-agg fusion (binder.py
@@ -117,7 +123,16 @@ class Session:
     def __init__(self, store=None):
         self.store = store if store is not None else MemoryStateStore()
         self.catalog = Catalog()
+        # restore the string dictionary BEFORE anything can mint ids
+        # (bind-time literals, parsers): MV state on this store holds
+        # dict ids from the previous incarnation (common/types.py)
+        objects = getattr(self.store, "objects", None)
+        dict_restored = 0
+        if objects is not None:
+            from ..common.types import load_dict_log
+            dict_restored = load_dict_log(objects)
         self.coord = BarrierCoordinator(self.store)
+        self.coord.dict_cursor = dict_restored
         self.env = BuildEnv(self.store, self.coord)
         self.env.session = self
         self.config = {k: v for k, (v, _) in self.CONFIG_VARS.items()}
@@ -270,6 +285,37 @@ class Session:
     def _create_source(self, stmt: ast.CreateSource) -> SourceDef:
         opts = dict(stmt.options)
         connector = opts.pop("connector", "nexmark")
+        if connector == "jsonl":
+            # external file-tailing source (connectors/file_source.py):
+            # a split = one append-only JSONL file, offset = line number
+            from ..connectors.file_source import parse_columns
+            path = opts.pop("path", None)
+            colspec = opts.pop("columns", None)
+            if not path or not colspec:
+                raise BindError(
+                    "jsonl connector needs path=... and "
+                    "columns='name type, ...'")
+            try:
+                schema = parse_columns(colspec)
+            except ValueError as e:
+                raise BindError(str(e))
+            args = {"connector": "jsonl", "path": path,
+                    "columns": colspec,
+                    "chunk_size": int(opts.pop("chunk_size", 256))}
+            if "rate_limit" in opts:
+                args["rate_limit"] = int(opts.pop("rate_limit"))
+            if "primary_key" in opts:
+                pk_name = opts.pop("primary_key")
+                if pk_name not in schema.names:
+                    raise BindError(
+                        f"primary_key {pk_name!r} not a column")
+                args["primary_key"] = list(schema.names).index(pk_name)
+            if opts:
+                raise BindError(
+                    f"unknown jsonl options {sorted(opts)}")
+            src = SourceDef(stmt.name, schema, args)
+            self.catalog.sources[stmt.name] = src
+            return src
         if connector == "tpch":
             from ..connectors.tpch import TPCH_SCHEMAS
             schemas = TPCH_SCHEMAS
@@ -464,8 +510,11 @@ class Session:
         if reset is not None:
             reset()
         # fresh coordinator: epochs re-floor at the committed epoch, no
-        # stale in-flight state
+        # stale in-flight state (the dict-delta cursor carries over — the
+        # dictionary itself survives in-process recovery)
+        old_cursor = self.coord.dict_cursor
         self.coord = BarrierCoordinator(self.store)
+        self.coord.dict_cursor = old_cursor
         self.env = BuildEnv(self.store, self.coord)
         self.env.session = self
         self.catalog.mvs.clear()

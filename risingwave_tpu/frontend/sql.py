@@ -490,6 +490,8 @@ class Parser:
 
     def _primary(self):
         t = self.next()
+        if t.kind == "ident" and t.val.lower() == "null":
+            return Lit(None)
         if t.kind == "num":
             return Lit(float(t.val) if "." in t.val else int(t.val))
         if t.kind == "str":

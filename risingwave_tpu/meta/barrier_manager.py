@@ -53,6 +53,12 @@ class BarrierCoordinator:
         # with the background ticker on the same coordinator, breaking the
         # in-order epoch completion contract (ADVICE r2 #1).
         self._rounds_lock = asyncio.Lock()
+        # open-vocabulary dict durability (common/types.py): strings
+        # minted below this cursor are already in the durable delta log.
+        # Seeded to the CURRENT dict length when the store was restored
+        # from a log (Session sets dict_cursor); 0 on a fresh store so
+        # the first checkpoint persists everything minted so far.
+        self.dict_cursor = 0
         # headline health metric (reference meta_barrier_latency,
         # grafana/risingwave-dev-dashboard.dashboard.py:894)
         from ..utils.metrics import GLOBAL_METRICS
@@ -114,6 +120,16 @@ class BarrierCoordinator:
                 f"checkpoint") from exc
         # complete IN ORDER (reference mod.rs:779): this epoch seals epoch.prev
         if barrier.kind is BarrierKind.CHECKPOINT and barrier.epoch.prev != INVALID_EPOCH:
+            # dict deltas BEFORE the manifest commit: state committed in
+            # this epoch may reference freshly-minted string ids, which
+            # must be durable no later than the rows that carry them (an
+            # orphan dict suffix after a crash is harmless — append-only,
+            # stable ids)
+            objects = getattr(self.store, "objects", None)
+            if objects is not None:
+                from ..common.types import persist_dict_delta
+                self.dict_cursor = persist_dict_delta(
+                    objects, self.dict_cursor)
             self.store.sync(barrier.epoch.prev)
             self.committed_epochs.append(barrier.epoch.prev)
         lat_ns = time.monotonic_ns() - barrier.inject_time_ns

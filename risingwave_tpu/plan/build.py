@@ -252,6 +252,12 @@ def _build_source(args, inputs, ctx: ActorCtx, key):
     from ..connectors.split import BlockSplitConnector
 
     def make_gen():
+        if args.get("connector") == "jsonl":
+            from ..connectors.file_source import (JsonlFileConnector,
+                                                  parse_columns)
+            return JsonlFileConnector(
+                args["path"], parse_columns(args["columns"]),
+                chunk_size=args.get("chunk_size", 256))
         if args.get("connector") == "tpch":
             from ..connectors.tpch import TpchGenerator
             return TpchGenerator(args["table"],
@@ -352,6 +358,18 @@ def _build_hash_agg(args, inputs, ctx: ActorCtx, key):
         tid = ctx.table_id(key)
         st = ctx.env.state_table(tid, sch, tuple(range(len(gk))),
                                  vnode_bitmap=ctx.vnode_bitmap)
+    md = args.get("mesh_devices", 1)
+    if md > 1:
+        from ..parallel.mesh import make_mesh
+        from ..stream.sharded_agg import ShardedHashAggExecutor
+        return ShardedHashAggExecutor(
+            inputs[0], args["group_key_indices"], args["agg_calls"],
+            mesh=make_mesh(md),
+            capacity=args.get("capacity", 1 << 16) // md,
+            state_table=st,
+            group_key_names=args.get("group_key_names"),
+            cleaning_watermark_col=args.get("cleaning_watermark_col"),
+            watchdog_interval=args.get("watchdog_interval", 1))
     return HashAggExecutor(
         inputs[0], args["group_key_indices"], args["agg_calls"],
         capacity=args.get("capacity", 1 << 16),
@@ -400,13 +418,21 @@ def _build_sorted_join(args, inputs, ctx: ActorCtx, key):
             tabs.append(ctx.env.state_table(
                 tid, inp.schema, pk, vnode_bitmap=ctx.vnode_bitmap))
         state_tables = tuple(tabs)
-    return SortedJoinExecutor(
-        inputs[0], inputs[1],
+    md = args.get("mesh_devices", 1)
+    cls = SortedJoinExecutor
+    extra = {}
+    if md > 1:
+        from ..parallel.mesh import make_mesh
+        from ..stream.sharded_join import ShardedSortedJoinExecutor
+        cls = ShardedSortedJoinExecutor
+        extra = dict(mesh=make_mesh(md))
+    return cls(
+        inputs[0], inputs[1], **extra,
         left_key_indices=args["left_key_indices"],
         right_key_indices=args["right_key_indices"],
         left_pk_indices=args["left_pk_indices"],
         right_pk_indices=args["right_pk_indices"],
-        capacity=args.get("capacity", 1 << 17),
+        capacity=args.get("capacity", 1 << 17) // md,
         match_factor=args.get("match_factor", 2),
         match_factors=args.get("match_factors"),
         condition=args.get("condition"),

@@ -44,6 +44,7 @@ class ShardedHashAggExecutor(HashAggExecutor):
     def __init__(self, input: Executor, group_key_indices: Sequence[int],
                  agg_calls: Sequence[AggCall], mesh: Mesh,
                  capacity: int = 1 << 14,
+                 state_table=None,
                  group_key_names: Optional[Sequence[str]] = None,
                  cleaning_watermark_col: Optional[int] = None,
                  watchdog_interval: Optional[int] = 1):
@@ -51,7 +52,7 @@ class ShardedHashAggExecutor(HashAggExecutor):
         self.n_shards = mesh.shape[VNODE_AXIS]
         self._routing = jnp.asarray(vnode_to_shard(self.n_shards))
         super().__init__(input, group_key_indices, agg_calls,
-                         capacity=capacity, state_table=None,
+                         capacity=capacity, state_table=state_table,
                          group_key_names=group_key_names,
                          cleaning_watermark_col=cleaning_watermark_col,
                          watchdog_interval=watchdog_interval)
@@ -112,6 +113,17 @@ class ShardedHashAggExecutor(HashAggExecutor):
             watchdog_sharded, in_specs=(shard, shard), out_specs=shard,
             **mesh_kw))
 
+        def persist_view_sharded(state):
+            cols, ops, vis, n_dirty = self._persist_view_impl(state)
+            return tuple(cols), ops, vis, n_dirty[None]
+
+        # the parent's eager persist view gathers on sharded arrays
+        # (XLA aborts); run it per shard instead — each shard's dirty
+        # rows compact to that shard's LOCAL prefix
+        self._persist_view_sh = jax.jit(jax.shard_map(
+            persist_view_sharded, in_specs=(shard,),
+            out_specs=(shard, shard, shard, shard), **mesh_kw))
+
         # per-shard watchdog accumulators replace the parent's scalars
         sharding = NamedSharding(mesh, P(VNODE_AXIS))
         self._overflow_dev = jax.device_put(
@@ -146,8 +158,74 @@ class ShardedHashAggExecutor(HashAggExecutor):
             self.rebuilds += 1
             self._occ_known = 0  # refreshed by the next watchdog fetch
 
+    def _persist(self, barrier) -> None:
+        """Durable flush of the SHARDED state: the per-shard persist
+        view compacts each shard's dirty rows to its LOCAL prefix; all
+        shards' prefixes ship in TWO d2h calls (counts, then one packed
+        buffer — same per-call d2h discipline as the parent's)."""
+        if self.state_table is None:
+            return
+        if self._applied_since_flush:
+            from ..utils.d2h import fetch_columns
+            cols, ops, vis, n_dirty = self._persist_view_sh(self.state)
+            nds = np.asarray(n_dirty)
+            C = self.capacity
+            arrays, shard_nd = [], []
+            for sh in range(self.n_shards):
+                nd = int(nds[sh])
+                if not nd:
+                    continue
+                lo = sh * C
+                arrays += [ops[lo:lo + nd], vis[lo:lo + nd]]
+                arrays += [c[lo:lo + nd] for c in cols]
+                shard_nd.append(nd)
+            if arrays:
+                host = fetch_columns(arrays)
+                w = 2 + len(cols)
+                for g, nd in enumerate(shard_nd):
+                    seg = host[g * w:(g + 1) * w]
+                    self.state_table.write_chunk_columns(
+                        seg[0], seg[2:], seg[1])
+        if (self.cleaning_watermark_key is not None
+                and self._pending_clean_wm is not None):
+            self._write_evict_deletes(self._pending_clean_wm)
+        self.state_table.commit(barrier.epoch.curr)
+
     def recover(self, barrier_epoch: int) -> None:
-        raise NotImplementedError("sharded agg is device-resident in v1")
+        """Rebuild SHARDED device state: rows partition by
+        vnode-of-group-key (the same routing the apply path masks by),
+        each shard's slice is built locally with the parent's machinery,
+        and the slices concatenate along the mesh axis. The durable
+        persist path is the parent's unchanged — its snapshot-diff view
+        is shape-agnostic over the global [S*C] arrays."""
+        if self.state_table is None:
+            return
+        rows = [r for _, r in self.state_table.iter_all()]
+        if not rows:
+            return
+        from ..common.vnode import compute_vnodes_numpy
+        nk = len(self.group_key_indices)
+        key_cols = [np.asarray([r[j] for r in rows], dtype=np.int64)
+                    for j in range(nk)]
+        shard_of = np.asarray(self._routing)[compute_vnodes_numpy(key_cols)]
+        by_shard = [[] for _ in range(self.n_shards)]
+        for r, sh in zip(rows, shard_of):
+            by_shard[int(sh)].append(r)
+        worst = max(len(b) for b in by_shard)
+        need = 1 << max(self.capacity.bit_length() - 1,
+                        (int(worst / 0.7)).bit_length())
+        self.capacity = max(self.capacity, need)
+        locals_ = [self._state_from_rows(b, self.capacity)
+                   for b in by_shard]
+        sharding = NamedSharding(self.mesh, P(VNODE_AXIS))
+
+        def concat(*xs):
+            if xs[0].ndim == 0:
+                return xs[0]   # replicated scalar (as in _initial_state)
+            return jax.device_put(jnp.concatenate(xs), sharding)
+
+        self.state = jax.tree_util.tree_map(concat, *locals_)
+        self._occ_known = worst
 
     def _check_watchdog(self) -> None:
         vals = np.asarray(self._watchdog_pack(self._overflow_dev,

@@ -55,7 +55,7 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
         super().__init__(left, right, **kwargs)
         shard, repl = P(VNODE_AXIS), P()
 
-        def make_apply(side):
+        def make_apply(side, mf):
             def apply_sharded(own, other, errs, chunk, wm):
                 my = jax.lax.axis_index(VNODE_AXIS)
                 key_cols = [chunk.columns[i].data
@@ -65,7 +65,8 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
                 local = StreamChunk(chunk.columns, chunk.ops, mine,
                                     chunk.schema)
                 out = self._apply_impl(_scalar_n(own), _scalar_n(other),
-                                       errs[0], local, wm, side)
+                                       errs[0], local, wm, side,
+                                       match_factor=mf)
                 own2, odeg, cols, ops, vis, errs2, _ = out
                 return (_vec_n(own2), odeg, cols, ops, vis, errs2[None],
                         own2.n.reshape((1,)))
@@ -75,20 +76,18 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
                 out_specs=(shard, shard, shard, shard, shard, shard,
                            shard)))
 
-        applies = {LEFT: make_apply(LEFT), RIGHT: make_apply(RIGHT)}
+        # sharded programs trace per (side, match_factor): the steady
+        # state uses the per-side factors, recovery's generous replay
+        # buffer gets its own trace instead of being refused
+        applies: dict = {}
 
         def apply_dispatch(own, other, errs, chunk, wm, side,
                            match_factor=None):
-            # the sharded programs are traced with the constructor's
-            # factor; a caller asking for a DIFFERENT one (recovery's
-            # generous replay buffer) must fail loudly, not silently
-            # under-buffer and corrupt degrees
-            if match_factor not in (None, self.match_factor):
-                raise NotImplementedError(
-                    "sharded sorted join cannot override match_factor "
-                    f"per call (asked {match_factor}, traced "
-                    f"{self.match_factor})")
-            return applies[side](own, other, errs, chunk, wm)
+            mf = match_factor or self.match_factors[side]
+            key = (side, mf)
+            if key not in applies:
+                applies[key] = make_apply(side, mf)
+            return applies[key](own, other, errs, chunk, wm)
         self._apply = apply_dispatch
 
         def make_evict(side):
@@ -130,6 +129,91 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
         # replaced by _sharded_empty right after
         return _empty_sorted_side(self.capacity[side],
                                   self._col_dtypes[side])
+
+    # ------------------------------------------------------- durability
+    def _shard_slice(self, st: SortedSideState, sh: int,
+                     side: int) -> SortedSideState:
+        """Shard sh's LOCAL view of a global [S*C] side state."""
+        C = self.capacity[side]
+        lo = sh * C
+        return SortedSideState(
+            st.khash[lo:lo + C],
+            tuple(c[lo:lo + C] for c in st.cols),
+            tuple(v[lo:lo + C] for v in st.valids),
+            st.degree[lo:lo + C],
+            st.n[sh].reshape(()))
+
+    def _persist(self, barrier) -> None:
+        """Durable flush of the sharded sides: per-shard snapshot diffs
+        (each shard's slice is a valid local sorted state, the parent's
+        diff program is shape-local), with ALL shards'/sides' payloads
+        shipped in TWO d2h calls — one counts fetch, one packed buffer
+        (the per-call fetch tax would otherwise multiply by 2·S·sides)."""
+        from ..common.chunk import OP_DELETE, OP_INSERT
+        from ..utils.d2h import fetch_columns
+        pending = []     # (side, table, [per-shard diff tuples])
+        for s in (LEFT, RIGHT):
+            st = self.state_tables[s]
+            if st is None:
+                continue
+            if self._flush_dirty[s]:
+                diffs = [self._diff(
+                    self._shard_slice(self.sides[s], sh, s),
+                    self._shard_slice(self._snap[s], sh, s))
+                    for sh in range(self.n_shards)]
+                pending.append((s, st, diffs))
+                self._snap[s] = self.sides[s]
+                self._flush_dirty[s] = False
+        if pending:
+            counts = np.asarray(jnp.stack(
+                [x for _, _, diffs in pending
+                 for d in diffs for x in (d[1], d[3])]))
+            arrays, ci = [], 0
+            for _, _, diffs in pending:
+                for d in diffs:
+                    nd, ni = int(counts[ci]), int(counts[ci + 1])
+                    ci += 2
+                    arrays += [c[:nd] for c in d[0]]
+                    arrays += [c[:ni] for c in d[2]]
+            host = fetch_columns(arrays)
+            k = ci = 0
+            for _, st, diffs in pending:
+                for d in diffs:
+                    nd, ni = int(counts[ci]), int(counts[ci + 1])
+                    ci += 2
+                    del_cols = host[k:k + len(d[0])]
+                    k += len(d[0])
+                    ins_cols = host[k:k + len(d[2])]
+                    k += len(d[2])
+                    if nd:
+                        st.write_chunk_columns(
+                            np.full(nd, OP_DELETE, dtype=np.int8),
+                            del_cols, np.ones(nd, dtype=bool))
+                    if ni:
+                        st.write_chunk_columns(
+                            np.full(ni, OP_INSERT, dtype=np.int8),
+                            ins_cols, np.ones(ni, dtype=bool))
+        for s in (LEFT, RIGHT):
+            st = self.state_tables[s]
+            if st is not None:
+                st.commit(barrier.epoch.curr)
+
+    def _recover_reset(self, s: int, rows: list) -> None:
+        """Per-shard capacity is sized by the WORST shard's row count
+        (rows route by vnode-of-key, same as the apply-path masking)."""
+        if rows:
+            keys = [np.asarray([r[k] for r in rows], dtype=np.int64)
+                    for k in self.key_indices[s]]
+            from ..common.vnode import compute_vnodes_numpy
+            shard_of = np.asarray(self._routing)[
+                compute_vnodes_numpy(keys)]
+            worst = int(np.bincount(
+                shard_of, minlength=self.n_shards).max())
+        else:
+            worst = 0
+        while worst > 0.7 * self.capacity[s]:
+            self.capacity[s] *= 2
+        self.sides[s] = self._sharded_empty(s)
 
     # --------------------------------------------------------- watchdog
     def _check_watchdog(self) -> None:

@@ -1,0 +1,154 @@
+"""External file-tailing source + JSON parser + dict durability
+(VERDICT r4 #5): a live-appended JSONL file behind a CREATE SOURCE,
+exactly-once offsets across crash recovery, and the GLOBAL_DICT delta
+log that lets open-vocabulary VARCHAR state decode after a restart.
+
+Reference: connector/src/source/kafka/source/reader.rs:40-50,
+parser/json_parser.rs.
+"""
+
+import asyncio
+import json
+from collections import Counter
+
+from risingwave_tpu.common import types as T
+from risingwave_tpu.frontend import Session
+
+COLS = "name varchar, score int64, weight float64"
+
+
+def _write(path, rows, mode="a"):
+    with open(path, mode) as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def _rows(i0, n, vocab=("ada", "grace", "edsger", "barbara", "alan")):
+    return [{"name": vocab[i % len(vocab)] + str(i % 7),
+             "score": i * 3, "weight": i / 2} for i in range(i0, i0 + n)]
+
+
+async def test_jsonl_source_live_append(tmp_path):
+    p = str(tmp_path / "events.jsonl")
+    _write(p, _rows(0, 100), mode="w")
+    s = Session()
+    await s.execute(
+        f"CREATE SOURCE ev WITH (connector='jsonl', path='{p}', "
+        f"columns='{COLS}', chunk_size=64)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW m AS SELECT name, score, weight FROM ev")
+    await s.tick(3)
+    got = Counter(s.query("SELECT name, score, weight FROM m"))
+    exp = Counter((r["name"], r["score"], r["weight"])
+                  for r in _rows(0, 100))
+    assert got == exp
+    # live append: new rows (and NEW dictionary strings) arrive at
+    # barrier cadence
+    _write(p, _rows(100, 60, vocab=("newvoice", "fresh")))
+    await s.tick(3)
+    got = Counter(s.query("SELECT name, score, weight FROM m"))
+    exp = Counter((r["name"], r["score"], r["weight"])
+                  for r in _rows(0, 100)
+                  + _rows(100, 60, vocab=("newvoice", "fresh")))
+    assert got == exp
+    await s.drop_all()
+
+
+async def test_jsonl_malformed_and_nulls(tmp_path):
+    p = str(tmp_path / "bad.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps({"name": "ok", "score": 1, "weight": 1.5}) + "\n")
+        f.write("this is not json\n")
+        f.write(json.dumps({"score": 2}) + "\n")          # missing cells
+        f.write(json.dumps({"name": "x", "score": "NaNope",
+                            "weight": 3.0}) + "\n")        # bad type
+    s = Session()
+    await s.execute(
+        f"CREATE SOURCE ev WITH (connector='jsonl', path='{p}', "
+        f"columns='{COLS}', chunk_size=16)")
+    await s.execute("CREATE MATERIALIZED VIEW m AS SELECT name, score, "
+                    "weight FROM ev")
+    await s.tick(2)
+    got = Counter(s.query("SELECT name, score, weight FROM m"))
+    exp = Counter([("ok", 1, 1.5), (None, None, None), (None, 2, None),
+                   ("x", None, 3.0)])
+    assert got == exp
+    await s.drop_all()
+
+
+async def test_jsonl_crash_recovery_exactly_once(tmp_path):
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+    p = str(tmp_path / "events.jsonl")
+    _write(p, _rows(0, 120), mode="w")
+    store = HummockStateStore(LocalFsObjectStore(str(tmp_path / "d")))
+    s = Session(store=store)
+    await s.execute(
+        f"CREATE SOURCE ev WITH (connector='jsonl', path='{p}', "
+        f"columns='{COLS}', chunk_size=32, rate_limit=32)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW m AS SELECT name, score, weight FROM ev")
+    await s.tick(2)
+    victim = s.catalog.mvs["m"].deployment.tasks[-1]
+    victim.cancel()
+    try:
+        await victim
+    except (asyncio.CancelledError, Exception):
+        pass
+    _write(p, _rows(120, 40))
+    await s.tick(8)
+    assert s.recoveries >= 1
+    got = Counter(s.query("SELECT name, score, weight FROM m"))
+    exp = Counter((r["name"], r["score"], r["weight"])
+                  for r in _rows(0, 160))
+    assert got == exp, (
+        f"loss/dup across recovery: {sum(got.values())} rows vs "
+        f"{sum(exp.values())}; diff {list((got - exp).items())[:3]} / "
+        f"{list((exp - got).items())[:3]}")
+    await s.drop_all()
+
+
+async def test_dict_survives_restart(tmp_path):
+    """Open-vocabulary strings must decode after a FULL restart: the
+    dict delta log is written with each checkpoint and replayed at
+    store-open. Simulated restart: reopen the on-disk store in a fresh
+    session with the process-global dictionary REPLACED by an empty one
+    (what a new process sees)."""
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+    p = str(tmp_path / "events.jsonl")
+    rows = _rows(0, 80, vocab=("openvocab", "external", "kafkaish"))
+    _write(p, rows, mode="w")
+    root = str(tmp_path / "d")
+    store = HummockStateStore(LocalFsObjectStore(root))
+    s = Session(store=store)
+    await s.execute(
+        f"CREATE SOURCE ev WITH (connector='jsonl', path='{p}', "
+        f"columns='{COLS}', chunk_size=32)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW m AS SELECT name, score, weight FROM ev")
+    await s.tick(3)
+    pre = Counter(s.query("SELECT name, score FROM m"))
+    assert sum(pre.values()) == 80
+    await s.coord.stop_all()
+
+    # empty the dictionary IN PLACE (modules hold direct references to
+    # the GLOBAL_DICT object; a fresh process starts with it empty)
+    saved_strings = list(T.GLOBAL_DICT._strings)
+    saved_ids = dict(T.GLOBAL_DICT._ids)
+    T.GLOBAL_DICT._strings.clear()
+    T.GLOBAL_DICT._ids.clear()
+    try:
+        store2 = HummockStateStore.open(LocalFsObjectStore(root))
+        s2 = Session(store=store2)
+        await s2.recover()
+        await s2.tick(2)
+        got = Counter(s2.query("SELECT name, score FROM m"))
+        exp = Counter((r["name"], r["score"]) for r in rows)
+        assert got == exp, (
+            "dict ids decoded wrong after restart: sample "
+            f"{list((got - exp).items())[:3]} / "
+            f"{list((exp - got).items())[:3]}")
+        await s2.drop_all()
+    finally:
+        T.GLOBAL_DICT._strings[:] = saved_strings
+        T.GLOBAL_DICT._ids.clear()
+        T.GLOBAL_DICT._ids.update(saved_ids)

@@ -1,0 +1,229 @@
+"""SQL-planned device-mesh deployment (VERDICT r4 #2): with
+SET streaming_parallelism_devices = N, hash-distributed agg/join
+fragments deploy as SINGLE actors whose state shards over an N-device
+jax Mesh on the vnode axis — and the durable path (state tables,
+crash recovery) works through the sharded executors.
+
+Reference: the parallel-unit placement of
+meta/src/stream/stream_graph/schedule.rs — here the placement axis is
+the device mesh (SURVEY §2.3 TPU-analogue column).
+"""
+
+import asyncio
+from collections import Counter
+
+import numpy as np
+
+from risingwave_tpu.frontend import Session
+from risingwave_tpu.stream.sharded_agg import ShardedHashAggExecutor
+from risingwave_tpu.stream.sharded_join import ShardedSortedJoinExecutor
+from risingwave_tpu.stream.hash_agg import HashAggExecutor
+from risingwave_tpu.stream.sorted_join import SortedJoinExecutor
+
+W = 10_000_000
+
+
+def _executors(session, mv_name, klass):
+    out = []
+    for roots in session.catalog.mvs[mv_name].deployment.roots.values():
+        for root in roots:
+            node = root
+            while node is not None:
+                if isinstance(node, klass):
+                    out.append(node)
+                node = getattr(node, "input", None)
+    return out
+
+
+AGG_SQL = ("SELECT auction, count(*) AS n, sum(price) AS sp "
+           "FROM bid GROUP BY auction")
+JOIN_SQL = (f"SELECT P.id, P.window_start "
+            f"FROM TUMBLE(person, date_time, {W}) P "
+            f"JOIN TUMBLE(auction, date_time, {W}) A "
+            f"ON P.id = A.seller AND P.window_start = A.window_start")
+
+
+async def _mk_bid(s):
+    await s.execute("CREATE SOURCE bid WITH (connector='nexmark', "
+                    "table='bid', chunk_size=256, rate_limit=512)")
+
+
+async def _mk_q8_sources(s):
+    await s.execute(
+        "CREATE SOURCE person WITH (connector='nexmark', table='person', "
+        "primary_key='id', chunk_size=128, rate_limit=256, "
+        "emit_watermarks=1)")
+    await s.execute(
+        "CREATE SOURCE auction WITH (connector='nexmark', "
+        "table='auction', primary_key='id', chunk_size=384, "
+        "rate_limit=768, emit_watermarks=1)")
+
+
+async def test_mesh_agg_planned_and_matches_unsharded():
+    s = Session()
+    await _mk_bid(s)
+    await s.execute("SET streaming_parallelism_devices = 8")
+    await s.execute(f"CREATE MATERIALIZED VIEW ma AS {AGG_SQL}")
+    assert _executors(s, "ma", ShardedHashAggExecutor), \
+        "mesh session var did not deploy a sharded agg"
+    await s.execute("SET streaming_parallelism_devices = 1")
+    await s.execute(f"CREATE MATERIALIZED VIEW ua AS {AGG_SQL}")
+    assert not _executors(s, "ua", ShardedHashAggExecutor)
+    await s.tick(3)
+    got = Counter(s.query("SELECT auction, n, sp FROM ma"))
+    # the two MVs sit at different offsets (different DDL epochs), so
+    # compare ma against a host recount at ITS committed offset
+    from risingwave_tpu.connectors import NexmarkGenerator
+    from risingwave_tpu.state.storage_table import StorageTable
+    from risingwave_tpu.stream.source import SourceExecutor
+    off = 0
+    for roots in s.catalog.mvs["ma"].deployment.roots.values():
+        for root in roots:
+            node = root
+            while node is not None:
+                if isinstance(node, SourceExecutor) \
+                        and node.state_table is not None:
+                    st = StorageTable.for_state_table(node.state_table)
+                    rows = list(st.batch_iter())
+                    off = max(off, int(rows[0][1]) if rows else 0)
+                node = getattr(node, "input", None)
+    gen = NexmarkGenerator("bid", chunk_size=max(256, off))
+    c = gen.next_chunk()
+    auction = np.asarray(c.columns[0].data)[:off]
+    price = np.asarray(c.columns[2].data)[:off]
+    exp = Counter()
+    agg: dict = {}
+    for a, p in zip(auction, price):
+        n, sp = agg.get(int(a), (0, 0))
+        agg[int(a)] = (n + 1, sp + int(p))
+    for a, (n, sp) in agg.items():
+        exp[(a, n, sp)] += 1
+    assert got == exp, (
+        f"sharded agg diverged: {len(got)} vs {len(exp)} rows; "
+        f"sample {list((got - exp).items())[:3]} / "
+        f"{list((exp - got).items())[:3]}")
+    assert off > 0 and len(exp) > 10
+    await s.drop_all()
+
+
+async def test_mesh_join_planned_and_survives_crash(tmp_path):
+    """q8 over the mesh: planned sharded join + durable state +
+    crash/recovery (the round-4 gap: sharded executors raised on
+    durability and were not plannable)."""
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+    store = HummockStateStore(LocalFsObjectStore(str(tmp_path / "d")))
+    s = Session(store=store)
+    await _mk_q8_sources(s)
+    await s.execute("SET streaming_parallelism_devices = 8")
+    await s.execute("SET streaming_join_capacity = 4096")
+    await s.execute(f"CREATE MATERIALIZED VIEW mj AS {JOIN_SQL}")
+    assert _executors(s, "mj", ShardedSortedJoinExecutor), \
+        "mesh session var did not deploy a sharded join"
+    await s.tick(3)
+    pre = Counter(s.query("SELECT id, window_start FROM mj"))
+    assert sum(pre.values()) > 0, "no matches pre-crash — test vacuous"
+
+    victim = s.catalog.mvs["mj"].deployment.tasks[-1]
+    victim.cancel()
+    try:
+        await victim
+    except (asyncio.CancelledError, Exception):
+        pass
+    await s.tick(3)
+    assert s.recoveries >= 1
+    got = Counter(s.query("SELECT id, window_start FROM mj"))
+
+    # oracle at the committed offsets
+    from risingwave_tpu.connectors import NexmarkGenerator
+    from risingwave_tpu.state.storage_table import StorageTable
+    from risingwave_tpu.stream.source import SourceExecutor
+    offs: dict = {}
+    for roots in s.catalog.mvs["mj"].deployment.roots.values():
+        for root in roots:
+            node = root
+            while node is not None:
+                if isinstance(node, SourceExecutor) \
+                        and node.state_table is not None:
+                    st = StorageTable.for_state_table(node.state_table)
+                    rows = list(st.batch_iter())
+                    offs.setdefault(node.connector.table, 0)
+                    offs[node.connector.table] = max(
+                        offs[node.connector.table],
+                        int(rows[0][1]) if rows else 0)
+                node = getattr(node, "input", None)
+
+    def prefix(table, n):
+        gen = NexmarkGenerator(table, chunk_size=max(256, n))
+        c = gen.next_chunk()
+        return [np.asarray(col.data)[:n] for col in c.columns]
+
+    p = prefix("person", offs["person"])
+    a = prefix("auction", offs["auction"])
+    persons: dict = {}
+    for pid, ts in zip(p[0], p[6]):
+        w = int(ts) - int(ts) % W
+        persons.setdefault(w, set()).add(int(pid))
+    exp = Counter()
+    for seller, ts in zip(a[7], a[5]):
+        w = int(ts) - int(ts) % W
+        if int(seller) in persons.get(w, ()):
+            exp[(int(seller), w)] += 1
+    assert got == exp, (
+        f"sharded join diverged after recovery: {sum(got.values())} vs "
+        f"{sum(exp.values())} rows; sample "
+        f"{list((got - exp).items())[:3]} / "
+        f"{list((exp - got).items())[:3]}")
+    assert sum(exp.values()) > 0
+    await s.drop_all()
+
+
+async def test_mesh_agg_durable_crash_recovery(tmp_path):
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+    store = HummockStateStore(LocalFsObjectStore(str(tmp_path / "d")))
+    s = Session(store=store)
+    await _mk_bid(s)
+    await s.execute("SET streaming_parallelism_devices = 8")
+    await s.execute(f"CREATE MATERIALIZED VIEW da AS {AGG_SQL}")
+    assert _executors(s, "da", ShardedHashAggExecutor)
+    await s.tick(3)
+    victim = s.catalog.mvs["da"].deployment.tasks[-1]
+    victim.cancel()
+    try:
+        await victim
+    except (asyncio.CancelledError, Exception):
+        pass
+    await s.tick(2)
+    assert s.recoveries >= 1
+    # post-recovery executors must STILL be sharded
+    assert _executors(s, "da", ShardedHashAggExecutor), \
+        "recovery replanned without the mesh"
+    got = Counter(s.query("SELECT auction, n, sp FROM da"))
+    from risingwave_tpu.connectors import NexmarkGenerator
+    from risingwave_tpu.state.storage_table import StorageTable
+    from risingwave_tpu.stream.source import SourceExecutor
+    off = 0
+    for roots in s.catalog.mvs["da"].deployment.roots.values():
+        for root in roots:
+            node = root
+            while node is not None:
+                if isinstance(node, SourceExecutor) \
+                        and node.state_table is not None:
+                    st = StorageTable.for_state_table(node.state_table)
+                    rows = list(st.batch_iter())
+                    off = max(off, int(rows[0][1]) if rows else 0)
+                node = getattr(node, "input", None)
+    gen = NexmarkGenerator("bid", chunk_size=max(256, off))
+    c = gen.next_chunk()
+    auction = np.asarray(c.columns[0].data)[:off]
+    price = np.asarray(c.columns[2].data)[:off]
+    agg: dict = {}
+    for a2, p2 in zip(auction, price):
+        n, sp = agg.get(int(a2), (0, 0))
+        agg[int(a2)] = (n + 1, sp + int(p2))
+    exp = Counter((a2, n, sp) for a2, (n, sp) in agg.items())
+    assert got == exp, (
+        f"sharded agg diverged after recovery; sample "
+        f"{list((got - exp).items())[:3]} / "
+        f"{list((exp - got).items())[:3]}")
+    assert off > 0
+    await s.drop_all()
