@@ -502,7 +502,7 @@ async def bench_q7d(progress: dict) -> None:
     ddl = [
         "SET streaming_durability = 1",
         "SET streaming_watchdog = 0",
-        f"SET streaming_join_capacity = {1 << 17}",
+        f"SET streaming_join_capacity = {1 << 18}",
         "SET streaming_join_match_factor = 2",
         f"SET streaming_agg_capacity = {1 << 13}",
         # smaller chunks than volatile q7: the durable programs compile

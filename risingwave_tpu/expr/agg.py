@@ -38,6 +38,9 @@ class AggKind(enum.Enum):
     SUM = "sum"
     MIN = "min"
     MAX = "max"
+    # one packed 8-byte register word of a 64-register HLL sketch
+    # (expr/hll.py) — approx_count_distinct lowers to 8 of these
+    HLL_REG = "hll_reg"
 
 
 @dataclass(frozen=True)
@@ -46,6 +49,7 @@ class AggCall:
     arg: Optional[int]          # input column index (None for count(*))
     ret_type: DataType
     append_only: bool = False   # input stream has no deletes
+    lane: int = 0               # HLL_REG word index (buckets [8L, 8L+8))
 
     def spec(self) -> "AggSpec":
         return make_spec(self)
@@ -69,6 +73,10 @@ class AggSpec:
     # seg_ids:[N] int32 segment per row; num_segments static
     def partial(self, values, signs, seg_ids, num_segments) -> jnp.ndarray:
         k = self.call.kind
+        if k is AggKind.HLL_REG:
+            from .hll import lane_partial
+            return lane_partial(values, signs, seg_ids, num_segments,
+                                self.call.lane)
         if k is AggKind.COUNT:
             return jax.ops.segment_sum(signs.astype(jnp.int64), seg_ids, num_segments)
         if k is AggKind.SUM:
@@ -84,6 +92,9 @@ class AggSpec:
 
     def combine(self, state, partial) -> jnp.ndarray:
         k = self.call.kind
+        if k is AggKind.HLL_REG:
+            from .hll import lane_combine
+            return lane_combine(state, partial)
         if k in (AggKind.COUNT, AggKind.SUM):
             return state + partial
         if k is AggKind.MIN:
@@ -98,6 +109,12 @@ class AggSpec:
 
 def make_spec(call: AggCall) -> AggSpec:
     k = call.kind
+    if k is AggKind.HLL_REG:
+        if not call.append_only:
+            raise NotImplementedError(
+                "approx_count_distinct needs an append-only input "
+                "(register max cannot retract)")
+        return AggSpec(call, jnp.int64, 0)
     if k is AggKind.COUNT:
         return AggSpec(call, jnp.int64, 0)
     if k is AggKind.SUM:

@@ -226,6 +226,16 @@ def _case(node, cols):
     return Column(out, valid)
 
 
+@register("hll_estimate")
+def _hll_estimate(node, cols):
+    from .hll import estimate_from_words_jnp
+    out = estimate_from_words_jnp([c.data for c in cols])
+    valid = cols[0].valid_mask()
+    for c in cols[1:]:
+        valid = valid & c.valid_mask()
+    return Column(out, valid)
+
+
 @register("coalesce")
 def _coalesce(node, cols):
     out = cols[-1].data.astype(node.ret_type.jnp_dtype)
@@ -315,6 +325,19 @@ def infer_ret_type(name: str, args) -> DataType:
         return DataType.INT64
     if name in _CMP_FNS or name in _BOOL_FNS:
         return DataType.BOOLEAN
+    if name in ("is_null", "is_not_null"):
+        return DataType.BOOLEAN
+    if name == "hll_estimate":
+        return DataType.INT64
+    if name == "case":
+        n = len(args)
+        vals = [args[2 * i + 1] for i in range(n // 2)]
+        if n % 2 == 1:
+            vals.append(args[-1])
+        ts = [a.ret_type for a in vals]
+        if all(t == ts[0] for t in ts):
+            return ts[0]     # _promote would degrade BOOLEAN to INT16
+        return _promote(ts)
     if name in ("tumble_start", "tumble_end") or name.startswith("date_trunc_"):
         return DataType.TIMESTAMP
     if name in _EXTRACT_FNS:

@@ -267,6 +267,8 @@ def run_batch_select_full(catalog, sel: ast.Select):
         v = out_cols[j][i].item()
         if out_types[j] is DataType.VARCHAR:
             return GLOBAL_DICT.decode(int(v))
+        if out_types[j] is DataType.BOOLEAN:
+            return bool(v)   # the row serde stores booleans as ints
         return v
 
     return out_names, out_types, [
@@ -308,11 +310,15 @@ def _run_agg(rel: _Rel, sel: ast.Select, items):
             sort_cols.append(v)
             sort_cols.append(~valid)
         order = np.lexsort(tuple(sort_cols))
-        run_start = np.ones(rel.n, dtype=bool)
+        # a new group starts where ANY key column differs from the
+        # previous sorted row (the old &= ~same demanded EVERY key
+        # change, collapsing multi-key GROUP BY into far too few groups
+        # — caught by the approx_count_distinct oracle, round 5)
+        run_start = np.zeros(rel.n, dtype=bool)
         for v, valid in zip(key_vals, key_valids):
             sv, svd = v[order], valid[order]
-            same = (sv[1:] == sv[:-1]) & (svd[1:] == svd[:-1])
-            run_start[1:] &= ~same
+            diff = (sv[1:] != sv[:-1]) | (svd[1:] != svd[:-1])
+            run_start[1:] |= diff
         run_start[0] = True
         gid_sorted = np.cumsum(run_start) - 1
         n_groups = int(gid_sorted[-1]) + 1 if rel.n else 0
@@ -331,6 +337,25 @@ def _run_agg(rel: _Rel, sel: ast.Select, items):
     def eval_agg(e):
         """-> (values [n_groups], valid) for one aggregate call."""
         assert isinstance(e, ast.Func) and e.name in AGG_FUNCS
+        if e.name in ("bool_and", "bool_or"):
+            ee = bind_scalar(e.args[0], rel.scope)
+            v, valid = eval_numpy(ee, rel.cols, rel.valids)
+            b = np.asarray(v, dtype=bool)
+            cn = np.bincount(seg_id, weights=valid.astype(np.float64),
+                             minlength=n_groups)
+            want = (valid & ~b) if e.name == "bool_and" else (valid & b)
+            cf = np.bincount(seg_id, weights=want.astype(np.float64),
+                             minlength=n_groups)
+            out = (cf == 0) if e.name == "bool_and" else (cf > 0)
+            return out, cn > 0
+        if e.name == "approx_count_distinct":
+            # same deterministic 64-register HLL as the streaming path
+            # (expr/hll.py) so the two engines agree EXACTLY
+            from ..expr.hll import hll_estimate_numpy
+            ee = bind_scalar(e.args[0], rel.scope)
+            v, valid = eval_numpy(ee, rel.cols, rel.valids)
+            return hll_estimate_numpy(
+                np.asarray(v), np.asarray(valid), seg_id, n_groups)
         if e.name == "avg":
             sv, svalid = eval_agg(ast.Func("sum", e.args))
             cv, _ = eval_agg(ast.Func("count", e.args))

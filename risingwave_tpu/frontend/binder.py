@@ -29,7 +29,8 @@ from ..expr.ir import Expr, call, col, lit
 from ..plan import Exchange, Fragment, Node, StreamGraph
 from . import sql as ast
 
-AGG_FUNCS = {"count", "sum", "min", "max", "avg"}
+AGG_FUNCS = {"count", "sum", "min", "max", "avg", "bool_and", "bool_or",
+             "approx_count_distinct"}
 
 
 class BindError(Exception):
@@ -507,7 +508,9 @@ class StreamPlanner:
         frag = self.graph.fragments[fid]
         sel = ast.Select(expand_star(sel.items, scope.schema), rel,
                          where, sel.group_by, list(sel.order_by),
-                         sel.limit, sel.offset)
+                         sel.limit, sel.offset,
+                         emit_on_close=getattr(sel, "emit_on_close",
+                                               False))
 
         jinfo = getattr(self, "_join_frags", {}).get(fid)
         if jinfo is not None and frag.root is jinfo["node"]:
@@ -1201,8 +1204,29 @@ class StreamPlanner:
         windows = []
         for j, w in enumerate(wfs):
             name = w.func.name
-            if name in ("row_number", "rank"):
+            if name in ("row_number", "rank", "dense_rank"):
                 windows.append(WindowSpec(name, name=f"w{j}"))
+            elif name in ("lag", "lead"):
+                if not w.func.args:
+                    raise BindError(f"window {name}() needs an argument")
+                ai = col_of(w.func.args[0])
+                off = 1
+                if len(w.func.args) > 1:
+                    a1 = w.func.args[1]
+                    if not (isinstance(a1, ast.Lit)
+                            and isinstance(a1.value, int)
+                            and a1.value >= 1):
+                        raise BindError(
+                            f"{name}() offset must be a positive "
+                            "integer literal")
+                    off = a1.value
+                windows.append(WindowSpec(
+                    name, arg=ai, offset=off, name=f"w{j}"))
+            elif name == "first_value":
+                if not w.func.args:
+                    raise BindError("first_value() needs an argument")
+                windows.append(WindowSpec(
+                    name, arg=col_of(w.func.args[0]), name=f"w{j}"))
             elif name in ("sum", "count", "avg"):
                 if not w.func.args:
                     raise BindError(f"window {name}() needs an argument")
@@ -1218,13 +1242,28 @@ class StreamPlanner:
             else:
                 raise BindError(
                     f"unsupported window function {name!r} (have: "
-                    "row_number, rank, sum, count, avg)")
+                    "row_number, rank, dense_rank, lag, lead, "
+                    "first_value, sum, count, avg)")
 
-        frag.root = Node("general_over_window", dict(
-            partition_by=partition_by, order_specs=order_specs,
-            windows=windows, pk_indices=list(sk),
-            capacity=self.cfg("streaming_over_window_capacity", 1 << 14),
-            durable=self.durable()), inputs=(frag.root,))
+        eowc = getattr(sel, "emit_on_close", False)
+        if eowc:
+            # EMIT ON WINDOW CLOSE: the leading ORDER BY column must be
+            # watermarked ascending so row finality is decidable
+            oc, odesc = order_specs[0]
+            if odesc or oc not in info.wm_cols:
+                raise BindError(
+                    "EMIT ON WINDOW CLOSE needs the leading window "
+                    "ORDER BY column ascending and watermarked")
+            if any(w.kind == "lead" for w in windows):
+                raise BindError(
+                    "EMIT ON WINDOW CLOSE cannot finalize lead()")
+        frag.root = Node(
+            "eowc_over_window" if eowc else "general_over_window", dict(
+                partition_by=partition_by, order_specs=order_specs,
+                windows=windows, pk_indices=list(sk),
+                capacity=self.cfg("streaming_over_window_capacity",
+                                  1 << 14),
+                durable=self.durable()), inputs=(frag.root,))
         in_width = len(scope.schema)
         win_fields = []
         out_sch = list(scope.schema)
@@ -1260,8 +1299,16 @@ class StreamPlanner:
             key_pos.append(found)
         frag.root = Node("project", dict(exprs=exprs, names=names),
                          inputs=(frag.root,))
+        # EOWC output is append-only (final rows, exactly once) and
+        # carries the watermark forward on the order column if selected
+        wm_out = frozenset()
+        if eowc:
+            oc = order_specs[0][0]
+            wm_out = frozenset(
+                j2 for j2, e2 in enumerate(exprs)
+                if isinstance(e2, InputRef) and e2.index == oc)
         return (fid, names, [e.ret_type for e in exprs], tuple(key_pos),
-                False, frozenset())
+                eowc, wm_out)
 
     def _plan_top_n(self, top_spec, planned):
         """Streaming ORDER BY + LIMIT -> RetractableTopN over the query's
@@ -1342,6 +1389,43 @@ class StreamPlanner:
         def agg_post(e) -> Expr:
             """One aggregate call -> its post-project expression over
             [keys..., agg outputs...]."""
+            if e.name in ("bool_and", "bool_or"):
+                # fully retractable via two counts (reference
+                # impl/src/aggregate/bool_and.rs keeps the same pair):
+                # cn = non-null inputs, cf = false (bool_and) / true
+                # (bool_or) inputs; NULL when cn = 0
+                x = e.args[0]
+                cn = add_call(AggKind.COUNT, add_arg(x), DataType.INT64)
+                inner = ast.UnOp("not", x) if e.name == "bool_and" else x
+                hit = ast.Func("case", [inner, ast.Lit(1)])
+                cf = add_call(AggKind.COUNT, add_arg(hit),
+                              DataType.INT64)
+                cond = call("greater_than",
+                            col(nk + cn, DataType.INT64), lit(0))
+                val = call("equal" if e.name == "bool_and"
+                           else "greater_than",
+                           col(nk + cf, DataType.INT64), lit(0))
+                return call("case", cond, val)
+            if e.name == "approx_count_distinct":
+                # 8 hidden register-word calls + estimate projection
+                # (expr/hll.py); NULL when the group saw no rows
+                if not info.append_only:
+                    raise BindError(
+                        "approx_count_distinct needs an append-only "
+                        "input (register max cannot retract)")
+                a = add_arg(e.args[0])
+                cn = add_call(AggKind.COUNT, a, DataType.INT64)
+                lanes = []
+                for L in range(8):
+                    agg_calls.append(AggCall(
+                        AggKind.HLL_REG, a, DataType.INT64,
+                        append_only=True, lane=L))
+                    lanes.append(len(agg_calls) - 1)
+                est = call("hll_estimate",
+                           *[col(nk + j, DataType.INT64) for j in lanes])
+                cond = call("greater_than",
+                            col(nk + cn, DataType.INT64), lit(0))
+                return call("case", cond, est)
             if e.name == "count":
                 idx = add_call(AggKind.COUNT,
                                None if e.star else add_arg(e.args[0]),

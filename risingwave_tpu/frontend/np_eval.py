@@ -89,6 +89,28 @@ def eval_numpy(e: Expr, cols: list[np.ndarray], valids=None):
         if name == "is_not_null":
             (a, av), = args
             return av, np.ones_like(av)
+        if name == "case":
+            n_args = len(args)
+            has_else = n_args % 2 == 1
+            if has_else:
+                v, valid = args[-1]
+                v = np.asarray(v).copy()
+                valid = np.asarray(valid).copy()
+            else:
+                # the default branch must carry the expression's TYPE:
+                # float64 zeros would leak "5.0" for an INT64 CASE
+                v = np.zeros(n, dtype=e.ret_type.np_dtype)
+                valid = np.zeros(n, dtype=bool)
+            v, valid = np.broadcast_to(v, (n,)).copy(), \
+                np.broadcast_to(valid, (n,)).copy()
+            for i in reversed(range(n_args // 2)):
+                c, cv = args[2 * i]
+                rv, rvv = args[2 * i + 1]
+                hit = np.broadcast_to(
+                    np.asarray(c, dtype=bool) & cv, (n,))
+                v = np.where(hit, rv, v)
+                valid = np.where(hit, np.broadcast_to(rvv, (n,)), valid)
+            return v, valid
         if name == "coalesce":
             v, valid = args[0]
             for (b, bv) in args[1:]:

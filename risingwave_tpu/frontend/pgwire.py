@@ -261,12 +261,26 @@ class PgServer:
         if kind == b"S":
             if name not in stmts:
                 raise _PgUserError("26000", f"unknown statement {name!r}")
-            n = _count_params(stmts[name][0])
+            sql_text, _ = stmts[name]
+            n = _count_params(sql_text)
             writer.write(_msg(b"t", struct.pack("!h", n)
                               + b"".join(struct.pack("!i", 25)
                                          for _ in range(n))))
-            writer.write(_msg(b"n", b""))     # NoData (rows described
-            #                                    at the portal level)
+            # statement-level row description (JDBC/npgsql describe
+            # HERE, not at the portal): best-effort plan with NULL
+            # parameters; anything unplannable answers NoData
+            try:
+                probe = _substitute_params(sql_text, [None] * n)
+                stmt = ast.parse(probe)
+                if isinstance(stmt, ast.Select):
+                    from .batch import run_batch_select_full
+                    names, types, _rows = run_batch_select_full(
+                        self.session.catalog, stmt)
+                    self._row_description(writer, names, types)
+                    return
+            except Exception:  # noqa: BLE001 — describe must not fail
+                pass
+            writer.write(_msg(b"n", b""))     # NoData
             return
         if name not in portals:
             raise _PgUserError("34000", f"unknown portal {name!r}")

@@ -28,6 +28,7 @@ KEYWORDS = {
     "alter", "set", "parallelism", "left", "right", "full", "outer",
     "inner", "over", "partition", "rows", "unbounded", "preceding",
     "current", "row", "for", "system_time", "of", "proctime",
+    "case", "when", "then", "else", "end", "in", "is",
 }
 
 _TOKEN_RE = re.compile(r"""
@@ -166,6 +167,7 @@ class Select:
     order_by: list = field(default_factory=list)   # (expr, descending)
     limit: Optional[int] = None
     offset: int = 0
+    emit_on_close: bool = False     # EMIT ON WINDOW CLOSE
 
 
 @dataclass
@@ -325,7 +327,14 @@ class Parser:
             limit = int(self.expect("num").val)
         if self.accept("kw", "offset"):
             offset = int(self.expect("num").val)
-        return Select(items, rel, where, group_by, order_by, limit, offset)
+        eowc = False
+        if self.accept("kw", "emit"):
+            self.expect("kw", "on")
+            self.expect("ident", "window")
+            self.expect("ident", "close")
+            eowc = True
+        return Select(items, rel, where, group_by, order_by, limit,
+                      offset, emit_on_close=eowc)
 
     def _select_item(self) -> SelectItem:
         if self.accept("op", "*"):
@@ -459,6 +468,25 @@ class Parser:
             return BinOp("and",
                          BinOp("greater_than_or_equal", e, lo),
                          BinOp("less_than_or_equal", e, hi))
+        if self.accept("kw", "is"):
+            neg = bool(self.accept("kw", "not"))
+            self.expect("ident", "null")
+            return Func("is_not_null" if neg else "is_null", [e])
+        neg = bool(self.accept("kw", "not"))
+        if self.accept("kw", "in"):
+            # x IN (a, b, c) -> equality OR-chain (NULL semantics match:
+            # x = NULL is NULL, and Kleene OR propagates it)
+            self.expect("op", "(")
+            items = [self._expr()]
+            while self.accept("op", ","):
+                items.append(self._expr())
+            self.expect("op", ")")
+            out = BinOp("equal", e, items[0])
+            for it in items[1:]:
+                out = BinOp("or", out, BinOp("equal", e, it))
+            return UnOp("not", out) if neg else out
+        if neg:
+            self.expect("kw", "in")   # NOT here only prefixes IN
         return e
 
     def _add(self):
@@ -490,6 +518,28 @@ class Parser:
 
     def _primary(self):
         t = self.next()
+        if t.kind == "kw" and t.val == "case":
+            # searched (CASE WHEN c THEN v ...) or simple
+            # (CASE x WHEN v THEN r ...) form; both lower to the `case`
+            # device function (first-match-wins pairs + optional else)
+            operand = None
+            if not (self.peek().kind == "kw"
+                    and self.peek().val == "when"):
+                operand = self._expr()
+            args = []
+            while self.accept("kw", "when"):
+                c = self._expr()
+                self.expect("kw", "then")
+                v = self._expr()
+                if operand is not None:
+                    c = BinOp("equal", operand, c)
+                args += [c, v]
+            if not args:
+                raise SqlError("CASE needs at least one WHEN")
+            if self.accept("kw", "else"):
+                args.append(self._expr())
+            self.expect("kw", "end")
+            return Func("case", args)
         if t.kind == "ident" and t.val.lower() == "null":
             return Lit(None)
         if t.kind == "num":

@@ -482,6 +482,25 @@ def _build_general_over_window(args, inputs, ctx: ActorCtx, key):
         watchdog_interval=args.get("watchdog_interval", 1))
 
 
+@register_builder("eowc_over_window")
+def _build_eowc_over_window(args, inputs, ctx: ActorCtx, key):
+    from ..stream.eowc_over_window import EowcOverWindowExecutor
+    pk = tuple(args["pk_indices"])
+    st = ft = None
+    if args.get("durable"):
+        st = ctx.env.state_table(ctx.table_id((key, 0)), inputs[0].schema,
+                                 pk, vnode_bitmap=ctx.vnode_bitmap)
+        ft = ctx.env.state_table(
+            ctx.table_id((key, 1)),
+            Schema((SchemaField("slot", DataType.INT64),
+                    SchemaField("emitted_to", DataType.INT64))), (0,))
+    return EowcOverWindowExecutor(
+        inputs[0], args["partition_by"], args["order_specs"],
+        args["windows"], capacity=args.get("capacity", 1 << 14),
+        state_table=st, frontier_table=ft, pk_indices=pk,
+        watchdog_interval=args.get("watchdog_interval", 1))
+
+
 @register_builder("now")
 def _build_now(args, inputs, ctx, key):
     from ..stream.dynamic import NowExecutor

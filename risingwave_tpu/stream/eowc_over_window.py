@@ -1,0 +1,122 @@
+"""EMIT ON WINDOW CLOSE over-window (append-only final rows).
+
+Reference: src/stream/src/executor/over_window/eowc.rs — rows buffer
+until the partition's ORDER column passes the watermark; then their
+window-function values are FINAL (frames end at CURRENT ROW and later
+rows sort strictly after the frontier), so each row emits exactly once,
+append-only, with no retraction machinery downstream.
+
+TPU re-design: subclass of the general over-window executor — the same
+dense sorted store and one-pass segmented window compute — with the
+changelog DIFF replaced by a RIPENESS GATE: at each barrier the full
+store recomputes (O(n) vectorized, the store is capacity-bound) and
+rows whose order value moved inside (emitted_frontier, watermark] emit
+as inserts. The emission frontier is durable (its own one-row state
+table) so recovery neither re-emits nor drops.
+
+v1 scope: `lead` is refused (a row's lead needs FUTURE rows, which an
+unbounded EOWC stream cannot finalize), and the store keeps full
+history (unbounded-frame sums need every predecessor; the reference
+instead carries per-partition accumulators — a later optimization).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.chunk import Column, StreamChunk, OP_INSERT
+from ..common.types import Schema
+from .executor import Executor
+from .general_over_window import GeneralOverWindowExecutor, WindowSpec
+from .message import Barrier, Watermark
+from .sorted_join import NO_WATERMARK
+
+
+class EowcOverWindowExecutor(GeneralOverWindowExecutor):
+    def __init__(self, input: Executor,
+                 partition_by: Sequence[int],
+                 order_specs: Sequence[tuple],
+                 windows: Sequence[WindowSpec],
+                 capacity: int = 1 << 14,
+                 state_table=None,
+                 frontier_table=None,
+                 pk_indices: Optional[Sequence[int]] = None,
+                 watchdog_interval: Optional[int] = 1):
+        assert all(w.kind != "lead" for w in windows), \
+            "EMIT ON WINDOW CLOSE cannot finalize lead()"
+        assert order_specs and not order_specs[0][1], \
+            "EOWC needs the watermarked ORDER BY column ascending"
+        super().__init__(input, partition_by, order_specs, windows,
+                         capacity=capacity, state_table=state_table,
+                         pk_indices=pk_indices,
+                         watchdog_interval=watchdog_interval)
+        self.identity = "Eowc" + self.identity
+        self.eowc_col = order_specs[0][0]
+        self.frontier_table = frontier_table
+        self._wm_pending = NO_WATERMARK
+        self._emitted_to = NO_WATERMARK
+        self._flush_eowc = jax.jit(self._flush_eowc_impl)
+
+    # ------------------------------------------------------------- flush
+    def _flush_eowc_impl(self, khash, cols, valids, n, lo, hi):
+        C = self.capacity
+        live = jnp.arange(C, dtype=jnp.int32) < n
+        order, wouts, wvalids = self._compute_windows(cols, valids, live)
+        s_cols = [c[order] for c in cols]
+        s_valids = [v[order] for v in valids]
+        out_fields = tuple(self.schema)[self.in_width:]
+        full_cols = s_cols + [
+            o.astype(f.data_type.jnp_dtype)
+            for o, f in zip(wouts, out_fields)]
+        full_valids = s_valids + list(wvalids)
+        oval = cols[self.eowc_col][order]
+        ripe = live[order] & (oval > lo) & (oval <= hi)
+        out = tuple(Column(c, v)
+                    for c, v in zip(full_cols, full_valids))
+        ops = jnp.full(C, OP_INSERT, dtype=jnp.int8)
+        return out, ops, ripe
+
+    def flush(self) -> Optional[StreamChunk]:
+        if self._wm_pending <= self._emitted_to:
+            return None
+        out, ops, vis = self._flush_eowc(
+            self.khash, self.cols, self.valids, self.n,
+            jnp.int64(self._emitted_to), jnp.int64(self._wm_pending))
+        self._emitted_to = self._wm_pending
+        return StreamChunk(out, ops, vis, self.schema)
+
+    # ----------------------------------------------------------- durable
+    def persist(self, barrier: Barrier, flushed) -> None:
+        super().persist(barrier, flushed)
+        if self.frontier_table is not None:
+            self.frontier_table.write_chunk_rows(
+                [(int(OP_INSERT), (0, int(self._emitted_to)))])
+            self.frontier_table.commit(barrier.epoch.curr)
+
+    def recover_state(self, epoch: int) -> None:
+        if self.frontier_table is not None:
+            self.frontier_table.init_epoch(epoch)
+            row = self.frontier_table.get_row((0,))
+            if row is not None:
+                self._emitted_to = int(row[1])
+                self._wm_pending = max(self._wm_pending, self._emitted_to)
+        # parent loads the input rows; its diff-baseline seeding runs a
+        # general flush — harmless here (em_* is unused by EOWC)
+        super().recover_state(epoch)
+
+    # --------------------------------------------------------- watermark
+    def map_watermark(self, wm: Watermark):
+        if wm.col_idx == self.eowc_col:
+            if wm.val > self._wm_pending:
+                self._wm_pending = wm.val
+                # a watermark alone ripens buffered rows: force the
+                # barrier flush even with no data this epoch
+                self._applied_since_flush = True
+            # the order column survives at the same output position;
+            # emitted rows never precede the forwarded frontier
+            return Watermark(wm.col_idx, wm.data_type, wm.val)
+        return None

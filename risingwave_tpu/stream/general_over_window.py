@@ -51,16 +51,21 @@ from .sorted_store import (GrowableSortedStore, segment_starts,
 class WindowSpec:
     """One window function call (reference: WindowFuncCall)."""
 
-    kind: str                       # row_number|rank|sum|count|avg
-    arg: Optional[int] = None       # input column (None for row_number/rank)
+    kind: str         # row_number|rank|dense_rank|sum|count|avg|
+    #                     lag|lead|first_value
+    arg: Optional[int] = None       # input column (None for rank family)
     preceding: Optional[int] = None  # None = UNBOUNDED PRECEDING
     name: str = ""
+    offset: int = 1                 # lag/lead row offset
 
     def ret_type(self, in_schema: Schema) -> DataType:
-        if self.kind in ("row_number", "rank", "count"):
+        if self.kind in ("row_number", "rank", "dense_rank", "count"):
             return DataType.INT64
         if self.kind == "avg":
             return DataType.FLOAT64
+        if self.kind in ("lag", "lead", "first_value"):
+            # row values pass through UNCHANGED (no promotion)
+            return in_schema[self.arg].data_type
         at = in_schema[self.arg].data_type
         # sum promotes: a narrow-int running sum would silently wrap when
         # cast back (the streaming agg path promotes the same way)
@@ -85,10 +90,14 @@ class GeneralOverWindowExecutor(GrowableSortedStore,
         self.order_specs = tuple((int(c), bool(d)) for c, d in order_specs)
         self.windows = tuple(windows)
         for w in self.windows:
-            assert w.kind in ("row_number", "rank", "sum", "count", "avg"), w
+            assert w.kind in ("row_number", "rank", "dense_rank", "sum",
+                              "count", "avg", "lag", "lead",
+                              "first_value"), w
             if w.preceding is not None:
                 assert w.kind in ("sum", "count", "avg"), \
                     "bounded frames support sum/count/avg"
+            if w.kind in ("lag", "lead"):
+                assert w.offset >= 1, "lag/lead offset must be >= 1"
         self.schema = Schema(tuple(in_schema) + tuple(
             Field(w.name or f"w{j}", w.ret_type(in_schema))
             for j, w in enumerate(self.windows)))
@@ -155,6 +164,10 @@ class GeneralOverWindowExecutor(GrowableSortedStore,
             tie_new = tie_new | jnp.concatenate(
                 [jnp.array([True]), sv[1:] != sv[:-1]])
         tie_start = jax.lax.cummax(jnp.where(tie_new, pos, 0))
+        # per-row partition END (for lead): run starts of the REVERSED
+        # sorted keys are reversed run ends
+        _, rev_start = segment_starts(gkey[order][::-1])
+        run_end = (C - 1) - rev_start[::-1]
 
         outs, out_valids = [], []
         for w in self.windows:
@@ -165,6 +178,27 @@ class GeneralOverWindowExecutor(GrowableSortedStore,
             if w.kind == "rank":
                 outs.append((tie_start - run_start + 1).astype(jnp.int64))
                 out_valids.append(s_live)
+                continue
+            if w.kind == "dense_rank":
+                dcs = jnp.cumsum(tie_new.astype(jnp.int64))
+                outs.append(dcs - dcs[run_start] + 1)
+                out_valids.append(s_live)
+                continue
+            if w.kind in ("lag", "lead", "first_value"):
+                raw = cols[w.arg][order]
+                rawv = valids[w.arg][order]
+                if w.kind == "first_value":
+                    src = run_start
+                    in_part = jnp.ones(C, dtype=bool)
+                elif w.kind == "lag":
+                    src = pos - w.offset
+                    in_part = src >= run_start
+                else:
+                    src = pos + w.offset
+                    in_part = src <= run_end
+                srcc = jnp.clip(src, 0, C - 1)
+                outs.append(raw[srcc])
+                out_valids.append(s_live & in_part & rawv[srcc])
                 continue
             av = cols[w.arg][order]
             avalid = valids[w.arg][order] & s_live
@@ -184,7 +218,7 @@ class GeneralOverWindowExecutor(GrowableSortedStore,
                 in_part = lo >= run_start
                 lo_c = jnp.clip(lo, 0, C - 1)
                 seg = seg - jnp.where(in_part, seg[lo_c], 0)
-            if w.kind == "avg":
+            if w.kind in ("avg", "sum"):
                 cnt = jnp.cumsum(avalid.astype(jnp.int64))
                 cbase = cnt[run_start] - avalid[run_start].astype(jnp.int64)
                 cseg = cnt - cbase
@@ -193,7 +227,12 @@ class GeneralOverWindowExecutor(GrowableSortedStore,
                     in_part = lo >= run_start
                     lo_c = jnp.clip(lo, 0, C - 1)
                     cseg = cseg - jnp.where(in_part, cnt[lo_c] - cbase, 0)
-                outs.append(seg / jnp.maximum(cseg, 1))
+                if w.kind == "avg":
+                    outs.append(seg / jnp.maximum(cseg, 1))
+                else:
+                    # sum over an all-NULL frame is NULL, not 0
+                    # (ADVICE r4 #1 — count alone stays always-valid)
+                    outs.append(seg)
                 out_valids.append(s_live & (cseg > 0))
             else:
                 outs.append(seg)
@@ -217,7 +256,8 @@ class GeneralOverWindowExecutor(GrowableSortedStore,
         # identity for the diff: hash over ALL columns (floats bitcast)
         lanes = []
         for c, v in zip(full_cols, full_valids):
-            x = (jax.lax.bitcast_convert_type(c, jnp.int64)
+            x = (jax.lax.bitcast_convert_type(
+                     c.astype(jnp.float64), jnp.int64)
                  if jnp.issubdtype(c.dtype, jnp.floating)
                  else c.astype(jnp.int64))
             lanes.append(jnp.where(v, x, 0))
@@ -229,13 +269,35 @@ class GeneralOverWindowExecutor(GrowableSortedStore,
         new_cols = tuple(c[rorder] for c in full_cols)
         new_valids = tuple(v[rorder] for v in full_valids)
 
-        def member(a_hash, a_n, b_hash):
-            i = jnp.clip(jnp.searchsorted(b_hash, a_hash), 0, C - 1)
-            return (jnp.arange(C) < a_n) & (b_hash[i] == a_hash)
+        def lanes_of(cols_, valids_):
+            out = []
+            for c, v in zip(cols_, valids_):
+                # f32 upcasts before the bitcast (a 32->64 bitcast is a
+                # bit-width error at trace time)
+                x = (jax.lax.bitcast_convert_type(
+                         c.astype(jnp.float64), jnp.int64)
+                     if jnp.issubdtype(c.dtype, jnp.floating)
+                     else c.astype(jnp.int64))
+                out.append(jnp.where(v, x, 0))
+                out.append(v.astype(jnp.int64))
+            return out
 
-        old_still = member(em_hash, em_n, new_hash)
+        new_lanes = lanes_of(new_cols, new_valids)
+        em_lanes = lanes_of(em_cols, em_valids)
+
+        def member(a_hash, a_n, a_lanes, b_hash, b_lanes):
+            # hash probe + EXACT all-lane compare (ADVICE r4 #2): a
+            # collision can only cause a redundant delete+insert of an
+            # identical row, never a suppressed changelog emission
+            i = jnp.clip(jnp.searchsorted(b_hash, a_hash), 0, C - 1)
+            same = b_hash[i] == a_hash
+            for la, lb in zip(a_lanes, b_lanes):
+                same = same & (lb[i] == la)
+            return (jnp.arange(C) < a_n) & same
+
+        old_still = member(em_hash, em_n, em_lanes, new_hash, new_lanes)
         emit_del = (jnp.arange(C) < em_n) & ~old_still
-        new_was = member(new_hash, n_new, em_hash)
+        new_was = member(new_hash, n_new, new_lanes, em_hash, em_lanes)
         emit_ins = (jnp.arange(C) < n_new) & ~new_was
 
         out_cols = tuple(

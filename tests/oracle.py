@@ -1,0 +1,38 @@
+"""Shared test oracle helpers: committed source offsets of a deployed
+MV and deterministic generator prefixes for host recounts."""
+
+import numpy as np
+
+from risingwave_tpu.state.storage_table import StorageTable
+from risingwave_tpu.stream.source import SourceExecutor
+
+
+def committed_offsets(session, mv_name: str) -> dict:
+    """table -> committed offset, read from the source state tables
+    (the connector's in-memory offset runs ahead of the checkpoint)."""
+    offs: dict = {}
+    obj = session.catalog.mvs.get(mv_name) \
+        or session.catalog.sinks[mv_name]
+    for roots in obj.deployment.roots.values():
+        for root in roots:
+            node = root
+            while node is not None:
+                if isinstance(node, SourceExecutor) \
+                        and node.state_table is not None:
+                    st = StorageTable.for_state_table(node.state_table)
+                    rows = list(st.batch_iter())
+                    table = node.connector.table \
+                        if hasattr(node.connector, "table") else "source"
+                    offs.setdefault(table, 0)
+                    offs[table] = max(offs[table],
+                                      int(rows[0][1]) if rows else 0)
+                node = getattr(node, "input", None)
+    return offs
+
+
+def nexmark_prefix(table: str, n: int) -> list:
+    """First n rows of a nexmark table as numpy columns."""
+    from risingwave_tpu.connectors import NexmarkGenerator
+    gen = NexmarkGenerator(table, chunk_size=max(256, n))
+    c = gen.next_chunk()
+    return [np.asarray(col.data)[:n] for col in c.columns]

@@ -73,24 +73,10 @@ async def test_mesh_agg_planned_and_matches_unsharded():
     got = Counter(s.query("SELECT auction, n, sp FROM ma"))
     # the two MVs sit at different offsets (different DDL epochs), so
     # compare ma against a host recount at ITS committed offset
-    from risingwave_tpu.connectors import NexmarkGenerator
-    from risingwave_tpu.state.storage_table import StorageTable
-    from risingwave_tpu.stream.source import SourceExecutor
-    off = 0
-    for roots in s.catalog.mvs["ma"].deployment.roots.values():
-        for root in roots:
-            node = root
-            while node is not None:
-                if isinstance(node, SourceExecutor) \
-                        and node.state_table is not None:
-                    st = StorageTable.for_state_table(node.state_table)
-                    rows = list(st.batch_iter())
-                    off = max(off, int(rows[0][1]) if rows else 0)
-                node = getattr(node, "input", None)
-    gen = NexmarkGenerator("bid", chunk_size=max(256, off))
-    c = gen.next_chunk()
-    auction = np.asarray(c.columns[0].data)[:off]
-    price = np.asarray(c.columns[2].data)[:off]
+    from oracle import committed_offsets, nexmark_prefix
+    off = committed_offsets(s, "ma").get("bid", 0)
+    cols = nexmark_prefix("bid", off)
+    auction, price = cols[0], cols[2]
     exp = Counter()
     agg: dict = {}
     for a, p in zip(auction, price):
@@ -134,31 +120,10 @@ async def test_mesh_join_planned_and_survives_crash(tmp_path):
     got = Counter(s.query("SELECT id, window_start FROM mj"))
 
     # oracle at the committed offsets
-    from risingwave_tpu.connectors import NexmarkGenerator
-    from risingwave_tpu.state.storage_table import StorageTable
-    from risingwave_tpu.stream.source import SourceExecutor
-    offs: dict = {}
-    for roots in s.catalog.mvs["mj"].deployment.roots.values():
-        for root in roots:
-            node = root
-            while node is not None:
-                if isinstance(node, SourceExecutor) \
-                        and node.state_table is not None:
-                    st = StorageTable.for_state_table(node.state_table)
-                    rows = list(st.batch_iter())
-                    offs.setdefault(node.connector.table, 0)
-                    offs[node.connector.table] = max(
-                        offs[node.connector.table],
-                        int(rows[0][1]) if rows else 0)
-                node = getattr(node, "input", None)
-
-    def prefix(table, n):
-        gen = NexmarkGenerator(table, chunk_size=max(256, n))
-        c = gen.next_chunk()
-        return [np.asarray(col.data)[:n] for col in c.columns]
-
-    p = prefix("person", offs["person"])
-    a = prefix("auction", offs["auction"])
+    from oracle import committed_offsets, nexmark_prefix
+    offs = committed_offsets(s, "mj")
+    p = nexmark_prefix("person", offs["person"])
+    a = nexmark_prefix("auction", offs["auction"])
     persons: dict = {}
     for pid, ts in zip(p[0], p[6]):
         w = int(ts) - int(ts) % W
@@ -198,24 +163,10 @@ async def test_mesh_agg_durable_crash_recovery(tmp_path):
     assert _executors(s, "da", ShardedHashAggExecutor), \
         "recovery replanned without the mesh"
     got = Counter(s.query("SELECT auction, n, sp FROM da"))
-    from risingwave_tpu.connectors import NexmarkGenerator
-    from risingwave_tpu.state.storage_table import StorageTable
-    from risingwave_tpu.stream.source import SourceExecutor
-    off = 0
-    for roots in s.catalog.mvs["da"].deployment.roots.values():
-        for root in roots:
-            node = root
-            while node is not None:
-                if isinstance(node, SourceExecutor) \
-                        and node.state_table is not None:
-                    st = StorageTable.for_state_table(node.state_table)
-                    rows = list(st.batch_iter())
-                    off = max(off, int(rows[0][1]) if rows else 0)
-                node = getattr(node, "input", None)
-    gen = NexmarkGenerator("bid", chunk_size=max(256, off))
-    c = gen.next_chunk()
-    auction = np.asarray(c.columns[0].data)[:off]
-    price = np.asarray(c.columns[2].data)[:off]
+    from oracle import committed_offsets, nexmark_prefix
+    off = committed_offsets(s, "da").get("bid", 0)
+    cols = nexmark_prefix("bid", off)
+    auction, price = cols[0], cols[2]
     agg: dict = {}
     for a2, p2 in zip(auction, price):
         n, sp = agg.get(int(a2), (0, 0))
