@@ -295,80 +295,43 @@ async def _measure(coord, gen, sink, progress: dict, measure_s: float,
 
 
 async def bench_q1(progress: dict) -> None:
-    from risingwave_tpu.common import DataType
-    from risingwave_tpu.connectors import NexmarkGenerator
-    from risingwave_tpu.expr import call, col, lit
-    from risingwave_tpu.meta import BarrierCoordinator
-    from risingwave_tpu.state import MemoryStateStore
-    from risingwave_tpu.stream import Actor, ProjectExecutor, SourceExecutor
-
-    # q1 is host-dispatch-bound: large chunks amortize the per-program cost
-    chunk_size = 131072
-    store = MemoryStateStore()
-    barrier_q = asyncio.Queue()
-    gen = NexmarkGenerator("bid", chunk_size=chunk_size)
-    src = SourceExecutor(1, gen, barrier_q)
-    proj = ProjectExecutor(
-        src,
-        [col(0), col(1), call("multiply", col(2), lit(0.908)),
-         col(5, DataType.TIMESTAMP)],
-        names=["auction", "bidder", "price", "date_time"])
-    sink = _DeviceSink(proj)
-    coord = BarrierCoordinator(store)
-    coord.register_source(barrier_q)
-    coord.register_actor(1)
-    task = Actor(1, sink, None, coord).spawn()
-    await _measure(coord, gen, sink, progress, MEASURE_S)
-    await coord.stop_all({1})
-    await task
-
-
+    """q1 VIA SQL (BASELINE config 1): currency-conversion projection.
+    The planner supplies the same single-actor source->project->sink
+    chain the round-3 hand-built pipeline hard-coded (q1 is
+    host-dispatch-bound: large chunks amortize per-program cost)."""
+    ddl = [
+        "SET streaming_durability = 0",
+        "SET streaming_watchdog = 0",
+        ("CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+         "chunk_size=131072)"),
+        ("CREATE SINK q1 AS SELECT auction, bidder, "
+         "price * 0.908 AS price, date_time FROM bid "
+         "WITH (connector='blackhole_device')"),
+    ]
+    await _bench_sql(progress, ddl, interval_s=0.5)
 
 
 async def bench_q5(progress: dict) -> None:
-    """q5 core: HOP(2s,10s) + count(*) GROUP BY (auction, window_start) —
-    the first stateful device pipeline (BASELINE config 2).
+    """q5 core VIA SQL (BASELINE config 2): HOP(2s,10s) + count(*)
+    GROUP BY (auction, window_start), watermark-cleaned.
 
-    Sizing is driven by CHURN PER EPOCH (watermark cleaning purges closed
-    windows at every barrier): at ~250M rows/s and 2us event spacing a
-    0.2s epoch spans ~50 event-seconds => (50+6 slides)*10k ~ 560k peak
-    groups — fits 2^20 under the 0.7 threshold with margin (round-2
-    analysis, unchanged)."""
-    from risingwave_tpu.connectors import NexmarkGenerator
-    from risingwave_tpu.connectors.nexmark import NexmarkConfig
-    from risingwave_tpu.expr.agg import count_star
-    from risingwave_tpu.meta import BarrierCoordinator
-    from risingwave_tpu.state import MemoryStateStore
-    from risingwave_tpu.stream import (
-        Actor, HashAggExecutor, HopWindowExecutor, SourceExecutor,
-    )
-
-    chunk_size = 131072
-    cfg = NexmarkConfig(inter_event_us=2)
-    store = MemoryStateStore()
-    barrier_q = asyncio.Queue()
-    gen = NexmarkGenerator("bid", chunk_size=chunk_size, cfg=cfg)
-    src = SourceExecutor(1, gen, barrier_q, emit_watermarks=True)
-    hop = HopWindowExecutor(src, time_col=5, window_slide_us=2_000_000,
-                            window_size_us=10_000_000)
-    # watchdog_interval=None: the process must stay d2h-transfer-free
-    # during the measured region; capacity safety is covered by CPU-backend
-    # tests of this pipeline shape plus the device-side zombie purge.
-    agg = HashAggExecutor(hop, group_key_indices=[0, hop.window_start_idx],
-                          agg_calls=[count_star(append_only=True)],
-                          capacity=1 << 20,
-                          cleaning_watermark_col=hop.window_start_idx,
-                          watchdog_interval=None)
-    sink = _DeviceSink(agg)
-    coord = BarrierCoordinator(store)
-    coord.register_source(barrier_q)
-    coord.register_actor(1)
-    task = Actor(1, sink, None, coord).spawn()
-    await _measure(coord, gen, sink, progress, MEASURE_S, interval_s=0.2)
-    await coord.stop_all({1})
-    await task
-
-
+    Sizing is driven by CHURN PER EPOCH (watermark cleaning purges
+    closed windows at every barrier): at ~250M rows/s and 2us event
+    spacing a 0.2s epoch spans ~50 event-seconds => (50+6 slides)*10k
+    ~ 560k peak groups — fits 2^20 under the 0.7 threshold with margin
+    (round-2 analysis, unchanged)."""
+    ddl = [
+        "SET streaming_durability = 0",
+        "SET streaming_watchdog = 0",
+        f"SET streaming_agg_capacity = {1 << 20}",
+        ("CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+         "chunk_size=131072, inter_event_us=2, emit_watermarks=1)"),
+        ("CREATE SINK q5 AS SELECT auction, window_start, count(*) AS n "
+         "FROM HOP(bid, date_time, 2000000, 10000000) "
+         "GROUP BY auction, window_start "
+         "WITH (connector='blackhole_device')"),
+    ]
+    await _bench_sql(progress, ddl, interval_s=0.2)
 
 
 async def _bench_sql(progress: dict, ddl: list, interval_s: float,

@@ -398,6 +398,24 @@ class StreamPlanner:
                     inputs=(Exchange(lf), Exchange(rf)))
             f = self.graph.add(Fragment(self.fid(), node,
                                         dispatch="broadcast"))
+            rw = self.cfg("streaming_fragment_worker", "")
+            if rw and node.kind == "sorted_join":
+                # DCN placement: this fragment deploys in the worker
+                # process (stream/remote_fragment.py). v1 runs the
+                # remote fragment volatile, so the SESSION must be
+                # volatile too (recovery then replays sources from 0
+                # and the materialize upsert converges the MV)
+                if self.durable():
+                    raise BindError(
+                        "streaming_fragment_worker requires "
+                        "streaming_durability = 0 (v1: remote fragments "
+                        "hold no durable state)")
+                if self.parallelism != 1:
+                    raise BindError(
+                        "streaming_fragment_worker requires "
+                        "streaming_parallelism = 1 (remote fragments "
+                        "and their upstreams are singleton in v1)")
+                f.remote_worker = rw
             # stash for the bind-time optimizer passes (_optimize_join):
             # filter pushdown + join-input pruning run once the consuming
             # SELECT is known

@@ -110,6 +110,10 @@ class Session:
         "streaming_parallelism_devices": (1, int),
         "streaming_over_window_capacity": (1 << 14, int),
         "streaming_dynamic_filter_capacity": (1 << 14, int),
+        # "host:port" of a running fragment worker
+        # (python -m risingwave_tpu.worker): join fragments deploy there
+        # over the DCN tier; requires streaming_durability = 0 in v1
+        "streaming_fragment_worker": ("", str),
         # 0 disables the snapshot join-agg fusion (binder.py
         # _try_snapshot_join_agg) — the q17 shape then plans the
         # generic changelog join cascade
@@ -506,9 +510,30 @@ class Session:
         all dataflows from the DDL log at the committed epoch, resume."""
         self.recoveries += 1
         await self.crash()
-        reset = getattr(self.store, "reset_uncommitted", None)
-        if reset is not None:
-            reset()
+        # VOLATILE sessions (every MV planned with streaming_durability
+        # = 0) recover by recomputing from scratch: stateful executors
+        # lost their state, but source offsets and MV tables would
+        # otherwise SURVIVE in the still-alive in-memory store —
+        # resuming sources past state the executors no longer have
+        # silently loses joins/aggregates (found round 5: pre-crash
+        # person rows x post-crash auction rows vanished). A whole-store
+        # reset is the reference's in-memory-backend semantics: process
+        # state dies with the failure, everything replays from offset 0
+        # and the rebuilt MVs converge exactly.
+        flows = [e for e in self._ddl_log
+                 if e["kind"] in ("mv", "sink")]
+        all_volatile = flows and all(
+            e.get("config", {}).get("streaming_durability", 1) == 0
+            for e in flows)
+        if all_volatile and isinstance(self.store, MemoryStateStore):
+            blob = getattr(self.store, "_catalog_blob", None)
+            self.store = MemoryStateStore()
+            if blob is not None:
+                self.store._catalog_blob = blob
+        else:
+            reset = getattr(self.store, "reset_uncommitted", None)
+            if reset is not None:
+                reset()
         # fresh coordinator: epochs re-floor at the committed epoch, no
         # stale in-flight state (the dict-delta cursor carries over — the
         # dictionary itself survives in-process recovery)

@@ -166,6 +166,43 @@ def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
     for fid in order:
         f = graph.fragments[fid]
         dep.roots[fid] = []
+        if getattr(f, "remote_worker", None):
+            # DCN placement (stream/remote_fragment.py): the fragment
+            # runs in a worker process; locally it appears as ONE actor
+            # whose executor chain crosses the process boundary, so
+            # barrier collection happens only after the round trip
+            assert f.parallelism == 1, "remote fragments are singleton"
+            actor_id = env.alloc_actor_id()
+            in_chans, in_schemas = [], []
+            edge_seen_r: dict = {}
+
+            def walk(n):
+                if isinstance(n, Exchange):
+                    k = edge_seen_r.get(n.upstream, 0)
+                    edge_seen_r[n.upstream] = k + 1
+                    up = graph.fragments[n.upstream]
+                    assert up.parallelism == 1, \
+                        "remote fragment upstreams are singleton"
+                    in_chans.append(channels[(n.upstream, fid, k)][0][0])
+                    in_schemas.append(built_schema[n.upstream])
+                    return
+                for i in n.inputs:
+                    walk(i)
+
+            walk(f.root)
+            out_schema = _infer_fragment_schema(graph, f, built_schema)
+            from ..stream.remote_fragment import RemoteFragmentExecutor
+            root = RemoteFragmentExecutor(
+                f.remote_worker, f.root, in_chans, in_schemas, out_schema,
+                actor_id=actor_id)
+            built_schema[fid] = out_schema
+            dep.roots[fid].append(root)
+            dispatcher = _dispatcher_for(graph, f, consumers[fid],
+                                         channels, 0)
+            env.coord.register_actor(actor_id)
+            dep.actors.append(Actor(actor_id, root, dispatcher,
+                                    env.coord))
+            continue
         bitmaps = (shard_vnode_bitmaps(f.parallelism)
                    if f.parallelism > 1 else [None])
         # table ids are shared across a fragment's actors (vnode-split)
@@ -205,30 +242,62 @@ def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
             if idx == 0:
                 built_schema[fid] = root.schema
 
-            # output dispatcher
-            cons = consumers[fid]
-            dispatcher = None
-            if cons:
-                per_consumer = []
-                for d_fid, k in cons:
-                    d = graph.fragments[d_fid]
-                    outs = channels[(fid, d_fid, k)][idx]
-                    if f.dispatch == "hash":
-                        per_consumer.append(HashDispatcher(
-                            outs, f.dist_key_indices,
-                            vnode_to_shard(d.parallelism)))
-                    elif f.dispatch == "broadcast":
-                        per_consumer.append(BroadcastDispatcher(outs))
-                    else:
-                        assert d.parallelism == f.parallelism, \
-                            "simple dispatch is 1:1 (NoShuffle)"
-                        per_consumer.append(SimpleDispatcher(outs[idx]))
-                dispatcher = (per_consumer[0] if len(per_consumer) == 1
-                              else FanoutDispatcher(per_consumer))
+            dispatcher = _dispatcher_for(graph, f, consumers[fid],
+                                         channels, idx)
             env.coord.register_actor(actor_id)
             dep.actors.append(Actor(actor_id, root, dispatcher, env.coord))
     dep.source_queues = list(env.pending_source_queues)
     return dep
+
+
+def _dispatcher_for(graph, f, cons, channels, idx):
+    """Output dispatcher for actor `idx` of fragment `f` (shared by the
+    local and remote-fragment build paths)."""
+    if not cons:
+        return None
+    per_consumer = []
+    for d_fid, k in cons:
+        d = graph.fragments[d_fid]
+        outs = channels[(f.fid, d_fid, k)][idx]
+        if f.dispatch == "hash":
+            per_consumer.append(HashDispatcher(
+                outs, f.dist_key_indices, vnode_to_shard(d.parallelism)))
+        elif f.dispatch == "broadcast":
+            per_consumer.append(BroadcastDispatcher(outs))
+        else:
+            assert d.parallelism == f.parallelism, \
+                "simple dispatch is 1:1 (NoShuffle)"
+            per_consumer.append(SimpleDispatcher(outs[idx]))
+    return (per_consumer[0] if len(per_consumer) == 1
+            else FanoutDispatcher(per_consumer))
+
+
+def _infer_fragment_schema(graph, frag, built_schema) -> Schema:
+    """Planner-level schema of a fragment's output WITHOUT building its
+    executors (the remote build needs it before the worker exists)."""
+    def rec(n):
+        if isinstance(n, Exchange):
+            return built_schema[n.upstream]
+        ins = [rec(i) for i in n.inputs]
+        k = n.kind
+        if k in ("sorted_join", "hash_join"):
+            fields = tuple(ins[0]) + tuple(ins[1])
+            oi = n.args.get("output_indices")
+            if oi is not None:
+                fields = tuple(fields[i] for i in oi)
+            return Schema(fields)
+        if k == "project":
+            return Schema(tuple(
+                SchemaField(nm, e.ret_type)
+                for e, nm in zip(n.args["exprs"], n.args["names"])))
+        if k in ("filter", "no_op", "dedup"):
+            return ins[0]
+        if k == "row_id_gen":
+            return Schema(tuple(ins[0])
+                          + (SchemaField("_row_id", DataType.SERIAL),))
+        raise NotImplementedError(
+            f"schema inference for remote fragment node {k!r}")
+    return rec(frag.root)
 
 
 class FanoutDispatcher:

@@ -55,6 +55,9 @@ class Fragment:
     dispatch: str = "simple"            # simple | broadcast | hash
     dist_key_indices: tuple[int, ...] = ()
     parallelism: int = 1
+    # "host:port" of a fragment worker process — the build places this
+    # fragment there over the DCN tier (stream/remote_fragment.py)
+    remote_worker: object = None
 
     def __post_init__(self):
         assert self.dispatch in ("simple", "broadcast", "hash")

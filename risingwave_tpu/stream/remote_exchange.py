@@ -125,6 +125,7 @@ class RemoteOutput:
         self._credit_evt = asyncio.Event()
         self._reader = self._writer = None
         self._credit_task = None
+        self._dead = False
 
     async def connect(self) -> "RemoteOutput":
         self._reader, self._writer = await asyncio.open_connection(
@@ -139,12 +140,24 @@ class RemoteOutput:
                 if tag == b"K":
                     self._credits += struct.unpack("!I", payload)[0]
                     self._credit_evt.set()
-        except (asyncio.IncompleteReadError, ConnectionResetError):
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                OSError):
             pass
+        finally:
+            # a sender parked on the credit wait must FAIL, not hang
+            # forever, once the receiver is gone (recovery teardown
+            # otherwise deadlocks: receiver waits for this socket to
+            # close while we wait for its credits)
+            self._dead = True
+            self._credit_evt.set()
 
     async def send(self, msg) -> None:
+        if self._dead:
+            raise ConnectionResetError("remote receiver is gone")
         if isinstance(msg, StreamChunk):
             while self._credits <= 0:     # permit-based backpressure
+                if self._dead:
+                    raise ConnectionResetError("remote receiver is gone")
                 self._credit_evt.clear()
                 await self._credit_evt.wait()
             self._credits -= 1
@@ -213,6 +226,15 @@ class RemoteInput(Executor):
         return self
 
     async def stop(self) -> None:
+        # close the live connection FIRST: wait_closed() (3.12+) waits
+        # for connection handlers, and ours is blocked reading a socket
+        # whose peer may itself be blocked on our credits — the
+        # recovery-teardown circular wait (round 5)
+        if self._conn_writer is not None:
+            try:
+                self._conn_writer.close()
+            except Exception:  # noqa: BLE001
+                pass
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -233,7 +255,7 @@ class RemoteInput(Executor):
                     try:
                         await _write_frame(self._conn_writer, b"K",
                                            struct.pack("!I", 1))
-                    except (ConnectionResetError, BrokenPipeError):
+                    except (ConnectionResetError, BrokenPipeError, OSError):
                         self._conn_writer = None
             elif tag == b"B":
                 d = json.loads(payload)

@@ -1,0 +1,115 @@
+"""Fragment worker — a second-process host executing shipped fragments.
+
+Reference: the compute node role (compute/src/server.rs:86): it receives
+plan fragments from the control plane, builds executors through the same
+from_proto registry, and exchanges data with peers. This worker accepts
+a control connection per fragment (stream/remote_fragment.py ships the
+pickled Node subtree — trusted-deployment IR, the reference's protobuf
+equivalent), serves the fragment's inputs as DCN RemoteInput endpoints,
+runs the executor chain, and streams everything back on a RemoteOutput.
+
+Run: python -m risingwave_tpu.worker [port]     (0 = ephemeral; the
+chosen port prints as the first stdout line for orchestration).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import pickle
+import struct
+import sys
+
+
+async def _recv_blob(reader) -> bytes:
+    ln = struct.unpack("!i", await reader.readexactly(4))[0]
+    return await reader.readexactly(ln)
+
+
+async def _send_blob(writer, blob: bytes) -> None:
+    writer.write(struct.pack("!i", len(blob)) + blob)
+    await writer.drain()
+
+
+class _StubCoord:
+    """Builders never touch the coordinator; actors (which do) are not
+    used in the worker — barriers ride the data stream."""
+
+    def register_source(self, q) -> None:
+        pass
+
+    def register_actor(self, a) -> None:
+        pass
+
+
+async def _handle(reader, writer) -> None:
+    from .common.types import Schema  # noqa: F401  (pickle needs types)
+    from .plan.build import BUILDERS, ActorCtx, BuildEnv
+    from .plan.graph import Exchange
+    from .state import MemoryStateStore
+    from .stream.message import Barrier
+    from .stream.remote_exchange import RemoteInput, RemoteOutput
+
+    peer = writer.get_extra_info("peername")[0]
+    try:
+        spec = pickle.loads(await _recv_blob(reader))
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        writer.close()
+        return
+    ins = []
+    for sch in spec["in_schemas"]:
+        ins.append(await RemoteInput(sch, host="0.0.0.0",
+                                     queue_depth=8).start())
+    await _send_blob(writer, json.dumps(
+        {"input_ports": [r.port for r in ins]}).encode())
+    out = await RemoteOutput(peer, spec["out_port"]).connect()
+
+    env = BuildEnv(MemoryStateStore(), _StubCoord())
+    ctx = ActorCtx(env=env, fragment=None, actor_id=0, actor_idx=0,
+                   vnode_bitmap=None, table_ids={})
+    pending = list(ins)
+
+    def build(n):
+        if isinstance(n, Exchange):
+            return pending.pop(0)     # pre-order = port assignment order
+        inputs = [build(i) for i in n.inputs]
+        args = dict(n.args)
+        args["durable"] = False       # v1: remote fragments are volatile
+        return BUILDERS[n.kind](args, inputs, ctx, id(n))
+
+    chain = build(spec["node"])
+    stop_id = spec.get("stop_actor_id")
+    try:
+        async for msg in chain.execute():
+            await out.send(msg)
+            if isinstance(msg, Barrier) and msg.mutation is not None \
+                    and (msg.is_stop(stop_id) if stop_id is not None
+                         else msg.is_stop_any()):
+                break
+    except (ConnectionResetError, asyncio.IncompleteReadError, OSError):
+        pass            # main went away (crash/recovery): drop fragment
+    finally:
+        try:
+            await out.close()
+        except Exception:  # noqa: BLE001
+            pass
+        for r in ins:
+            await r.stop()
+        writer.close()
+
+
+async def serve(port: int = 0, host: str = "127.0.0.1"):
+    server = await asyncio.start_server(_handle, host, port)
+    print(server.sockets[0].getsockname()[1], flush=True)
+    async with server:
+        await server.serve_forever()
+
+
+def main(argv=None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    port = int(argv[0]) if argv else 0
+    asyncio.run(serve(port))
+
+
+if __name__ == "__main__":
+    main()
