@@ -197,6 +197,16 @@ class PgServer:
                     for row in rows:
                         self._data_row(writer, row)
                     writer.write(_msg(b"C", _cstr(f"SELECT {len(rows)}")))
+                elif isinstance(stmt, (ast.Explain, ast.Show)):
+                    rows = await self.session.execute(part)
+                    ncols = len(rows[0]) if rows else 1
+                    names = (["QUERY PLAN"] if isinstance(stmt, ast.Explain)
+                             else ["name", "setting"][:ncols])
+                    self._row_description(
+                        writer, names, [DataType.VARCHAR] * ncols)
+                    for row in rows:
+                        self._data_row(writer, row)
+                    writer.write(_msg(b"C", _cstr(f"SELECT {len(rows)}")))
                 else:
                     await self.session.execute(part)
                     writer.write(_msg(b"C", _cstr(_tag_of(stmt))))
@@ -298,6 +308,12 @@ class PgServer:
                 raise _PgUserError("42601", str(e))
             p["cached"] = (names, types, rows)
             self._row_description(writer, names, types)
+        elif isinstance(stmt, ast.Explain):
+            self._row_description(writer, ["QUERY PLAN"],
+                                  [DataType.VARCHAR])
+        elif isinstance(stmt, ast.Show):
+            self._row_description(writer, ["setting"],
+                                  [DataType.VARCHAR])
         else:
             writer.write(_msg(b"n", b""))     # NoData
 
@@ -321,6 +337,14 @@ class PgServer:
                     raise _PgUserError("42601", str(e))
             _, _, rows = p["cached"]
             p["cached"] = None       # a re-Execute re-runs the query
+            for row in rows:
+                self._data_row(writer, row)
+            writer.write(_msg(b"C", _cstr(f"SELECT {len(rows)}")))
+        elif isinstance(stmt, (ast.Explain, ast.Show)):
+            try:
+                rows = await self.session.execute(p["sql"])
+            except (BindError, SqlError) as e:
+                raise _PgUserError("42601", str(e))
             for row in rows:
                 self._data_row(writer, row)
             writer.write(_msg(b"C", _cstr(f"SELECT {len(rows)}")))

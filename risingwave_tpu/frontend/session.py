@@ -26,7 +26,7 @@ from ..plan import BuildEnv, Deployment, build_graph
 from ..state import MemoryStateStore, StorageTable
 from . import sql as ast
 from .binder import (BindError, Scope, StreamPlanner, bind_scalar,
-                     expand_star)
+                     contains_agg, expand_star)
 from .np_eval import eval_numpy
 
 _NEXMARK_SCHEMAS = {"bid": BID_SCHEMA, "person": PERSON_SCHEMA,
@@ -276,6 +276,10 @@ class Session:
             return out
         if isinstance(stmt, ast.AlterParallelism):
             return await self.alter_parallelism(stmt.name, stmt.parallelism)
+        if isinstance(stmt, ast.Explain):
+            return self.explain(stmt.stmt)
+        if isinstance(stmt, ast.Show):
+            return self.show(stmt.what)
         if isinstance(stmt, ast.SetVar):
             if stmt.name not in self.CONFIG_VARS:
                 raise BindError(f"unknown session variable {stmt.name!r}")
@@ -285,6 +289,46 @@ class Session:
         if isinstance(stmt, ast.Select):
             return self.query_select(stmt)
         raise BindError(f"unsupported statement {stmt!r}")
+
+    def explain(self, stmt) -> list:
+        """EXPLAIN: plan WITHOUT deploying, return the fragment graph as
+        text rows (reference: handler/explain.rs over the planner's
+        explain output; snapshot format shared with tests/goldens)."""
+        from ..plan.graph import render_graph
+        # same parallelism the CREATE path would deploy with — EXPLAIN
+        # must preview the actual topology
+        planner = StreamPlanner(
+            self.catalog, config=self.config,
+            parallelism=self.config["streaming_parallelism"])
+        if isinstance(stmt, ast.CreateMV):
+            plan = planner.plan_select(stmt.select)
+        elif isinstance(stmt, ast.CreateSink):
+            plan = planner.plan_sink(stmt.select, dict(stmt.options))
+        elif isinstance(stmt, ast.Select):
+            # a bare SELECT executes on the numpy BATCH engine over a
+            # committed snapshot — explain THAT pipeline, not a
+            # streaming plan that never runs
+            return [(ln,) for ln in _render_batch_plan(stmt)]
+        else:
+            raise BindError(
+                "EXPLAIN supports SELECT / CREATE MATERIALIZED VIEW / "
+                "CREATE SINK")
+        return [(ln,) for ln in render_graph(plan.graph)]
+
+    def show(self, what: str) -> list:
+        """SHOW <objects|variable> (reference: handler/show.rs +
+        session_config reads)."""
+        if what == "sources":
+            return [(n,) for n in sorted(self.catalog.sources)]
+        if what in ("tables", "materialized_views"):
+            return [(n,) for n in sorted(self.catalog.mvs)]
+        if what == "sinks":
+            return [(n,) for n in sorted(self.catalog.sinks)]
+        if what == "all":
+            return [(k, str(v)) for k, v in sorted(self.config.items())]
+        if what in self.CONFIG_VARS:
+            return [(str(self.config[what]),)]
+        raise BindError(f"unknown SHOW target {what!r}")
 
     def _create_source(self, stmt: ast.CreateSource) -> SourceDef:
         opts = dict(stmt.options)
@@ -631,3 +675,39 @@ class Session:
         batch/src/executor/ — scan/filter/join/agg/sort/limit)."""
         from .batch import run_batch_select
         return run_batch_select(self.catalog, sel)
+
+
+def _render_batch_plan(sel) -> list:
+    """Batch (serving) pipeline of a bare SELECT as text — mirrors the
+    executor order in frontend/batch.py."""
+    def rel_lines(rel, depth):
+        pad = "  " * depth
+        if isinstance(rel, ast.TableRel):
+            return [f"{pad}batch_scan {rel.name}"
+                    + (f" AS {rel.alias}" if rel.alias else "")]
+        if isinstance(rel, ast.JoinRel):
+            jt = getattr(rel, "join_type", "inner")
+            return ([f"{pad}batch_hash_join type={jt}"]
+                    + rel_lines(rel.left, depth + 1)
+                    + rel_lines(rel.right, depth + 1))
+        return [f"{pad}{type(rel).__name__}"]
+
+    out = []
+    depth = 0
+    if sel.limit is not None or sel.offset:
+        out.append("batch_limit "
+                   f"limit={sel.limit} offset={sel.offset}")
+        depth += 1
+    if sel.order_by:
+        out.append("  " * depth + "batch_sort")
+        depth += 1
+    if sel.group_by or any(contains_agg(it.expr) for it in sel.items):
+        out.append("  " * depth + "batch_hash_agg")
+        depth += 1
+    out.append("  " * depth + "batch_project")
+    depth += 1
+    if sel.where is not None:
+        out.append("  " * depth + "batch_filter")
+        depth += 1
+    out.extend(rel_lines(sel.rel, depth))
+    return out

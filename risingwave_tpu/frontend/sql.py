@@ -29,6 +29,7 @@ KEYWORDS = {
     "inner", "over", "partition", "rows", "unbounded", "preceding",
     "current", "row", "for", "system_time", "of", "proctime",
     "case", "when", "then", "else", "end", "in", "is",
+    "explain", "show",
 }
 
 _TOKEN_RE = re.compile(r"""
@@ -153,6 +154,16 @@ class SetVar:
 
 
 @dataclass
+class Explain:
+    stmt: object
+
+
+@dataclass
+class Show:
+    what: str           # sources|tables|materialized_views|sinks|all|<var>
+
+
+@dataclass
 class SubqueryRel:
     select: object              # Select
     alias: str
@@ -231,6 +242,21 @@ class Parser:
         return stmt
 
     def _statement(self):
+        if self.accept("kw", "explain"):
+            return Explain(self._statement())
+        if self.accept("kw", "show"):
+            t = self.next()
+            if t.kind not in ("ident", "kw"):
+                raise SqlError("SHOW needs a target "
+                               "(sources|tables|sinks|all|<variable>)")
+            what = t.val.lower()
+            if what == "materialized":
+                if not self.accept("kw", "view"):
+                    self.expect("ident", "views")
+                what = "materialized_views"
+            # else: object class or a session variable name
+            self.accept("op", ";")
+            return Show(what)
         if self.accept("kw", "set"):
             # SET var = value — session config (reference: session_config/)
             name = self.next().val

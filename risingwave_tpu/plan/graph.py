@@ -132,3 +132,36 @@ class StreamGraph:
         for fid in sorted(self.fragments):
             visit(fid)
         return out
+
+
+def render_node(node, depth: int = 0) -> list:
+    """Plan-node tree as indented text (EXPLAIN + plan goldens)."""
+    if isinstance(node, Exchange):
+        return [f"{'  ' * depth}exchange({node.upstream})"]
+    extra = ""
+    if node.kind in ("sorted_join", "hash_join"):
+        extra = (f" lkeys={node.args['left_key_indices']}"
+                 f" rkeys={node.args['right_key_indices']}")
+    if node.kind == "project":
+        extra = f" names={node.args.get('names')}"
+    out = [f"{'  ' * depth}{node.kind}{extra}"]
+    for i in node.inputs:
+        out.extend(render_node(i, depth + 1))
+    return out
+
+
+def render_graph(graph: "StreamGraph") -> list:
+    """Whole fragment graph as text lines (reference: EXPLAIN output /
+    the planner_test YAML snapshots, frontend/planner_test)."""
+    lines = []
+    for fid in sorted(graph.fragments):
+        f = graph.fragments[fid]
+        remote = (f" remote={f.remote_worker}"
+                  if getattr(f, "remote_worker", None) else "")
+        lines.append(
+            f"fragment {fid} dispatch={f.dispatch} "
+            f"parallelism={f.parallelism} "
+            f"dist={tuple(f.dist_key_indices)}{remote}")
+        for ln in render_node(f.root, 1):
+            lines.append(ln)
+    return lines

@@ -158,3 +158,27 @@ async def test_reject_unsupported_clause():
     rows = s.query("SELECT auction FROM m ORDER BY 1 LIMIT 3")
     assert rows == sorted(rows)
     await s.drop_all()
+
+
+async def test_explain_and_show():
+    """EXPLAIN (plan text, no deployment) + SHOW objects/variables
+    (reference: handler/{explain,show}.rs)."""
+    from risingwave_tpu.frontend import Session
+    s = Session()
+    await s.execute("CREATE SOURCE bid WITH (connector='nexmark', "
+                    "table='bid', chunk_size=128, rate_limit=128)")
+    rows = await s.execute(
+        "EXPLAIN CREATE MATERIALIZED VIEW m AS "
+        "SELECT auction, count(*) AS n FROM bid GROUP BY auction")
+    text = "\n".join(r[0] for r in rows)
+    assert "hash_agg" in text and "fragment" in text
+    assert "m" not in s.catalog.mvs, "EXPLAIN must not deploy"
+    await s.execute("CREATE MATERIALIZED VIEW m AS SELECT auction "
+                    "FROM bid")
+    assert s.show("sources") == [("bid",)]
+    assert s.show("materialized_views") == [("m",)]
+    rows = await s.execute("SHOW streaming_durability")
+    assert rows == [("1",)]
+    rows = await s.execute("SHOW all")
+    assert ("streaming_join_capacity", str(1 << 17)) in rows
+    await s.drop_all()
