@@ -74,14 +74,18 @@ class JsonlFileConnector:
             return True
 
     def seek(self, offset: int) -> None:
-        """Re-position to line `offset` by scanning from the start
-        (recovery-time only; the steady state never seeks)."""
-        self.offset = 0
-        self._byte_pos = 0
-        if offset <= 0:
+        """Re-position to line `offset`. A forward seek scans from the
+        CURRENT position (split readers advance monotonically — a
+        from-zero rescan per block would be quadratic in file size);
+        only a backward seek restarts from byte 0 (recovery)."""
+        if offset < self.offset:
+            self.offset = 0
+            self._byte_pos = 0
+        if offset <= self.offset:
             return
         with open(self.path, "rb") as f:
-            for _ in range(offset):
+            f.seek(self._byte_pos)
+            for _ in range(offset - self.offset):
                 line = f.readline()
                 if not line or not line.endswith(b"\n"):
                     break

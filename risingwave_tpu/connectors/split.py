@@ -42,6 +42,26 @@ class BlockSplitConnector:
         self.offset = offset
 
     @property
+    def exhausted(self) -> bool:
+        # a split-wrapped FINITE source (ArrowSource, jsonl tail at EOF)
+        # must surface exhaustion at THIS split's next global position,
+        # or the source executor busy-spins empty chunks (ADVICE r4 #3).
+        # The positioning seek is cached per global offset (the source
+        # loop polls this before every read) and a vanished backing file
+        # reads as exhausted, matching the inner connectors' own
+        # contract.
+        if not hasattr(self.inner, "exhausted"):
+            return False
+        go = self._global_offset()
+        if getattr(self, "_probed_at", None) != go:
+            try:
+                self.inner.seek(go)
+            except OSError:
+                return True
+            self._probed_at = go
+        return self.inner.exhausted
+
+    @property
     def watermark_col(self) -> int:
         return self.inner.watermark_col
 

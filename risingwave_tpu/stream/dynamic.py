@@ -229,7 +229,14 @@ class DynamicFilterExecutor(GrowableSortedStore, Executor):
                 wm: Watermark = msg
                 if s == LEFT:
                     if wm.col_idx != self.key_col:
-                        yield wm
+                        # ADVICE r4 #4: a dynamic filter must not forward
+                        # non-key-column watermarks — ANY threshold
+                        # movement (rising for >, falling for <) deletes
+                        # rows whose values on those columns sit below an
+                        # already-forwarded watermark, violating the
+                        # contract downstream (del_miss fail-stop on a
+                        # state-cleaned store)
+                        continue
                     elif self.op in ("greater_than",
                                      "greater_than_or_equal") \
                             and self._rhs is not None:

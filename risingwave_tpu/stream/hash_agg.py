@@ -429,9 +429,9 @@ class HashAggExecutor(Executor):
             # column (floats bitcast) — ships in TWO calls
             nd = int(n_dirty)
             if nd:
-                from ..utils.d2h import fetch_columns
-                host = fetch_columns([ops[:nd], vis[:nd]]
-                                     + [c[:nd] for c in cols])
+                from ..utils.d2h import fetch_prefix_groups
+                (host,) = fetch_prefix_groups(
+                    [([ops, vis] + list(cols), nd)])
                 self.state_table.write_chunk_columns(
                     host[0], host[2:], host[1])
         if (self.cleaning_watermark_key is not None
@@ -449,8 +449,8 @@ class HashAggExecutor(Executor):
         if not n:
             return
         # one packed fetch (same per-call d2h discipline as _persist)
-        from ..utils.d2h import fetch_columns
-        keys_np = fetch_columns([k[:n] for k in keys])
+        from ..utils.d2h import fetch_prefix_groups
+        (keys_np,) = fetch_prefix_groups([(list(keys), n)])
         width = sum(self._call_persist_width(j)
                     for j in range(len(self.specs))) + 1
         pad = (0,) * width                  # non-pk columns unused by delete

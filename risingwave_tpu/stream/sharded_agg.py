@@ -166,24 +166,21 @@ class ShardedHashAggExecutor(HashAggExecutor):
         if self.state_table is None:
             return
         if self._applied_since_flush:
-            from ..utils.d2h import fetch_columns
+            from ..utils.d2h import fetch_prefix_groups
             cols, ops, vis, n_dirty = self._persist_view_sh(self.state)
             nds = np.asarray(n_dirty)
             C = self.capacity
-            arrays, shard_nd = [], []
+            groups = []
             for sh in range(self.n_shards):
                 nd = int(nds[sh])
                 if not nd:
                     continue
                 lo = sh * C
-                arrays += [ops[lo:lo + nd], vis[lo:lo + nd]]
-                arrays += [c[lo:lo + nd] for c in cols]
-                shard_nd.append(nd)
-            if arrays:
-                host = fetch_columns(arrays)
-                w = 2 + len(cols)
-                for g, nd in enumerate(shard_nd):
-                    seg = host[g * w:(g + 1) * w]
+                groups.append((
+                    [ops[lo:lo + C], vis[lo:lo + C]]
+                    + [c[lo:lo + C] for c in cols], nd))
+            if groups:
+                for seg in fetch_prefix_groups(groups):
                     self.state_table.write_chunk_columns(
                         seg[0], seg[2:], seg[1])
         if (self.cleaning_watermark_key is not None

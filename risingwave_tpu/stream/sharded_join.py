@@ -150,7 +150,7 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
         shipped in TWO d2h calls — one counts fetch, one packed buffer
         (the per-call fetch tax would otherwise multiply by 2·S·sides)."""
         from ..common.chunk import OP_DELETE, OP_INSERT
-        from ..utils.d2h import fetch_columns
+        from ..utils.d2h import fetch_prefix_groups
         pending = []     # (side, table, [per-shard diff tuples])
         for s in (LEFT, RIGHT):
             st = self.state_tables[s]
@@ -168,23 +168,22 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
             counts = np.asarray(jnp.stack(
                 [x for _, _, diffs in pending
                  for d in diffs for x in (d[1], d[3])]))
-            arrays, ci = [], 0
+            groups, ci = [], 0
             for _, _, diffs in pending:
                 for d in diffs:
                     nd, ni = int(counts[ci]), int(counts[ci + 1])
                     ci += 2
-                    arrays += [c[:nd] for c in d[0]]
-                    arrays += [c[:ni] for c in d[2]]
-            host = fetch_columns(arrays)
-            k = ci = 0
+                    groups.append((list(d[0]), nd))
+                    groups.append((list(d[2]), ni))
+            fetched = fetch_prefix_groups(groups)
+            gi = ci = 0
             for _, st, diffs in pending:
                 for d in diffs:
                     nd, ni = int(counts[ci]), int(counts[ci + 1])
                     ci += 2
-                    del_cols = host[k:k + len(d[0])]
-                    k += len(d[0])
-                    ins_cols = host[k:k + len(d[2])]
-                    k += len(d[2])
+                    del_cols = fetched[gi]
+                    ins_cols = fetched[gi + 1]
+                    gi += 2
                     if nd:
                         st.write_chunk_columns(
                             np.full(nd, OP_DELETE, dtype=np.int8),

@@ -676,24 +676,24 @@ class SortedJoinExecutor(Executor):
         whole diff ships in TWO calls — one for the two counts, one for
         every changed row packed into a single int64 buffer (floats
         bitcast). A naive per-column fetch cost 5-9s per barrier."""
-        from ..utils.d2h import fetch_columns
+        from ..utils.d2h import fetch_prefix_groups
         del_cols, n_del, ins_cols, n_ins = self._diff(cur, snap)
         counts = np.asarray(jnp.stack([n_del, n_ins]))
         nd, ni = int(counts[0]), int(counts[1])
         if not nd and not ni:
             return
-        host = fetch_columns([c[:nd] for c in del_cols]
-                             + [c[:ni] for c in ins_cols])
+        dels, inss = fetch_prefix_groups(
+            [(list(del_cols), nd), (list(ins_cols), ni)])
         # deletes strictly before inserts: an updated row (same pk,
         # new values) diffs as delete(old)+insert(new) on one key
         if nd:
             st.write_chunk_columns(
                 np.full(nd, OP_DELETE, dtype=np.int8),
-                host[:len(del_cols)], np.ones(nd, dtype=bool))
+                dels, np.ones(nd, dtype=bool))
         if ni:
             st.write_chunk_columns(
                 np.full(ni, OP_INSERT, dtype=np.int8),
-                host[len(del_cols):], np.ones(ni, dtype=bool))
+                inss, np.ones(ni, dtype=bool))
 
     def _recover_reset(self, s: int, rows: list) -> None:
         """Size a side for recovery and reset it to empty (the sharded

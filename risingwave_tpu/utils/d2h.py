@@ -54,3 +54,31 @@ def fetch_columns(arrays) -> list[np.ndarray]:
     """Pack + single fetch + unpack."""
     flat, metas = pack_for_fetch(arrays)
     return unpack_fetched(np.asarray(flat), metas)
+
+
+def _bucket(n: int, cap: int) -> int:
+    if n <= 0:
+        return 0
+    return min(1 << (n - 1).bit_length(), cap)
+
+
+def fetch_prefix_groups(groups) -> list:
+    """groups: [(full_arrays, n_prefix)] -> list of lists of np arrays
+    trimmed to n_prefix, via ONE packed fetch. Slice lengths bucket to
+    powers of two so the eager slice/concat SHAPES repeat across
+    barriers — every fresh shape signature costs a compile round trip
+    (~1-3s on the tunneled link), which exact per-epoch lengths would
+    pay at every single barrier."""
+    sliced, meta = [], []
+    for arrays, n in groups:
+        cap = int(arrays[0].shape[0]) if arrays else 0
+        b = _bucket(int(n), cap)
+        for a in arrays:
+            sliced.append(a[:b])
+        meta.append((len(arrays), int(n)))
+    host = fetch_columns(sliced)
+    out, i = [], 0
+    for cnt, n in meta:
+        out.append([h[:n] for h in host[i:i + cnt]])
+        i += cnt
+    return out
