@@ -23,6 +23,12 @@ def _rand_scalar(rng, depth=0):
         if rng.random() < 0.5:
             return rng.choice(INT_COLS)
         return str(rng.randint(0, 1000))
+    r = rng.random()
+    if r < 0.15:
+        # CASE over a random predicate (round-5 grammar breadth)
+        return (f"(CASE WHEN {_rand_pred(rng, 1)} "
+                f"THEN {_rand_scalar(rng, depth + 1)} "
+                f"ELSE {_rand_scalar(rng, depth + 1)} END)")
     op = rng.choice(["+", "-", "*", "+", "-"])
     return (f"({_rand_scalar(rng, depth + 1)} {op} "
             f"{_rand_scalar(rng, depth + 1)})")
@@ -30,6 +36,16 @@ def _rand_scalar(rng, depth=0):
 
 def _rand_pred(rng, depth=0):
     if depth >= 2 or rng.random() < 0.5:
+        r = rng.random()
+        if r < 0.15:
+            vals = ", ".join(str(rng.randint(0, 9))
+                             for _ in range(rng.randint(1, 3)))
+            neg = "NOT " if rng.random() < 0.5 else ""
+            return (f"(({rng.choice(INT_COLS)} % 10) "
+                    f"{neg}IN ({vals}))")
+        if r < 0.25:
+            neg = " NOT" if rng.random() < 0.5 else ""
+            return f"({_rand_scalar(rng, 1)} IS{neg} NULL)"
         cmp_op = rng.choice(["<", "<=", ">", ">=", "=", "<>"])
         return (f"({_rand_scalar(rng, 1)} {cmp_op} "
                 f"{_rand_scalar(rng, 1)})")
@@ -47,10 +63,14 @@ def _rand_query(rng, i):
         return f"SELECT {items} FROM raw{where}", False
     # aggregate query
     key = f"({rng.choice(INT_COLS)} % {rng.randint(2, 9)})"
-    aggs = ", ".join(
-        f"{rng.choice(['count', 'sum', 'min', 'max'])}"
-        f"({_rand_scalar(rng, 1)}) AS a{j}"
-        for j in range(rng.randint(1, 2)))
+    def agg_term(j):
+        fn = rng.choice(["count", "sum", "min", "max", "bool_and",
+                         "bool_or"])
+        arg = (_rand_pred(rng, 1) if fn.startswith("bool")
+               else _rand_scalar(rng, 1))
+        return f"{fn}({arg}) AS a{j}"
+
+    aggs = ", ".join(agg_term(j) for j in range(rng.randint(1, 2)))
     where = f" WHERE {_rand_pred(rng)}" if rng.random() < 0.5 else ""
     return (f"SELECT {key} AS k, {aggs} FROM raw{where} GROUP BY {key}",
             True)
