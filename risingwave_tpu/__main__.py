@@ -10,6 +10,8 @@ durable Hummock store under DIR and survives restarts. Meta commands:
     \\tick [n]    advance n barrier rounds now
     \\mvs         list materialized views
     \\metrics     dump the metrics registry
+    \\trace       recent per-epoch barrier spans
+    \\stacks      await-tree dump of every live task
     \\q           quit
 """
 
@@ -85,6 +87,12 @@ async def repl(args) -> None:
                     print(f"  {name}: {', '.join(mv.schema.names)}")
             elif parts[0] == "\\metrics":
                 print(GLOBAL_METRICS.render())
+            elif parts[0] == "\\trace":
+                for t in session.coord.tracer.recent():
+                    print(t.render())
+            elif parts[0] == "\\stacks":
+                from risingwave_tpu.utils.trace import dump_task_tree
+                print(dump_task_tree())
             else:
                 print(f"unknown meta command {parts[0]}")
             continue

@@ -64,6 +64,13 @@ class BarrierCoordinator:
         from ..utils.metrics import GLOBAL_METRICS
         self._metrics_latency = GLOBAL_METRICS.histogram(
             "meta_barrier_latency_seconds")
+        # per-epoch spans (utils/trace.py — the reference's barrier
+        # TracingContext + grafana trace panel analogue)
+        from ..utils.trace import EpochTracer
+        self.tracer = EpochTracer()
+        # print ONE stuck-barrier diagnosis (spans + await tree) when a
+        # collection exceeds this many seconds; None disables
+        self.stuck_report_s: float | None = 60.0
 
     # -------------------------------------------------------- registration
     def register_source(self, queue: asyncio.Queue) -> None:
@@ -77,6 +84,7 @@ class BarrierCoordinator:
         st = self._epochs.get(barrier.epoch.curr)
         if st is None:
             return
+        self.tracer.collect(barrier.epoch.curr, actor_id)
         st.remaining.discard(actor_id)
         if not st.remaining:
             st.done.set()
@@ -105,14 +113,38 @@ class BarrierCoordinator:
         barrier = Barrier(epoch, kind, mutation, (), time.monotonic_ns())
         self._epochs[curr] = EpochState(barrier, set(self.actor_ids))
         self._prev_epoch = curr
+        self.tracer.begin(curr)
         for q in self.source_queues:
             await q.put(barrier)
         return barrier
 
     async def wait_collected(self, barrier: Barrier) -> None:
         st = self._epochs[barrier.epoch.curr]
-        await st.done.wait()
+        if self.stuck_report_s is None:
+            await st.done.wait()
+        else:
+            # one wait task serves both phases: no shield/wait_for
+            # (which would orphan a pending task on timeout or ^C)
+            waiter = asyncio.ensure_future(st.done.wait())
+            try:
+                done, _ = await asyncio.wait(
+                    {waiter}, timeout=self.stuck_report_s)
+                if not done:
+                    # stuck-barrier diagnosis ONCE (reference: risectl
+                    # await-tree dump for hung barriers), keep waiting
+                    from ..utils.trace import format_stuck_barrier_report
+                    print(f"[stuck barrier] epoch {barrier.epoch.curr} "
+                          f"not collected after {self.stuck_report_s}s; "
+                          f"remaining actors {sorted(st.remaining)}\n"
+                          + format_stuck_barrier_report(self), flush=True)
+                await waiter
+            finally:
+                if not waiter.done():
+                    waiter.cancel()
         if self._failure is not None:
+            # close the span before raising — the FAILED epoch's trace
+            # is exactly what a post-mortem \trace wants to show
+            self.tracer.end(barrier.epoch.curr)
             actor_id, exc = self._failure
             raise RuntimeError(
                 f"actor {actor_id} died; epoch {barrier.epoch.curr} cannot "
@@ -130,8 +162,13 @@ class BarrierCoordinator:
                 from ..common.types import persist_dict_delta
                 self.dict_cursor = persist_dict_delta(
                     objects, self.dict_cursor)
+            t_sync = time.monotonic_ns()
             self.store.sync(barrier.epoch.prev)
             self.committed_epochs.append(barrier.epoch.prev)
+            self.tracer.end(barrier.epoch.curr,
+                            sync_ns=time.monotonic_ns() - t_sync)
+        else:
+            self.tracer.end(barrier.epoch.curr)
         lat_ns = time.monotonic_ns() - barrier.inject_time_ns
         self.latencies_ns.append(lat_ns)
         self._metrics_latency.observe(lat_ns / 1e9)

@@ -1,0 +1,129 @@
+"""Epoch spans + async stack dumps — the tracing/await-tree analogue.
+
+Reference: (a) barriers carry a TracingContext so each epoch is a
+distributed trace spanning meta -> CN actors (common/src/util/tracing.rs,
+executor/mod.rs:267, actor.rs:195-240); (b) every actor future is
+await-tree-instrumented and dumpable via the MonitorService for
+stuck-barrier debugging (stream_manager.rs:66).
+
+Single-process TPU analogue:
+  * EpochTrace — per-epoch spans recorded by the barrier coordinator:
+    inject time, per-actor collect times, sync duration. A slow epoch's
+    trace shows WHICH actor held the barrier.
+  * dump_task_tree() — the await-tree: every asyncio task's current
+    await stack, so a stuck barrier shows exactly which executor
+    coroutine is parked where (channel recv, credit wait, device fence).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import traceback
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class EpochTrace:
+    epoch: int
+    inject_ns: int
+    collects: list = field(default_factory=list)   # (actor_id, ns_after)
+    sync_ns: int = 0                               # store sync duration
+    total_ns: int = 0
+
+    def render(self) -> str:
+        lines = [f"epoch {self.epoch}: total "
+                 f"{self.total_ns / 1e6:.1f}ms, sync "
+                 f"{self.sync_ns / 1e6:.1f}ms"]
+        for actor_id, dt in sorted(self.collects, key=lambda x: x[1]):
+            lines.append(f"  actor {actor_id} collected at "
+                         f"+{dt / 1e6:.1f}ms")
+        return "\n".join(lines)
+
+
+class EpochTracer:
+    """Ring of recent epoch traces (the Grafana trace panel stand-in)."""
+
+    def __init__(self, keep: int = 64):
+        self._ring: deque[EpochTrace] = deque(maxlen=keep)
+        self._open: dict[int, EpochTrace] = {}
+
+    def begin(self, epoch: int) -> None:
+        self._open[epoch] = EpochTrace(epoch, time.monotonic_ns())
+
+    def collect(self, epoch: int, actor_id: int) -> None:
+        t = self._open.get(epoch)
+        if t is not None:
+            t.collects.append(
+                (actor_id, time.monotonic_ns() - t.inject_ns))
+
+    def end(self, epoch: int, sync_ns: int = 0) -> None:
+        t = self._open.pop(epoch, None)
+        if t is not None:
+            t.total_ns = time.monotonic_ns() - t.inject_ns
+            t.sync_ns = sync_ns
+            self._ring.append(t)
+
+    def recent(self, n: int = 8) -> list[EpochTrace]:
+        return list(self._ring)[-n:]
+
+    def open_traces(self) -> list[EpochTrace]:
+        """In-flight (uncollected) epochs — THE data for a stuck
+        barrier: which actors already collected, and when."""
+        out = []
+        now = time.monotonic_ns()
+        for t in self._open.values():
+            t.total_ns = now - t.inject_ns
+            out.append(t)
+        return sorted(out, key=lambda t: t.epoch)
+
+    def slowest(self, n: int = 3) -> list[EpochTrace]:
+        return sorted(self._ring, key=lambda t: -t.total_ns)[:n]
+
+
+def dump_task_tree(limit_frames: int = 6) -> str:
+    """Await stacks of every live asyncio task (await-tree analogue:
+    risectl's stack dump for stuck-barrier debugging). Safe to call from
+    inside the loop; excludes the calling task's own dump frames."""
+    out = []
+    try:
+        current = asyncio.current_task()
+        tasks = asyncio.all_tasks()
+    except RuntimeError:
+        return "(no running event loop)"
+    for task in sorted(tasks,
+                       key=lambda t: t.get_name()):
+        if task is current:
+            continue
+        out.append(f"task {task.get_name()}"
+                   f"{' <cancelled>' if task.cancelled() else ''}:")
+        frames = task.get_stack(limit=limit_frames)
+        if not frames:
+            out.append("  (no frames: done or not started)")
+            continue
+        for f in frames:
+            code = f.f_code
+            out.append(f"  {code.co_filename.rsplit('/', 1)[-1]}"
+                       f":{f.f_lineno} {code.co_name}")
+    return "\n".join(out)
+
+
+def format_stuck_barrier_report(coord) -> str:
+    """One-call diagnosis: the STUCK epochs' partial spans (who already
+    collected, and when), recent completed spans, and the await tree.
+    (What the reference gets from `risectl trace` + await-tree dump.)"""
+    tracer = getattr(coord, "tracer", None)
+    lines = []
+    if tracer is not None:
+        stuck = tracer.open_traces()
+        if stuck:
+            lines.append("== in-flight (stuck) epochs ==")
+            for t in stuck:
+                lines.append(t.render())
+        lines.append("== recent completed epochs ==")
+        for t in tracer.recent():
+            lines.append(t.render())
+    lines.append("== await tree ==")
+    lines.append(dump_task_tree())
+    return "\n".join(lines)
