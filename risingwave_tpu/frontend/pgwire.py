@@ -207,6 +207,9 @@ class PgServer:
                     for row in rows:
                         self._data_row(writer, row)
                     writer.write(_msg(b"C", _cstr(f"SELECT {len(rows)}")))
+                elif isinstance(stmt, ast.Insert):
+                    n = await self.session.execute(part)
+                    writer.write(_msg(b"C", _cstr(f"INSERT 0 {n}")))
                 else:
                     await self.session.execute(part)
                     writer.write(_msg(b"C", _cstr(_tag_of(stmt))))
@@ -348,6 +351,12 @@ class PgServer:
             for row in rows:
                 self._data_row(writer, row)
             writer.write(_msg(b"C", _cstr(f"SELECT {len(rows)}")))
+        elif isinstance(stmt, ast.Insert):
+            try:
+                n = await self.session.execute(p["sql"])
+            except (BindError, SqlError) as e:
+                raise _PgUserError("42601", str(e))
+            writer.write(_msg(b"C", _cstr(f"INSERT 0 {n}")))
         else:
             try:
                 await self.session.execute(p["sql"])
@@ -468,6 +477,8 @@ def _substitute_params(sql_text: str, params: list, oids=()) -> str:
 
 
 def _tag_of(stmt) -> str:
+    if isinstance(stmt, ast.CreateTable):
+        return "CREATE_TABLE"
     if isinstance(stmt, ast.CreateSource):
         return "CREATE_SOURCE"
     if isinstance(stmt, ast.CreateMV):

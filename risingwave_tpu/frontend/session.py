@@ -276,6 +276,31 @@ class Session:
             return out
         if isinstance(stmt, ast.AlterParallelism):
             return await self.alter_parallelism(stmt.name, stmt.parallelism)
+        if isinstance(stmt, ast.CreateTable):
+            # a DML-able BASE TABLE (reference: CREATE TABLE + dml.rs +
+            # TableSource): composed from the jsonl source (the
+            # append-only file IS the durable DML log — replayable
+            # offsets, open-vocabulary dict durability included) plus an
+            # auto-materialization so batch SELECTs and MV-on-MV work.
+            # Both sub-DDLs land in the catalog log, so recovery replays
+            # them in order.
+            if stmt.name in self.catalog.sources \
+                    or stmt.name in self.catalog.mvs:
+                raise BindError(f"{stmt.name!r} already exists")
+            colspec = ", ".join(f"{n} {t}" for n, t in stmt.columns)
+            path = self._dml_path(stmt.name)
+            # TRUNCATE: a re-created table must not resurrect a dropped
+            # incarnation's rows (recovery replays the SOURCE DDL, not
+            # CreateTable, so replay never truncates)
+            open(path, "w").close()
+            await self.execute(
+                f"CREATE SOURCE {stmt.name} WITH (connector='jsonl', "
+                f"path='{path}', columns='{colspec}')")
+            return await self.execute(
+                f"CREATE MATERIALIZED VIEW {stmt.name} AS "
+                f"SELECT * FROM {stmt.name}")
+        if isinstance(stmt, ast.Insert):
+            return self._insert(stmt)
         if isinstance(stmt, ast.Explain):
             return self.explain(stmt.stmt)
         if isinstance(stmt, ast.Show):
@@ -289,6 +314,74 @@ class Session:
         if isinstance(stmt, ast.Select):
             return self.query_select(stmt)
         raise BindError(f"unsupported statement {stmt!r}")
+
+    def _dml_path(self, table: str) -> str:
+        """Stable per-table DML log path: inside the durable store's
+        root when there is one (survives restarts), else a
+        session-stable temp dir (in-process recovery reuses it)."""
+        import os
+        import tempfile
+        objects = getattr(self.store, "objects", None)
+        root = getattr(objects, "root", None) if objects else None
+        if root is None:
+            root = getattr(self.store, "_dml_dir", None)
+            if root is None:
+                root = tempfile.mkdtemp(prefix="rwtpu_dml_")
+                self.store._dml_dir = root
+        d = os.path.join(root, "dml")
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"{table}.jsonl")
+
+    def _insert(self, stmt: ast.Insert) -> int:
+        """INSERT INTO <jsonl-backed table> VALUES ... — append whole
+        JSON lines; the tailing source picks them up at the next
+        barrier (reference: dml.rs rows ride a channel into the
+        TableSource; exactly-once from the committed line offset)."""
+        src = self.catalog.sources.get(stmt.name)
+        if src is None or src.options.get("connector") != "jsonl":
+            raise BindError(
+                f"{stmt.name!r} is not an INSERT-able table (CREATE "
+                "TABLE name (col type, ...) or a jsonl source)")
+        from ..common.types import DataType
+        names = list(src.schema.names)
+        lines = []
+        for row in stmt.rows:
+            if len(row) != len(names):
+                raise BindError(
+                    f"INSERT row has {len(row)} values, table "
+                    f"{stmt.name!r} has {len(names)} columns")
+            obj = {}
+            for f, v in zip(src.schema, row):
+                if isinstance(v, ast.UnOp) and v.op == "neg" \
+                        and isinstance(v.arg, ast.Lit) \
+                        and isinstance(v.arg.value, (int, float)):
+                    val = -v.arg.value
+                elif isinstance(v, ast.Lit):
+                    val = v.value
+                else:
+                    raise BindError("INSERT VALUES must be literals")
+                if val is None:
+                    continue
+                dt = f.data_type
+                ok = (isinstance(val, str)
+                      if dt in (DataType.VARCHAR, DataType.BYTEA,
+                                DataType.JSONB)
+                      else isinstance(val, bool)
+                      if dt is DataType.BOOLEAN
+                      else isinstance(val, (int, float))
+                      and not isinstance(val, bool)
+                      if dt.is_float
+                      else isinstance(val, int)
+                      and not isinstance(val, bool))
+                if not ok:
+                    raise BindError(
+                        f"INSERT value {val!r} does not fit column "
+                        f"{f.name} ({dt.value})")
+                obj[f.name] = val
+            lines.append(json.dumps(obj))
+        with open(src.options["path"], "a") as f:
+            f.write("".join(ln + "\n" for ln in lines))
+        return len(lines)
 
     def explain(self, stmt) -> list:
         """EXPLAIN: plan WITHOUT deploying, return the fragment graph as

@@ -29,7 +29,7 @@ KEYWORDS = {
     "inner", "over", "partition", "rows", "unbounded", "preceding",
     "current", "row", "for", "system_time", "of", "proctime",
     "case", "when", "then", "else", "end", "in", "is",
-    "explain", "show",
+    "explain", "show", "insert", "into", "values",
 }
 
 _TOKEN_RE = re.compile(r"""
@@ -151,6 +151,18 @@ class WindowFunc:
 class SetVar:
     name: str
     value: object
+
+
+@dataclass
+class CreateTable:
+    name: str
+    columns: list       # [(name, type_str)]
+
+
+@dataclass
+class Insert:
+    name: str
+    rows: list          # [[literal values]]
 
 
 @dataclass
@@ -276,8 +288,46 @@ class Parser:
             n = int(self.expect("num").val)
             self.accept("op", ";")
             return AlterParallelism(name, n)
+        if self.accept("kw", "insert"):
+            self.expect("kw", "into")
+            name = self.expect("ident").val
+            self.expect("kw", "values")
+            rows = []
+            while True:
+                self.expect("op", "(")
+                row = [self._expr()]
+                while self.accept("op", ","):
+                    row.append(self._expr())
+                self.expect("op", ")")
+                rows.append(row)
+                if not self.accept("op", ","):
+                    break
+            self.accept("op", ";")
+            return Insert(name, rows)
         if self.accept("kw", "create"):
-            if self.accept("kw", "source") or self.accept("kw", "table"):
+            if self.accept("kw", "table"):
+                name = self.expect("ident").val
+                t = self.peek()
+                if t.kind == "op" and t.val == "(":
+                    # CREATE TABLE name (col type, ...) — a DML-able
+                    # base table (reference: CREATE TABLE + dml.rs)
+                    self.next()
+                    cols = []
+                    while True:
+                        cn = self.expect("ident").val
+                        ct = self.next().val
+                        cols.append((cn, ct))
+                        if not self.accept("op", ","):
+                            break
+                    self.expect("op", ")")
+                    self.accept("op", ";")
+                    return CreateTable(name, cols)
+                # legacy: CREATE TABLE name WITH (...) = CREATE SOURCE
+                self.expect("kw", "with")
+                opts = self._with_options()
+                self.accept("op", ";")
+                return CreateSource(name, opts)
+            if self.accept("kw", "source"):
                 return self._create_source()
             if self.accept("kw", "sink"):
                 name = self.expect("ident").val
