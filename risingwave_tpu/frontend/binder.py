@@ -163,6 +163,9 @@ class StreamPlanner:
         projects) never mutate a shared root."""
         if not hasattr(self, "_source_frags"):
             self._source_frags = {}
+        if not hasattr(self, "used_sources"):
+            self.used_sources = set()
+        self.used_sources.add(name)
         if name not in self._source_frags:
             src = self.catalog.source(name)
             node = Node("nexmark_source", dict(src.options, durable=True))

@@ -479,6 +479,8 @@ def _substitute_params(sql_text: str, params: list, oids=()) -> str:
 def _tag_of(stmt) -> str:
     if isinstance(stmt, ast.CreateTable):
         return "CREATE_TABLE"
+    if isinstance(stmt, ast.Drop):
+        return "DROP_" + stmt.kind.upper()
     if isinstance(stmt, ast.CreateSource):
         return "CREATE_SOURCE"
     if isinstance(stmt, ast.CreateMV):

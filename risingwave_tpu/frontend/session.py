@@ -48,6 +48,7 @@ class SinkDef:
     sink_fragment: int
     upstream_taps: tuple = ()
     sql: str = ""
+    sources: tuple = ()                # source names this sink reads
 
     @property
     def executor(self):
@@ -67,6 +68,7 @@ class MvDef:
     sql: str = ""                      # original DDL (durable catalog)
     append_only: bool = False          # changelog has no retractions
     parallelism: int = 1
+    sources: tuple = ()                # source names this MV reads
 
     @property
     def table(self):
@@ -276,6 +278,8 @@ class Session:
             return out
         if isinstance(stmt, ast.AlterParallelism):
             return await self.alter_parallelism(stmt.name, stmt.parallelism)
+        if isinstance(stmt, ast.Drop):
+            return await self._drop(stmt)
         if isinstance(stmt, ast.CreateTable):
             # a DML-able BASE TABLE (reference: CREATE TABLE + dml.rs +
             # TableSource): composed from the jsonl source (the
@@ -295,7 +299,7 @@ class Session:
             open(path, "w").close()
             await self.execute(
                 f"CREATE SOURCE {stmt.name} WITH (connector='jsonl', "
-                f"path='{path}', columns='{colspec}')")
+                f"path='{path}', columns='{colspec}', is_table=1)")
             return await self.execute(
                 f"CREATE MATERIALIZED VIEW {stmt.name} AS "
                 f"SELECT * FROM {stmt.name}")
@@ -314,6 +318,53 @@ class Session:
         if isinstance(stmt, ast.Select):
             return self.query_select(stmt)
         raise BindError(f"unsupported statement {stmt!r}")
+
+    async def _drop(self, stmt: ast.Drop) -> str:
+        """DROP ... (reference: handler/drop_*.rs; dependents refuse)."""
+        kind, name = stmt.kind, stmt.name
+        if kind == "sink":
+            if name not in self.catalog.sinks:
+                raise BindError(f"unknown sink {name!r}")
+            await self.drop_sink(name)
+            return "DROP_SINK"
+        if kind == "materialized_view":
+            if name not in self.catalog.mvs:
+                raise BindError(f"unknown materialized view {name!r}")
+            await self.drop_mv(name)
+            return "DROP_MATERIALIZED_VIEW"
+        # table = its auto-materialization + its source; source = just
+        # the catalog entry (a source has no running deployment of its
+        # own — deployments embed their connector at build time).
+        # Dependent MVs/sinks refuse the drop: their DDL-log entries
+        # could never replay after the source entry is pruned.
+        src = self.catalog.sources.get(name)
+        if src is None:
+            raise BindError(f"unknown {kind} {name!r}")
+        is_table = bool(src.options.get("is_table"))
+        if kind == "table" and not (is_table and name in self.catalog.mvs):
+            raise BindError(f"{name!r} is not a table")
+        if kind == "source" and is_table:
+            raise BindError(f"{name!r} is a table (use DROP TABLE)")
+        deps = [d.name
+                for d in (list(self.catalog.mvs.values())
+                          + list(self.catalog.sinks.values()))
+                if name in getattr(d, "sources", ()) and d.name != name]
+        if deps:
+            raise BindError(f"cannot drop {name!r}: {deps} read it")
+        if kind == "table":
+            await self.drop_mv(name)
+        self.catalog.sources.pop(name, None)
+        self._ddl_log = [e for e in self._ddl_log
+                         if not (e["kind"] == "source"
+                                 and e["name"] == name)]
+        self._persist_catalog()
+        if is_table:
+            import os as _os
+            try:
+                _os.remove(src.options["path"])
+            except OSError:
+                pass
+        return "DROP_TABLE" if kind == "table" else "DROP_SOURCE"
 
     def _dml_path(self, table: str) -> str:
         """Stable per-table DML log path: inside the durable store's
@@ -451,6 +502,8 @@ class Session:
                     raise BindError(
                         f"primary_key {pk_name!r} not a column")
                 args["primary_key"] = list(schema.names).index(pk_name)
+            if "is_table" in opts:
+                args["is_table"] = bool(int(opts.pop("is_table")))
             if opts:
                 raise BindError(
                     f"unknown jsonl options {sorted(opts)}")
@@ -534,7 +587,9 @@ class Session:
                        upstream_taps=tuple(self.env.pending_taps),
                        sql=sql_text,
                        append_only=getattr(plan, "append_only", False),
-                       parallelism=parallelism)
+                       parallelism=parallelism,
+                       sources=tuple(sorted(
+                           getattr(planner, "used_sources", ()))))
             self.catalog.mvs[stmt.name] = mv
         # bring the new dataflow up: the first MV gets the Initial
         # barrier; later MVs initialize on the next ordinary barrier.
@@ -561,7 +616,9 @@ class Session:
             dep.spawn()
             sink = SinkDef(stmt.name, plan.schema, dep, plan.mv_fragment,
                            upstream_taps=tuple(self.env.pending_taps),
-                           sql=sql_text)
+                           sql=sql_text,
+                           sources=tuple(sorted(
+                               getattr(planner, "used_sources", ()))))
             self.catalog.sinks[stmt.name] = sink
         if not self._recovering:
             await self.coord.run_rounds(

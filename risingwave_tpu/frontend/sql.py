@@ -29,7 +29,7 @@ KEYWORDS = {
     "inner", "over", "partition", "rows", "unbounded", "preceding",
     "current", "row", "for", "system_time", "of", "proctime",
     "case", "when", "then", "else", "end", "in", "is",
-    "explain", "show", "insert", "into", "values",
+    "explain", "show", "insert", "into", "values", "drop",
 }
 
 _TOKEN_RE = re.compile(r"""
@@ -166,6 +166,12 @@ class Insert:
 
 
 @dataclass
+class Drop:
+    kind: str           # materialized_view | table | source | sink
+    name: str
+
+
+@dataclass
 class Explain:
     stmt: object
 
@@ -288,6 +294,23 @@ class Parser:
             n = int(self.expect("num").val)
             self.accept("op", ";")
             return AlterParallelism(name, n)
+        if self.accept("kw", "drop"):
+            if self.accept("kw", "materialized"):
+                self.expect("kw", "view")
+                kind = "materialized_view"
+            elif self.accept("kw", "table"):
+                kind = "table"
+            elif self.accept("kw", "source"):
+                kind = "source"
+            elif self.accept("kw", "sink"):
+                kind = "sink"
+            else:
+                raise SqlError(
+                    "DROP supports MATERIALIZED VIEW / TABLE / SOURCE "
+                    "/ SINK")
+            name = self.expect("ident").val
+            self.accept("op", ";")
+            return Drop(kind, name)
         if self.accept("kw", "insert"):
             self.expect("kw", "into")
             name = self.expect("ident").val

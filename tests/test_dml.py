@@ -90,3 +90,60 @@ async def test_insert_types_and_recreate():
     assert s.query("SELECT a, b FROM t2") == [], \
         "re-created table resurrected dropped rows"
     await s.drop_all()
+
+
+async def test_drop_statements():
+    """DROP MATERIALIZED VIEW / TABLE / SOURCE / SINK via SQL
+    (reference: handler/drop_*.rs)."""
+    import pytest
+    from risingwave_tpu.frontend.binder import BindError
+    s = Session()
+    await s.execute("CREATE SOURCE bid WITH (connector='nexmark', "
+                    "table='bid', chunk_size=128, rate_limit=128)")
+    await s.execute("CREATE MATERIALIZED VIEW m AS SELECT auction "
+                    "FROM bid")
+    await s.execute("CREATE TABLE t (a int64)")
+    await s.tick(1)
+    assert await s.execute("DROP MATERIALIZED VIEW m") \
+        == "DROP_MATERIALIZED_VIEW"
+    assert "m" not in s.catalog.mvs
+    assert await s.execute("DROP TABLE t") == "DROP_TABLE"
+    assert "t" not in s.catalog.mvs and "t" not in s.catalog.sources
+    assert await s.execute("DROP SOURCE bid") == "DROP_SOURCE"
+    assert "bid" not in s.catalog.sources
+    with pytest.raises(BindError):
+        await s.execute("DROP MATERIALIZED VIEW missing")
+    # recreate after drop works (the DDL log was pruned)
+    await s.execute("CREATE TABLE t (a int64)")
+    await s.execute("INSERT INTO t VALUES (42)")
+    await s.tick(2)
+    assert s.query("SELECT a FROM t") == [(42,)]
+    await s.drop_all()
+
+
+async def test_drop_guards():
+    """Review regressions: DROP SOURCE refuses when MVs read it; DROP
+    TABLE refuses a name that is not a table; table files clean up."""
+    import os
+    import pytest
+    from risingwave_tpu.frontend.binder import BindError
+    s = Session()
+    await s.execute("CREATE SOURCE bid WITH (connector='nexmark', "
+                    "table='bid', chunk_size=128, rate_limit=128)")
+    await s.execute("CREATE MATERIALIZED VIEW m AS SELECT auction "
+                    "FROM bid")
+    with pytest.raises(BindError):
+        await s.execute("DROP SOURCE bid")     # m reads it
+    with pytest.raises(BindError):
+        await s.execute("DROP TABLE bid")      # not a table
+    await s.execute("DROP MATERIALIZED VIEW m")
+    assert await s.execute("DROP SOURCE bid") == "DROP_SOURCE"
+
+    await s.execute("CREATE TABLE t (a int64)")
+    with pytest.raises(BindError):
+        await s.execute("DROP SOURCE t")       # table needs DROP TABLE
+    path = s.catalog.sources["t"].options["path"]
+    assert os.path.exists(path)
+    await s.execute("DROP TABLE t")
+    assert not os.path.exists(path), "dml log file leaked"
+    await s.drop_all()
