@@ -47,6 +47,11 @@ import numpy as np
 # Hard wall-clock budget for the whole bench (driver timeouts are larger;
 # this guarantees a JSON line is printed well before any external timeout).
 GLOBAL_BUDGET_S = 560.0
+# Deadline for the pre-flight jax.devices() probe (round-5 post-mortem: a
+# dead tunnel made device init hang forever inside the first query
+# subprocess, which then recorded 0.0 rows/s as "teardown abandoned" —
+# the stall must be diagnosed BEFORE any query is charged for it).
+DEVICE_PROBE_TIMEOUT_S = 120.0
 # Per-query subprocess budgets (compile + measure + baseline), seconds.
 QUERY_BUDGET_S = {"q1": 60.0, "q5": 150.0, "q7": 150.0, "q8": 170.0,
                   "q17": 150.0, "q7d": 150.0}
@@ -730,7 +735,34 @@ def _one_query_main(query: str) -> None:
     os._exit(0)
 
 
-def _emit_combined(results: dict, note: str = "") -> None:
+def _probe_device_init(timeout_s: float = DEVICE_PROBE_TIMEOUT_S):
+    """Deadline-bounded device-init probe in a SUBPROCESS.
+
+    `jax.devices()` on a sick tunneled TPU can hang indefinitely; probing
+    in-process would hang the orchestrator itself. The probe child
+    inherits the bench environment (same backend the queries will get).
+    Returns (ok, detail) — on stall/failure the caller emits
+    `device_init_stall: true` loudly instead of letting the first query
+    burn its whole budget on init and record 0.0 rows/s.
+    """
+    src = ("import jax; ds = jax.devices(); "
+           "print('DEVICES', len(ds), ds[0].platform)")
+    try:
+        p = subprocess.run([sys.executable, "-c", src],
+                           capture_output=True, text=True,
+                           timeout=timeout_s,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return False, (f"jax.devices() did not return within {timeout_s}s "
+                       f"(dead tunnel / stalled device init)")
+    if p.returncode != 0:
+        tail = (p.stderr or "").strip().splitlines()[-1:] or [""]
+        return False, f"device init failed (rc={p.returncode}): {tail[0][:200]}"
+    return True, (p.stdout or "").strip()
+
+
+def _emit_combined(results: dict, note: str = "",
+                   extra: dict = None) -> None:
     """ONE JSON line: headline = worst north-star query."""
     headline_q = None
     headline = None
@@ -756,6 +788,8 @@ def _emit_combined(results: dict, note: str = "") -> None:
         "seconds": (headline or {}).get("seconds", 0.0),
         "queries": results,
     }
+    if extra:
+        out.update(extra)
     if note:
         out["note"] = note
     print(json.dumps(out), flush=True)
@@ -788,6 +822,19 @@ def main() -> None:
     t0 = time.perf_counter()
     here = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # pre-flight: fail LOUDLY on a stalled device instead of letting the
+    # first query record 0.0 rows/s as "teardown abandoned"
+    dev_ok, dev_detail = _probe_device_init()
+    if not dev_ok:
+        for q in ("q1", "q5", "q7", "q8", "q17", "q7d"):
+            results[q] = {"note": "skipped: device init stall"}
+        killer.cancel()
+        if emit_once.acquire(blocking=False):
+            _emit_combined(
+                results,
+                note=f"DEVICE INIT STALL — no query ran: {dev_detail}",
+                extra={"device_init_stall": True})
+        return
     for q in ("q1", "q5", "q7", "q8", "q17", "q7d"):
         remaining = GLOBAL_BUDGET_S - (time.perf_counter() - t0) - 10
         if remaining <= 40:   # a query needs import+compile time to matter

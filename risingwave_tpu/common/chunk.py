@@ -189,6 +189,140 @@ def empty_chunk(schema: Schema, capacity: int = DEFAULT_CHUNK_CAPACITY) -> Strea
     return StreamChunk.from_numpy(schema, [np.zeros(0, f.data_type.np_dtype) for f in schema], capacity=capacity)
 
 
+# ------------------------------------------------------------- coalescing
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def _pad_chunk_impl(chunk: StreamChunk, out_capacity: int) -> StreamChunk:
+    """Grow a chunk to `out_capacity` with invisible rows (row order and
+    update-pair adjacency preserved — padding is strictly at the tail)."""
+    pad = out_capacity - chunk.capacity
+
+    def ext(x):
+        return jnp.concatenate([x, jnp.zeros(pad, dtype=x.dtype)])
+
+    cols = tuple(
+        Column(ext(c.data), None if c.valid is None else ext(c.valid))
+        for c in chunk.columns)
+    return StreamChunk(cols, ext(chunk.ops), ext(chunk.vis), chunk.schema)
+
+
+def _concat2_impl(a: StreamChunk, b: StreamChunk) -> StreamChunk:
+    """Concatenate two equal-schema chunks (a's rows first)."""
+    def cat(x, y):
+        return jnp.concatenate([x, y])
+
+    def cat_valid(ca: Column, cb: Column):
+        if ca.valid is None and cb.valid is None:
+            return None
+        va = ca.valid if ca.valid is not None else \
+            jnp.ones(ca.capacity, dtype=bool)
+        vb = cb.valid if cb.valid is not None else \
+            jnp.ones(cb.capacity, dtype=bool)
+        return cat(va, vb)
+
+    cols = tuple(Column(cat(ca.data, cb.data), cat_valid(ca, cb))
+                 for ca, cb in zip(a.columns, b.columns))
+    return StreamChunk(cols, cat(a.ops, b.ops), cat(a.vis, b.vis), a.schema)
+
+
+# Shared pack programs (lazy: jit_state imports jax utils; chunk.py is
+# imported by host-only code paths too). Capacities are bucketed to powers
+# of two, so the static-shape set is {pad: (2^i -> 2^j), concat: (2^j,
+# 2^j)} — O(log^2 max_capacity) programs TOTAL across all coalescers, and
+# zero recompiles once a pipeline's buckets are warm. The inputs are NOT
+# donated: dispatchers fan chunks out zero-copy (same arrays, different
+# visibility), so a pack input may be aliased by a sibling consumer.
+_PACK_PROGRAMS: dict = {}
+
+
+def _pack_programs():
+    if not _PACK_PROGRAMS:
+        from ..ops.jit_state import jit_state
+        _PACK_PROGRAMS["pad"] = jit_state(
+            _pad_chunk_impl, static_argnums=(1,), name="chunk_pad")
+        _PACK_PROGRAMS["concat2"] = jit_state(
+            _concat2_impl, name="chunk_concat2")
+    return _PACK_PROGRAMS
+
+
+class ChunkCoalescer:
+    """Packs consecutive small chunks between barriers into fewer, fuller
+    chunks — the host-loop half of making per-barrier-interval device work
+    O(1) dispatches.
+
+    Every chunk an executor sees costs one device dispatch per jitted step
+    regardless of how few visible rows it carries; sources and exchanges
+    frequently emit runs of small chunks inside one barrier interval.  The
+    coalescer buffers a run (receiver side, after the channel — it never
+    interacts with backpressure), then folds it pairwise into one chunk
+    whose capacity is the power-of-two bucket of the run's total capacity.
+    Row order is preserved (stable tail-concat), so changelog update pairs
+    stay adjacent; visibility masks carry over untouched.
+
+    The pack programs compile once per (capacity-bucket) pair and are
+    shared process-wide, so coalescing adds ZERO steady-state recompiles
+    while removing k-1 downstream dispatches per k-chunk run — per
+    stateful executor in the chain below.
+
+    Protocol: `push(chunk)` returns chunks ready to emit now (a full run,
+    or a passthrough); `flush()` drains the pending run — callers MUST
+    flush before forwarding a barrier or watermark so cross-message
+    ordering is exactly the uncoalesced stream's.
+    """
+
+    def __init__(self, max_capacity: int = 4 * DEFAULT_CHUNK_CAPACITY):
+        self.max_capacity = max(1, int(max_capacity))
+        self._pending: list[StreamChunk] = []
+        self._pending_cap = 0
+        self.packed = 0          # chunks absorbed into a merge
+        self.emitted = 0         # chunks emitted (after packing)
+
+    def push(self, chunk: StreamChunk) -> list[StreamChunk]:
+        out: list[StreamChunk] = []
+        cap = chunk.capacity
+        if cap >= self.max_capacity:
+            # too big to pack with anything: drain, then pass through
+            out.extend(self.flush())
+            out.append(chunk)
+            self.emitted += 1
+            return out
+        if self._pending:
+            head = self._pending[0]
+            schema_differs = (head.schema is not chunk.schema
+                              and head.schema != chunk.schema)
+            if (self._pending_cap + cap > self.max_capacity
+                    or schema_differs):
+                out.extend(self.flush())
+        self._pending.append(chunk)
+        self._pending_cap += cap
+        return out
+
+    def flush(self) -> list[StreamChunk]:
+        if not self._pending:
+            return []
+        run, self._pending, self._pending_cap = self._pending, [], 0
+        if len(run) == 1:
+            self.emitted += 1
+            return run
+        progs = _pack_programs()
+        merged = run[0]
+        for nxt in run[1:]:
+            # equalize to the larger power-of-two bucket, then concat —
+            # keeps every program signature inside the bucketed set
+            target = _next_pow2(max(merged.capacity, nxt.capacity))
+            if merged.capacity < target:
+                merged = progs["pad"](merged, target)
+            if nxt.capacity < target:
+                nxt = progs["pad"](nxt, target)
+            merged = progs["concat2"](merged, nxt)
+        self.packed += len(run)
+        self.emitted += 1
+        return [merged]
+
+
 class StreamChunkBuilder:
     """Host-side row accumulator emitting fixed-capacity chunks
     (reference: StreamChunkBuilder, array/stream_chunk_builder.rs).

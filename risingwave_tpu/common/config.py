@@ -12,7 +12,14 @@ analogue).
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:                                    # stdlib from 3.11; 3.10 images may
+    import tomllib                      # carry the identical `tomli` instead
+except ImportError:                     # pragma: no cover
+    try:
+        import tomli as tomllib
+    except ImportError:
+        tomllib = None
 from dataclasses import dataclass, field, fields
 from typing import Optional
 
@@ -68,6 +75,10 @@ class RwConfig:
 
     @classmethod
     def from_toml(cls, path: str) -> "RwConfig":
+        if tomllib is None:
+            raise RuntimeError(
+                "TOML config files need Python >= 3.11 (tomllib) or the "
+                "tomli package; use RwConfig.from_dict / env overrides")
         with open(path, "rb") as f:
             return cls.from_dict(tomllib.load(f))
 

@@ -124,6 +124,13 @@ class Session:
         # the in-memory hummock backend) — no per-barrier state-table
         # flush; crash recovery then replays sources from scratch
         "streaming_durability": (1, int),
+        # > 0: exchange receivers pack runs of consecutive small chunks
+        # between barriers into one chunk of up to this total capacity
+        # (power-of-two bucketed shapes, zero steady-state recompiles) —
+        # each downstream stateful executor then pays one dispatch per
+        # interval instead of one per chunk (common/chunk.py
+        # ChunkCoalescer). 0 = off.
+        "streaming_chunk_coalesce": (0, int),
     }
 
     def __init__(self, store=None):
@@ -220,6 +227,8 @@ class Session:
                 # each entry replays under ITS OWN planning-time config;
                 # entries without one (sources, old logs) use the defaults
                 self.config = {**saved_config, **entry.get("config", {})}
+                self.env.chunk_coalesce_max = self.config.get(
+                    "streaming_chunk_coalesce", 0)
                 await self.execute(entry["sql"])
         finally:
             self.config = saved_config
@@ -314,6 +323,10 @@ class Session:
                 raise BindError(f"unknown session variable {stmt.name!r}")
             _, conv = self.CONFIG_VARS[stmt.name]
             self.config[stmt.name] = conv(stmt.value)
+            if stmt.name == "streaming_chunk_coalesce":
+                # build-time knob, read by build_graph when wiring
+                # exchange receivers (plan/build.py)
+                self.env.chunk_coalesce_max = self.config[stmt.name]
             return self.config[stmt.name]
         if isinstance(stmt, ast.Select):
             return self.query_select(stmt)
@@ -734,7 +747,10 @@ class Session:
         old_cursor = self.coord.dict_cursor
         self.coord = BarrierCoordinator(self.store)
         self.coord.dict_cursor = old_cursor
-        self.env = BuildEnv(self.store, self.coord)
+        self.env = BuildEnv(
+            self.store, self.coord,
+            chunk_coalesce_max=self.config.get(
+                "streaming_chunk_coalesce", 0))
         self.env.session = self
         self.catalog.mvs.clear()
         self.catalog.sinks.clear()
@@ -749,6 +765,8 @@ class Session:
                 # each entry replays under ITS OWN planning-time config;
                 # entries without one (sources, old logs) use the defaults
                 self.config = {**saved_config, **entry.get("config", {})}
+                self.env.chunk_coalesce_max = self.config.get(
+                    "streaming_chunk_coalesce", 0)
                 await self.execute(entry["sql"])
         finally:
             self.config = saved_config

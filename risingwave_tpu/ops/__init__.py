@@ -1,5 +1,8 @@
-"""Device kernels shared across executors: hashing, open-addressing tables."""
+"""Device kernels shared across executors: hashing, open-addressing
+tables, and the donated state-threading jit wrapper."""
 
 from .hash_table import HashTable, lookup, lookup_or_insert, needs_rebuild
+from .jit_state import StateJit, jit_state
 
-__all__ = ["HashTable", "lookup", "lookup_or_insert", "needs_rebuild"]
+__all__ = ["HashTable", "StateJit", "jit_state", "lookup",
+           "lookup_or_insert", "needs_rebuild"]

@@ -54,10 +54,14 @@ class BuildEnv:
     and the barrier coordinator being wired up."""
 
     def __init__(self, store: StateStore, coord: BarrierCoordinator,
-                 channel_capacity: int = 64):
+                 channel_capacity: int = 64, chunk_coalesce_max: int = 0):
         self.store = store
         self.coord = coord
         self.channel_capacity = channel_capacity
+        # > 0: exchange receivers (ChannelInput/Merge) pack runs of small
+        # chunks up to this total capacity into one chunk per dispatch
+        # (SET streaming_chunk_coalesce; common/chunk.py ChunkCoalescer)
+        self.chunk_coalesce_max = chunk_coalesce_max
         self._next_table_id = 1
         self._next_actor_id = 1
         # session services for cross-MV nodes (stream_scan taps); set by
@@ -226,14 +230,18 @@ def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
                     # terminate only on THIS actor's stop (a shared
                     # coordinator routes other deployments' stops here too)
                     stop_on = (lambda b, aid=ctx.actor_id: b.is_stop(aid))
+                    co = env.chunk_coalesce_max
                     if up.dispatch == "simple" and up.parallelism > 1:
                         # NoShuffle: 1:1 actor pairing
                         return ChannelInput(matrix[idx][idx], sch,
-                                            stop_on=stop_on)
+                                            stop_on=stop_on,
+                                            coalesce_max=co)
                     chans = [matrix[u][idx] for u in range(up.parallelism)]
                     if len(chans) == 1:
-                        return ChannelInput(chans[0], sch, stop_on=stop_on)
-                    return MergeExecutor(chans, sch, stop_on=stop_on)
+                        return ChannelInput(chans[0], sch, stop_on=stop_on,
+                                            coalesce_max=co)
+                    return MergeExecutor(chans, sch, stop_on=stop_on,
+                                         coalesce_max=co)
                 inputs = [build_node(i) for i in n.inputs]
                 return BUILDERS[n.kind](dict(n.args), inputs, ctx, id(n))
 

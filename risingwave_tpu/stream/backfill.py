@@ -45,6 +45,7 @@ from ..state.state_table import StateTable
 from ..state.storage_table import StorageTable
 from .executor import Executor
 from .message import Barrier, BarrierKind, Watermark
+from ..ops.jit_state import jit_state
 
 
 def backfill_progress_schema(mv_schema: Schema,
@@ -73,7 +74,7 @@ class BackfillExecutor(Executor):
         self.finished = False
         self.vnode = 0                        # vnodes < this are complete
         self.last_pk: Optional[tuple] = None  # within self.vnode
-        self._filter = jax.jit(self._filter_impl)
+        self._filter = jit_state(self._filter_impl, name="backfill_filter")
         self.snapshot_rows_total = 0
 
     # ------------------------------------------------------------ filtering

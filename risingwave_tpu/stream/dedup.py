@@ -24,6 +24,7 @@ import numpy as np
 
 from ..common.chunk import StreamChunk, OP_INSERT, op_sign
 from ..ops.hash_table import HashTable, lookup, lookup_or_insert
+from ..ops.jit_state import jit_state
 from ..state.state_table import StateTable
 from .executor import Executor, StatefulUnaryExecutor
 from .message import Barrier
@@ -44,8 +45,13 @@ class AppendOnlyDedupExecutor(StatefulUnaryExecutor):
             input.schema[i].data_type.jnp_dtype for i in self.key_indices)
         self.table = HashTable.empty(capacity, self._key_dtypes)
         self.fresh = jnp.zeros(capacity, dtype=bool)  # new since persist
-        self._apply = jax.jit(self._apply_impl)
-        self._fresh_keys = jax.jit(self._fresh_keys_impl)
+        # table, fresh bitmap, and error accumulator are threaded (the
+        # only refs are re-bound in on_chunk) — donate; _fresh_keys is a
+        # read-only persistence view, never donated
+        self._apply = jit_state(self._apply_impl, donate_argnums=(0, 1, 2),
+                                name="dedup_apply")
+        self._fresh_keys = jit_state(self._fresh_keys_impl,
+                                     name="dedup_fresh_keys")
         self._errs_dev = jnp.zeros((), dtype=jnp.int32)
         self._init_stateful(state_table, watchdog_interval)
 

@@ -28,6 +28,7 @@ from ..common.chunk import (
     Column, StreamChunk, OP_DELETE, OP_INSERT, OP_UPDATE_INSERT,
 )
 from ..common.types import DataType, Field, Schema
+from ..ops.jit_state import jit_state
 from .executor import Executor
 from .align import LEFT, RIGHT, barrier_align
 from .message import Barrier, BarrierKind, Watermark
@@ -104,12 +105,18 @@ class DynamicFilterExecutor(GrowableSortedStore, Executor):
         self.em_valids = tuple(jnp.zeros(C, dtype=bool) for _ in dts)
         self.em_n = jnp.int32(0)
         self._errs_dev = jnp.zeros(2, dtype=jnp.int32)
-        self._apply = jax.jit(partial(sorted_store_apply,
-                                      pk_idx=self.pk_indices,
-                                      capacity=self.capacity))
-        self._flush = jax.jit(self._flush_impl)
-        self._wd_pack = jax.jit(
-            lambda e, n: jnp.concatenate([e, n[None].astype(jnp.int32)]))
+        # store pytree + errs threaded (em_* is a fresh gather): donate;
+        # _flush consumes/replaces the em_* previous-emission set
+        self._apply = jit_state(
+            partial(sorted_store_apply, pk_idx=self.pk_indices,
+                    capacity=self.capacity),
+            donate_argnums=(0, 1, 2, 3, 4), name="dynamic_filter_apply")
+        self._flush = jit_state(self._flush_impl,
+                                donate_argnums=(4, 5, 6, 7),
+                                name="dynamic_filter_flush")
+        self._wd_pack = jit_state(
+            lambda e, n: jnp.concatenate([e, n[None].astype(jnp.int32)]),
+            name="dynamic_filter_wd_pack")
         self._rhs: Optional[int] = None      # host scalar (tiny rhs rows)
         self._dirty = False
         if watchdog_interval not in (None, 1):

@@ -34,6 +34,7 @@ from .executor import Executor
 from .general_over_window import GeneralOverWindowExecutor, WindowSpec
 from .message import Barrier, Watermark
 from .sorted_join import NO_WATERMARK
+from ..ops.jit_state import jit_state
 
 
 class EowcOverWindowExecutor(GeneralOverWindowExecutor):
@@ -59,7 +60,8 @@ class EowcOverWindowExecutor(GeneralOverWindowExecutor):
         self.frontier_table = frontier_table
         self._wm_pending = NO_WATERMARK
         self._emitted_to = NO_WATERMARK
-        self._flush_eowc = jax.jit(self._flush_eowc_impl)
+        self._flush_eowc = jit_state(self._flush_eowc_impl,
+                                     name="eowc_over_window_flush")
 
     # ------------------------------------------------------------- flush
     def _flush_eowc_impl(self, khash, cols, valids, n, lo, hi):

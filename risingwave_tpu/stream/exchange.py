@@ -19,11 +19,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..common.chunk import (
-    StreamChunk, OP_DELETE, OP_INSERT, OP_UPDATE_DELETE, OP_UPDATE_INSERT,
+    ChunkCoalescer, StreamChunk, OP_DELETE, OP_INSERT, OP_UPDATE_DELETE,
+    OP_UPDATE_INSERT,
 )
 from ..common.vnode import VNODE_COUNT, compute_vnodes
 from .executor import Executor
 from .message import Barrier, Message, Watermark
+from ..ops.jit_state import jit_state
 
 
 class Channel:
@@ -118,7 +120,10 @@ class HashDispatcher(Dispatcher):
         # a captured device array costs ~3ms per invocation on a tunneled
         # TPU (re-validated constant buffer), an argument ~30us
         self.vnode_to_output = jnp.asarray(vnode_to_output, dtype=jnp.int32)
-        self._route = jax.jit(self._route_impl)
+        # NO donation: route outputs are zero-copy views of the input
+        # chunk, which other consumers may still hold
+        self._route = jit_state(self._route_impl,
+                                name="hash_dispatch_route")
 
     def _route_impl(self, chunk: StreamChunk, vnode_to_output):
         keys = [chunk.columns[i].data for i in self.dist_key_indices]
@@ -161,16 +166,30 @@ class ChannelInput(Executor):
     killed the chain. Default (None) keeps the standalone/test behavior:
     any Stop ends the stream."""
 
-    def __init__(self, channel: Channel, schema, stop_on=None):
+    def __init__(self, channel: Channel, schema, stop_on=None,
+                 coalesce_max: int = 0):
         self.channel = channel
         self.schema = schema
         self.stop_on = stop_on
+        # coalesce_max > 0: pack runs of consecutive chunks up to that
+        # total capacity into one chunk (flushed before any barrier/
+        # watermark, so cross-message ordering is the uncoalesced one)
+        self.coalescer = (ChunkCoalescer(coalesce_max) if coalesce_max
+                          else None)
         self.identity = "ChannelInput"
 
     async def execute(self):
         from .message import StopMutation
+        co = self.coalescer
         while True:
             msg = await self.channel.recv()
+            if co is not None:
+                if isinstance(msg, StreamChunk):
+                    for out in co.push(msg):
+                        yield out
+                    continue
+                for out in co.flush():
+                    yield out
             yield msg
             if isinstance(msg, Barrier)                     and isinstance(msg.mutation, StopMutation):
                 if self.stop_on is None or self.stop_on(msg):
@@ -182,14 +201,21 @@ class MergeExecutor(Executor):
     yields a barrier is blocked until every upstream yields that barrier,
     then ONE barrier is emitted. Watermarks are min-combined per column."""
 
-    def __init__(self, channels: Sequence[Channel], schema, stop_on=None):
+    def __init__(self, channels: Sequence[Channel], schema, stop_on=None,
+                 coalesce_max: int = 0):
         self.channels = list(channels)
         self.schema = schema
         self.stop_on = stop_on            # see ChannelInput.stop_on
+        # fan-in is where small-chunk runs concentrate (N upstream actors
+        # interleave inside one barrier interval): one coalescer packs the
+        # combined stream, flushed before any barrier/watermark emission
+        self.coalescer = (ChunkCoalescer(coalesce_max) if coalesce_max
+                          else None)
         self.identity = f"Merge({len(self.channels)})"
 
     async def execute(self):
         n = len(self.channels)
+        co = self.coalescer
         getters: dict[int, asyncio.Task] = {
             i: asyncio.create_task(c.recv()) for i, c in enumerate(self.channels)}
         pending_barrier: dict[int, Barrier] = {}
@@ -204,6 +230,9 @@ class MergeExecutor(Executor):
                     stop = (isinstance(barrier.mutation, StopMutation)
                             and (self.stop_on is None
                                  or self.stop_on(barrier)))
+                    if co is not None:
+                        for out in co.flush():
+                            yield out
                     yield barrier
                     pending_barrier.clear()
                     if stop:
@@ -222,10 +251,17 @@ class MergeExecutor(Executor):
                         wm = self._combined_watermark(msg.col_idx, watermarks)
                         if wm is not None and emitted_wm.get(msg.col_idx) != wm.val:
                             emitted_wm[msg.col_idx] = wm.val
+                            if co is not None:
+                                for out in co.flush():
+                                    yield out
                             yield wm
                         getters[i] = asyncio.create_task(self.channels[i].recv())
                     else:
-                        yield msg
+                        if co is not None:
+                            for out in co.push(msg):
+                                yield out
+                        else:
+                            yield msg
                         getters[i] = asyncio.create_task(self.channels[i].recv())
         finally:
             for t in getters.values():

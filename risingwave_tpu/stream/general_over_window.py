@@ -40,6 +40,7 @@ import numpy as np
 from ..common.chunk import Column, StreamChunk, OP_DELETE, OP_INSERT
 from ..common.types import DataType, Field, Schema
 from ..ops.hash_table import stable_lexsort
+from ..ops.jit_state import jit_state
 from .executor import Executor, StatefulUnaryExecutor
 from .message import Barrier, Watermark
 from .sorted_join import _HSENTINEL, key_hash
@@ -122,13 +123,20 @@ class GeneralOverWindowExecutor(GrowableSortedStore,
         self.em_valids = tuple(jnp.zeros(C, dtype=bool) for _ in out_dts)
         self.em_n = jnp.int32(0)
         self._errs_dev = jnp.zeros(2, dtype=jnp.int32)
-        self._apply = jax.jit(partial(sorted_store_apply,
-                                      pk_idx=self.pk_indices,
-                                      capacity=self.capacity))
+        # store pytree + errs threaded (em_* is a fresh gather): donate;
+        # _flush consumes/replaces the em_* previous-emission set
+        self._apply = jit_state(
+            partial(sorted_store_apply, pk_idx=self.pk_indices,
+                    capacity=self.capacity),
+            donate_argnums=(0, 1, 2, 3, 4),
+            name="general_over_window_apply")
         # ONE d2h fetch per barrier: errs and the live count ride together
-        self._wd_pack = jax.jit(
-            lambda e, n: jnp.concatenate([e, n[None].astype(jnp.int32)]))
-        self._flush = jax.jit(self._flush_impl)
+        self._wd_pack = jit_state(
+            lambda e, n: jnp.concatenate([e, n[None].astype(jnp.int32)]),
+            name="general_over_window_wd_pack")
+        self._flush = jit_state(self._flush_impl,
+                                donate_argnums=(4, 5, 6, 7),
+                                name="general_over_window_flush")
         self._epoch_chunks: list[StreamChunk] = []
         self._init_stateful(state_table, watchdog_interval)
 

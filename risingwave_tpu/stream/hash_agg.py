@@ -41,6 +41,7 @@ from ..ops.extrema import (
     extrema_underflow, extrema_update,
 )
 from ..ops.hash_table import HashTable, lookup_or_insert, needs_rebuild
+from ..ops.jit_state import jit_state
 from ..state.state_table import StateTable
 from .executor import Executor
 from .message import Barrier, BarrierKind, Watermark
@@ -112,12 +113,33 @@ class HashAggExecutor(Executor):
         self._key_dtypes = tuple(
             in_schema[i].data_type.jnp_dtype for i in self.group_key_indices)
         self.state = self._initial_state(capacity)
-        self._apply = jax.jit(self._apply_impl)
-        self._flush = jax.jit(self._flush_impl)
-        self._live_zombie = jax.jit(self._live_zombie_impl)
-        self._evict = jax.jit(self._evict_impl)
-        self._evict_keys = jax.jit(self._evict_keys_impl)
-        self._rehash = jax.jit(self._rehash_impl, static_argnums=1)
+        # State-threading programs donate the AggState pytree (and the
+        # device watchdog accumulator) so XLA updates the table buffers in
+        # place: `self.state = self._apply(self.state, ...)` is the only
+        # reference, which is the donation contract. Read-only views
+        # (_live_zombie, _evict_keys, _persist_view) must NOT donate —
+        # the state stays live after them.
+        self._apply = jit_state(self._apply_impl, donate_argnums=(0, 1),
+                                name="hash_agg_apply")
+        self._flush = jit_state(self._flush_impl, donate_argnums=(0,),
+                                name="hash_agg_flush")
+        self._live_zombie = jit_state(self._live_zombie_impl,
+                                      name="hash_agg_live_zombie")
+        self._evict = jit_state(self._evict_impl, donate_argnums=(0,),
+                                name="hash_agg_evict")
+        self._evict_keys = jit_state(self._evict_keys_impl,
+                                     name="hash_agg_evict_keys")
+        self._rehash = jit_state(self._rehash_impl, static_argnums=1,
+                                 donate_argnums=(0,), name="hash_agg_rehash")
+        self._persist_view = jit_state(self._persist_view_impl,
+                                       name="hash_agg_persist_view")
+        # multi-chunk apply: chunks buffered within a barrier interval are
+        # applied in ONE dispatch via lax.scan over a stacked batch (the
+        # sharded subclass opts out — its programs are shard_map-wrapped)
+        self._use_chunk_batching = True
+        self._batch_max = 8
+        self._pending_chunks: list[StreamChunk] = []
+        self._apply_scans: dict[int, object] = {}
         # load/overflow watchdog (see _check_watchdog). watchdog_interval =
         # barriers between watchdog fetches; None disables the fetch
         # ENTIRELY (even at stop) — on a tunneled TPU the FIRST d2h
@@ -141,8 +163,9 @@ class HashAggExecutor(Executor):
         self._applied_since_flush = False
         self._overflow_dev = jnp.zeros((), dtype=jnp.int32)
         self._occ_dev = jnp.zeros((), dtype=jnp.int32)
-        self._watchdog_pack = jax.jit(
-            lambda ov, occ: jnp.stack([ov, occ]))
+        self._watchdog_pack = jit_state(
+            lambda ov, occ: jnp.stack([ov, occ]),
+            name="hash_agg_watchdog_pack")
 
     def fence_tokens(self) -> list:
         # the state root depends on every program dispatched this epoch,
@@ -231,7 +254,11 @@ class HashAggExecutor(Executor):
         # into the device stream on a tunneled TPU, so per-chunk copies are
         # the difference between wire speed and 100x slower.
         occ = jnp.sum(table.occupied.astype(jnp.int32))
-        return new_state, overflow + n_unresolved + n_err, occ
+        # keep the accumulator's dtype stable (the segment sums promote to
+        # int64): donation can only reuse the input buffer — and lax.scan
+        # only accepts the carry — when the dtype round-trips
+        overflow = (overflow + n_unresolved + n_err).astype(overflow.dtype)
+        return new_state, overflow, occ
 
     # ---------------------------------------------------------- flush
     def _flush_impl(self, state: AggState):
@@ -460,7 +487,7 @@ class HashAggExecutor(Executor):
 
     def _flush_persist_view(self):
         """The state rows that changed this epoch (computed pre-flush)."""
-        return self._persist_view_impl(self.state)
+        return self._persist_view(self.state)
 
     def _persist_view_impl(self, st: AggState):
         # persisted row = keys ++ raw agg states ++ row_count; same
@@ -567,15 +594,82 @@ class HashAggExecutor(Executor):
             prev_emit=emits,
         )
 
+    # ---------------------------------------------------- multi-chunk apply
+    def _apply_chunk_now(self, chunk: StreamChunk) -> None:
+        self.state, self._overflow_dev, self._occ_dev = self._apply(
+            self.state, self._overflow_dev, chunk)
+        self._applied_since_flush = True
+
+    def _enqueue_chunk(self, chunk: StreamChunk) -> None:
+        """Buffer a chunk for the batched scan apply. Output only happens
+        at the barrier flush, so deferring applies to the interval end is
+        observationally identical to per-chunk applies — minus k-1
+        dispatches per k-chunk interval."""
+        if not self._use_chunk_batching:
+            self._apply_chunk_now(chunk)
+            return
+        p = self._pending_chunks
+        if p and (p[-1].capacity != chunk.capacity
+                  or jax.tree_util.tree_structure(p[-1])
+                  != jax.tree_util.tree_structure(chunk)):
+            # only identically-shaped chunks stack; mixed runs split
+            self._drain_pending()
+        self._pending_chunks.append(chunk)
+        if len(self._pending_chunks) >= self._batch_max:
+            self._drain_pending()
+
+    def _drain_pending(self) -> None:
+        p = self._pending_chunks
+        if not p:
+            return
+        self._pending_chunks = []
+        if len(p) == 1:
+            self._apply_chunk_now(p[0])
+            return
+        # bucket the batch length to a power of two so the scan program
+        # set stays tiny; filler chunks are all-invisible views of the
+        # last chunk's arrays (zero-copy) and contribute nothing
+        k = 1 << (len(p) - 1).bit_length()
+        if k > len(p):
+            last = p[-1]
+            filler = StreamChunk(last.columns, last.ops,
+                                 jnp.zeros(last.capacity, dtype=bool),
+                                 last.schema)
+            p = p + [filler] * (k - len(p))
+        scan = self._apply_scans.get(k)
+        if scan is None:
+            scan = self._make_apply_scan(k)
+            self._apply_scans[k] = scan
+        self.state, self._overflow_dev, self._occ_dev = scan(
+            self.state, self._overflow_dev, *p)
+        self._applied_since_flush = True
+
+    def _make_apply_scan(self, k: int):
+        def scan_impl(state, overflow, *chunks):
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *chunks)
+
+            def step(carry, chunk):
+                st, ov = carry
+                st, ov2, occ = self._apply_impl(st, ov, chunk)
+                # the overflow counter promotes to int64 through the
+                # segment sums; scan needs a dtype-stable carry
+                return (st, ov2.astype(ov.dtype)), occ
+
+            (st, ov), occs = jax.lax.scan(step, (state, overflow), stacked)
+            return st, ov, occs[-1]
+
+        return jit_state(scan_impl, donate_argnums=(0, 1),
+                         name=f"hash_agg_apply_scan{k}")
+
     # ----------------------------------------------------------- stream
     async def execute(self):
         first = True
         async for msg in self.input.execute():
             if isinstance(msg, StreamChunk):
-                self.state, self._overflow_dev, self._occ_dev = self._apply(
-                    self.state, self._overflow_dev, msg)
-                self._applied_since_flush = True
+                self._enqueue_chunk(msg)
             elif isinstance(msg, Barrier):
+                self._drain_pending()
                 if first or msg.kind is BarrierKind.INITIAL:
                     first = False
                     if self.state_table is not None:

@@ -43,6 +43,7 @@ from ..common.chunk import (
 from ..common.types import Field, Schema
 from ..ops.hash_table import (HashTable, lookup, lookup_or_insert,
                               stable_lexsort)
+from ..ops.jit_state import jit_state
 from ..state.state_table import StateTable
 from .align import LEFT, RIGHT, barrier_align
 from .executor import Executor
@@ -196,13 +197,33 @@ class HashJoinExecutor(Executor):
         self.identity = (f"HashJoin(l={self.key_indices[0]}, "
                          f"r={self.key_indices[1]})")
         self.sides = [self._empty(s) for s in (LEFT, RIGHT)]
-        self._apply = jax.jit(self._apply_impl, static_argnames=("side",))
-        self._persist_view = jax.jit(self._persist_view_impl)
-        self._evict = jax.jit(self._evict_impl, static_argnames=("side",))
-        self._evict_rows = jax.jit(self._evict_rows_impl, static_argnames=("side",))
-        self._stats = jax.jit(self._stats_impl)
-        self._rehash = jax.jit(self._rehash_impl,
-                               static_argnames=("side", "new_ck", "new_cr"))
+        # Donation: the OWN side (arg 0) and the error accumulator (arg 2)
+        # are threaded — `self.sides[s] = self._apply(self.sides[s], ...)`
+        # holds the only reference — so their table buffers update in
+        # place. The OTHER side (arg 1) is read-only and must never be
+        # donated: it is still live as self.sides[1 - s].
+        self._apply = jit_state(self._apply_impl, static_argnames=("side",),
+                                donate_argnums=(0, 2), name="hash_join_apply")
+        self._persist_view = jit_state(self._persist_view_impl,
+                                       name="hash_join_persist_view")
+        self._evict = jit_state(self._evict_impl, static_argnames=("side",),
+                                donate_argnums=(0,), name="hash_join_evict")
+        self._evict_rows = jit_state(self._evict_rows_impl,
+                                     static_argnames=("side",),
+                                     name="hash_join_evict_rows")
+        self._stats = jit_state(self._stats_impl, name="hash_join_stats")
+        self._rehash = jit_state(self._rehash_impl,
+                                 static_argnames=("side", "new_ck", "new_cr"),
+                                 donate_argnums=(0,), name="hash_join_rehash")
+        # multi-chunk apply: consecutive same-side chunks inside one
+        # barrier interval scan through the probe/update step in ONE
+        # dispatch; the run drains on side switch, barrier, or watermark,
+        # so cross-side and chunk/watermark ordering are preserved exactly
+        self._use_chunk_batching = True
+        self._batch_max = 8
+        self._run_chunks: list[StreamChunk] = []
+        self._run_side: Optional[int] = None
+        self._apply_scans: dict = {}
         self.rebuilds = 0
         # 1 = fetch + fail-stop before every checkpoint commit; None =
         # NO fetch ever, not even at stop (see HashAggExecutor: on a
@@ -223,9 +244,10 @@ class HashJoinExecutor(Executor):
         self._top_dev = [zero, zero]
         self._occ_known = [0, 0]
         self._top_known = [0, 0]
-        self._watchdog_pack = jax.jit(
+        self._watchdog_pack = jit_state(
             lambda errs, ol, tl, orr, tr: jnp.concatenate(
-                [errs, jnp.stack([ol, tl, orr, tr])]))
+                [errs, jnp.stack([ol, tl, orr, tr])]),
+            name="hash_join_watchdog_pack")
         # watermark bookkeeping: per side, last seen watermark per key position
         self._key_wms: list[dict[int, int]] = [{}, {}]
         self._emitted_key_wm: dict[int, int] = {}
@@ -572,24 +594,100 @@ class HashJoinExecutor(Executor):
                 f"join changelog inconsistency: {n_miss} deletes matched "
                 f"no stored row")
 
+    # ---------------------------------------------------- multi-chunk apply
+    def _make_apply_scan(self, k: int, side: int):
+        def scan_impl(own: JoinSideState, other: JoinSideState,
+                      errs: jnp.ndarray, *chunks):
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *chunks)
+
+            def step(carry, chunk):
+                own_, errs_ = carry
+                own_, cols, ops, vis, errs_, occ, top = self._apply_impl(
+                    own_, other, errs_, chunk, side)
+                return (own_, errs_), (cols, ops, vis, occ, top)
+
+            (own2, errs2), (cols, ops, vis, occs, tops) = jax.lax.scan(
+                step, (own, errs), stacked)
+            # stacked per-step outputs [k, M] flatten into one chunk of
+            # capacity k*M — row order is step-major, matching the
+            # sequential per-chunk emission order
+            flat_cols = tuple(
+                Column(c.data.reshape(-1),
+                       None if c.valid is None else c.valid.reshape(-1))
+                for c in cols)
+            return (own2, flat_cols, ops.reshape(-1), vis.reshape(-1),
+                    errs2, occs[-1], tops[-1])
+
+        return jit_state(scan_impl, donate_argnums=(0, 2),
+                         name=f"hash_join_apply_scan{k}_s{side}")
+
+    def _run_matches(self, side: int, chunk: StreamChunk) -> bool:
+        p = self._run_chunks
+        return (self._run_side == side and bool(p)
+                and p[-1].capacity == chunk.capacity
+                and jax.tree_util.tree_structure(p[-1])
+                == jax.tree_util.tree_structure(chunk))
+
+    def _enqueue_chunk(self, side: int, chunk: StreamChunk) -> list:
+        if not self._use_chunk_batching:
+            self._run_chunks, self._run_side = [chunk], side
+            return self._drain_run()
+        outs = []
+        if self._run_chunks and not self._run_matches(side, chunk):
+            outs.extend(self._drain_run())
+        self._run_chunks.append(chunk)
+        self._run_side = side
+        if len(self._run_chunks) >= self._batch_max:
+            outs.extend(self._drain_run())
+        return outs
+
+    def _drain_run(self) -> list:
+        run, s = self._run_chunks, self._run_side
+        if not run:
+            return []
+        self._run_chunks, self._run_side = [], None
+        if len(run) == 1:
+            (self.sides[s], cols, ops, vis, self._errs_dev, occ,
+             top) = self._apply(self.sides[s], self.sides[1 - s],
+                                self._errs_dev, run[0], side=s)
+        else:
+            # power-of-two batch bucket; fillers are all-invisible views
+            # of the last chunk (no probe, no state change, no matches)
+            k = 1 << (len(run) - 1).bit_length()
+            if k > len(run):
+                last = run[-1]
+                filler = StreamChunk(last.columns, last.ops,
+                                     jnp.zeros(last.capacity, dtype=bool),
+                                     last.schema)
+                run = run + [filler] * (k - len(run))
+            scan = self._apply_scans.get((k, s))
+            if scan is None:
+                scan = self._make_apply_scan(k, s)
+                self._apply_scans[(k, s)] = scan
+            (self.sides[s], cols, ops, vis, self._errs_dev, occ,
+             top) = scan(self.sides[s], self.sides[1 - s],
+                         self._errs_dev, *run)
+        self._occ_dev[s], self._top_dev[s] = occ, top
+        self._dirty_since_flush[s] = True
+        out = StreamChunk(
+            tuple(cols[i] for i in self.output_indices), ops, vis,
+            self.schema)
+        if self.condition is not None:
+            pred = self.condition.eval(cols)
+            out = out.mask(pred.data & pred.valid_mask())
+        return [out]
+
     # ----------------------------------------------------------- stream
     async def execute(self):
         first = True
         async for kind, s, msg in barrier_align(*self.inputs):
             if kind == "chunk":
-                (self.sides[s], cols, ops, vis, self._errs_dev, occ,
-                 top) = self._apply(self.sides[s], self.sides[1 - s],
-                                    self._errs_dev, msg, side=s)
-                self._occ_dev[s], self._top_dev[s] = occ, top
-                self._dirty_since_flush[s] = True
-                out = StreamChunk(
-                    tuple(cols[i] for i in self.output_indices), ops, vis,
-                    self.schema)
-                if self.condition is not None:
-                    pred = self.condition.eval(cols)
-                    out = out.mask(pred.data & pred.valid_mask())
-                yield out
+                for out in self._enqueue_chunk(s, msg):
+                    yield out
             elif kind == "barrier":
+                for out in self._drain_run():
+                    yield out
                 barrier: Barrier = msg
                 if first or barrier.kind is BarrierKind.INITIAL:
                     first = False
@@ -627,6 +725,9 @@ class HashJoinExecutor(Executor):
                 self._maybe_rebuild()
                 yield barrier
             else:
+                # watermark: drain first so emitted outputs precede it
+                for out in self._drain_run():
+                    yield out
                 wm: Watermark = msg
                 if self.clean_cols[s] is not None and wm.col_idx == self.clean_cols[s]:
                     self._pending_clean[s] = wm.val

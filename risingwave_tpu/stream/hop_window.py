@@ -24,6 +24,7 @@ from ..common.chunk import Column, StreamChunk
 from ..common.types import DataType, Field, Schema
 from .executor import Executor, StatelessUnaryExecutor
 from .message import Watermark
+from ..ops.jit_state import jit_state
 
 
 class HopWindowExecutor(StatelessUnaryExecutor):
@@ -52,7 +53,7 @@ class HopWindowExecutor(StatelessUnaryExecutor):
         self.window_end_idx = _outpos(we_full)
         self.identity = (f"HopWindow(col={time_col}, slide={window_slide_us}us, "
                          f"size={window_size_us}us)")
-        self._step = jax.jit(self._step_impl)
+        self._step = jit_state(self._step_impl, name="hop_window_step")
 
     def _step_impl(self, chunk: StreamChunk) -> StreamChunk:
         K = self.n_windows

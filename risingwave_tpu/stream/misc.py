@@ -20,6 +20,7 @@ from ..state.state_table import StateTable
 from .exchange import Channel, MergeExecutor
 from .executor import Executor, StatelessUnaryExecutor
 from .message import Barrier, BarrierKind, Watermark
+from ..ops.jit_state import jit_state
 
 
 class ValuesExecutor(Executor):
@@ -80,7 +81,7 @@ class ExpandExecutor(StatelessUnaryExecutor):
         self.schema = Schema(tuple(
             in_fields + [type(in_fields[0])("flag", DataType.INT64)]))
         self.identity = f"Expand({len(self.subsets)} subsets)"
-        self._step = jax.jit(self._step_impl)
+        self._step = jit_state(self._step_impl, name="expand_step")
 
     def _step_impl(self, chunk: StreamChunk) -> StreamChunk:
         K = len(self.subsets)
@@ -178,7 +179,7 @@ class WatermarkFilterExecutor(Executor):
         self.identity = f"WatermarkFilter(col={time_col}, lag={lag_us}us)"
         self._wm: Optional[int] = None
         self._max_dev = None
-        self._step = jax.jit(self._step_impl)
+        self._step = jit_state(self._step_impl, name="watermark_filter_step")
 
     def _step_impl(self, chunk: StreamChunk, cur_max):
         ts = chunk.columns[self.time_col].data

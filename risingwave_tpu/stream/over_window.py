@@ -28,6 +28,7 @@ from ..common.chunk import Column, StreamChunk, OP_INSERT, op_sign
 from ..common.types import DataType, Field, Schema
 from ..expr.agg import AggCall, AggKind
 from ..ops.hash_table import HashTable, lookup_or_insert, stable_lexsort
+from ..ops.jit_state import jit_state
 from ..state.state_table import StateTable
 from .executor import Executor, StatefulUnaryExecutor
 from .message import Barrier, Watermark
@@ -77,7 +78,11 @@ class OverWindowExecutor(StatefulUnaryExecutor):
         self.agg_states = tuple(
             (spec.init_state((capacity,)) if spec is not None else None)
             for spec in self._specs)
-        self._apply = jax.jit(self._apply_impl)
+        # all five threaded state args (table, counts, agg_states, dirty,
+        # errs) are re-bound in on_chunk and aliased nowhere else: donate
+        self._apply = jit_state(self._apply_impl,
+                                donate_argnums=(0, 1, 2, 3, 4),
+                                name="over_window_apply")
         self._errs_dev = jnp.zeros((), dtype=jnp.int32)
         self._init_stateful(state_table, watchdog_interval)
 

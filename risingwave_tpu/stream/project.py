@@ -21,6 +21,7 @@ from ..common.types import Field, Schema
 from ..expr.ir import Expr
 from .executor import Executor, StatelessUnaryExecutor
 from .message import Watermark
+from ..ops.jit_state import jit_state
 
 
 class ProjectExecutor(StatelessUnaryExecutor):
@@ -44,7 +45,7 @@ class ProjectExecutor(StatelessUnaryExecutor):
         # monotonicity by providing the transform
         self.watermark_transforms = dict(watermark_transforms or {})
         self.identity = f"Project({', '.join(map(repr, self.exprs))})"
-        self._step = jax.jit(self._step_impl)
+        self._step = jit_state(self._step_impl, name="project_step")
 
     def _step_impl(self, chunk: StreamChunk) -> StreamChunk:
         cols = tuple(e.eval(chunk.columns) for e in self.exprs)
@@ -76,7 +77,7 @@ class FilterExecutor(StatelessUnaryExecutor):
         super().__init__(input)
         self.predicate = predicate
         self.identity = f"Filter({predicate!r})"
-        self._step = jax.jit(self._step_impl)
+        self._step = jit_state(self._step_impl, name="filter_step")
 
     def _step_impl(self, chunk: StreamChunk) -> StreamChunk:
         pred = self.predicate.eval(chunk.columns)

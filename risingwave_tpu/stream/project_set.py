@@ -28,6 +28,7 @@ from ..common.chunk import Column, StreamChunk
 from ..common.types import DataType, Field, Schema
 from .executor import StatelessUnaryExecutor
 from .message import Watermark
+from ..ops.jit_state import jit_state
 
 
 class ProjectSetExecutor(StatelessUnaryExecutor):
@@ -51,7 +52,7 @@ class ProjectSetExecutor(StatelessUnaryExecutor):
         # clipping would make the MV wrong with no signal (every bounded
         # structure here fail-stops; see sorted-store overflow counters)
         self._overflow_dev = jnp.zeros((), dtype=jnp.int32)
-        self._step = jax.jit(self._step_impl)
+        self._step = jit_state(self._step_impl, name="project_set_step")
 
     def _step_impl(self, overflow, chunk: StreamChunk):
         N = chunk.capacity

@@ -40,6 +40,7 @@ from ..common.chunk import Column, StreamChunk, OP_DELETE, OP_INSERT
 from ..ops.hash_table import stable_lexsort
 from .executor import Executor, StatefulUnaryExecutor
 from .message import Barrier, Watermark
+from ..ops.jit_state import jit_state
 from .sorted_join import _HSENTINEL, key_hash
 from .sorted_store import GrowableSortedStore, sorted_store_apply
 
@@ -92,13 +93,20 @@ class RetractableTopNExecutor(GrowableSortedStore,
         self.top_valids = tuple(jnp.zeros(C, dtype=bool) for _ in dts)
         self.top_n = jnp.int32(0)
         self._errs_dev = jnp.zeros(2, dtype=jnp.int32)  # [row_ovf, del_miss]
-        self._apply = jax.jit(partial(sorted_store_apply,
-                                      pk_idx=self.pk_indices,
-                                      capacity=self.capacity))
+        # the dense store pytree (khash, cols, valids, n) + errs is
+        # threaded and aliased nowhere (the emitted top set is a fresh
+        # gather): donate. _flush consumes/replaces the top_* triplet.
+        self._apply = jit_state(
+            partial(sorted_store_apply, pk_idx=self.pk_indices,
+                    capacity=self.capacity),
+            donate_argnums=(0, 1, 2, 3, 4), name="retract_top_n_apply")
         # ONE d2h fetch per barrier: errs and the live count ride together
-        self._wd_pack = jax.jit(
-            lambda e, n: jnp.concatenate([e, n[None].astype(jnp.int32)]))
-        self._flush = jax.jit(self._flush_impl)
+        self._wd_pack = jit_state(
+            lambda e, n: jnp.concatenate([e, n[None].astype(jnp.int32)]),
+            name="retract_top_n_wd_pack")
+        self._flush = jit_state(self._flush_impl,
+                                donate_argnums=(4, 5, 6, 7),
+                                name="retract_top_n_flush")
         # durability: the state table materializes the FULL input row set
         # keyed by the stream key (the reference's TopN state table holds
         # all input rows too, top_n_state.rs); each epoch's buffered

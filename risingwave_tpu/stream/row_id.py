@@ -26,6 +26,7 @@ from ..common.epoch import to_physical
 from ..common.types import DataType, Field, Schema
 from .executor import Executor, StatelessUnaryExecutor
 from .message import Barrier
+from ..ops.jit_state import jit_state
 
 _SEQ_PER_MS_BITS = 15
 
@@ -38,7 +39,7 @@ class RowIdGenExecutor(StatelessUnaryExecutor):
         self.schema = Schema(input.schema.fields + (Field(row_id_name, DataType.SERIAL),))
         self.pk_indices = (len(self.schema) - 1,)
         self.identity = "RowIdGen"
-        self._step = jax.jit(self._step_impl)
+        self._step = jit_state(self._step_impl, name="row_id_step")
 
     def on_barrier(self, barrier: Barrier) -> None:
         # epoch physical time floors the sequence => restart-safe ids

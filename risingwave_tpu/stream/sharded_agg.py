@@ -32,7 +32,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..common.chunk import StreamChunk
 from ..common.vnode import compute_vnodes
 from ..expr.agg import AggCall
-from ..parallel.mesh import VNODE_AXIS, vnode_to_shard
+from ..ops.jit_state import jit_state
+from ..parallel.mesh import VNODE_AXIS, shard_map, vnode_to_shard
 from .executor import Executor
 from .hash_agg import AggState, HashAggExecutor
 
@@ -57,7 +58,11 @@ class ShardedHashAggExecutor(HashAggExecutor):
                          cleaning_watermark_col=cleaning_watermark_col,
                          watchdog_interval=watchdog_interval)
         # re-wrap the inherited step impls in shard_map (the parent set up
-        # plain jits over the freshly built sharded state)
+        # plain jits over the freshly built sharded state); donation rules
+        # match the parent's — the sharded AggState and the per-shard
+        # accumulators are threaded, never aliased. Chunk batching stays
+        # off: the scan programs are built over the unsharded impls.
+        self._use_chunk_batching = False
         mesh_kw = dict(mesh=mesh)
         shard = P(VNODE_AXIS)
         repl = P()
@@ -73,30 +78,33 @@ class ShardedHashAggExecutor(HashAggExecutor):
             st, ov, occ = self._apply_impl(state, overflow[0], local)
             return st, ov[None], occ[None]
 
-        self._apply = jax.jit(jax.shard_map(
+        self._apply = jit_state(shard_map(
             apply_sharded, in_specs=(shard, shard, repl),
-            out_specs=(shard, shard, shard), **mesh_kw))
+            out_specs=(shard, shard, shard), **mesh_kw),
+            donate_argnums=(0, 1), name="sharded_agg_apply")
 
         def flush_sharded(state):
             st, cols, ops, vis = self._flush_impl(state)
             return st, cols, ops, vis
 
-        self._flush = jax.jit(jax.shard_map(
+        self._flush = jit_state(shard_map(
             flush_sharded, in_specs=(shard,),
-            out_specs=(shard, shard, shard, shard), **mesh_kw))
+            out_specs=(shard, shard, shard, shard), **mesh_kw),
+            donate_argnums=(0,), name="sharded_agg_flush")
 
         def evict_sharded(state, wm):
             return self._evict_impl(state, wm)
 
-        self._evict = jax.jit(jax.shard_map(
+        self._evict = jit_state(shard_map(
             evict_sharded, in_specs=(shard, repl), out_specs=shard,
-            **mesh_kw))
+            **mesh_kw), donate_argnums=(0,), name="sharded_agg_evict")
 
         def purge_sharded(state):
             return self._rehash_impl(state, self.capacity)
 
-        self._purge = jax.jit(jax.shard_map(
-            purge_sharded, in_specs=(shard,), out_specs=shard, **mesh_kw))
+        self._purge = jit_state(shard_map(
+            purge_sharded, in_specs=(shard,), out_specs=shard, **mesh_kw),
+            donate_argnums=(0,), name="sharded_agg_purge")
 
         def rehash_same_capacity(state, cap):
             # sharded v1 never grows: only same-capacity purges reach here
@@ -109,9 +117,9 @@ class ShardedHashAggExecutor(HashAggExecutor):
             max_occ = jax.lax.pmax(occ[0], VNODE_AXIS)
             return jnp.stack([total_ov, max_occ])[None]
 
-        self._watchdog_pack = jax.jit(jax.shard_map(
+        self._watchdog_pack = jit_state(shard_map(
             watchdog_sharded, in_specs=(shard, shard), out_specs=shard,
-            **mesh_kw))
+            **mesh_kw), name="sharded_agg_watchdog_pack")
 
         def persist_view_sharded(state):
             cols, ops, vis, n_dirty = self._persist_view_impl(state)
@@ -120,9 +128,10 @@ class ShardedHashAggExecutor(HashAggExecutor):
         # the parent's eager persist view gathers on sharded arrays
         # (XLA aborts); run it per shard instead — each shard's dirty
         # rows compact to that shard's LOCAL prefix
-        self._persist_view_sh = jax.jit(jax.shard_map(
+        self._persist_view_sh = jit_state(shard_map(
             persist_view_sharded, in_specs=(shard,),
-            out_specs=(shard, shard, shard, shard), **mesh_kw))
+            out_specs=(shard, shard, shard, shard), **mesh_kw),
+            name="sharded_agg_persist_view")
 
         # per-shard watchdog accumulators replace the parent's scalars
         sharding = NamedSharding(mesh, P(VNODE_AXIS))

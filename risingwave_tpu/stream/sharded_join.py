@@ -30,7 +30,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..common.chunk import StreamChunk
 from ..common.vnode import compute_vnodes
-from ..parallel.mesh import VNODE_AXIS, vnode_to_shard
+from ..ops.jit_state import jit_state
+from ..parallel.mesh import VNODE_AXIS, shard_map, vnode_to_shard
 from .align import LEFT, RIGHT
 from .executor import Executor
 from .sorted_join import SortedJoinExecutor, SortedSideState, _empty_sorted_side
@@ -70,11 +71,15 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
                 own2, odeg, cols, ops, vis, errs2, _ = out
                 return (_vec_n(own2), odeg, cols, ops, vis, errs2[None],
                         own2.n.reshape((1,)))
-            return jax.jit(jax.shard_map(
+            # donation mirrors the parent's: ONLY the sharded error
+            # accumulator (arg 2) — the side states stay aliased by the
+            # per-shard snapshot diff base (_snap)
+            return jit_state(shard_map(
                 apply_sharded, mesh=mesh,
                 in_specs=(shard, shard, shard, repl, repl),
                 out_specs=(shard, shard, shard, shard, shard, shard,
-                           shard)))
+                           shard)), donate_argnums=(2,),
+                name=f"sharded_join_apply_s{side}")
 
         # sharded programs trace per (side, match_factor): the steady
         # state uses the per-side factors, recovery's generous replay
@@ -93,9 +98,9 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
         def make_evict(side):
             def evict_sharded(own, wm):
                 return _vec_n(self._evict_impl(_scalar_n(own), wm, side))
-            return jax.jit(jax.shard_map(
+            return jit_state(shard_map(
                 evict_sharded, mesh=mesh, in_specs=(shard, repl),
-                out_specs=shard))
+                out_specs=shard), name=f"sharded_join_evict_s{side}")
 
         evicts = {LEFT: make_evict(LEFT), RIGHT: make_evict(RIGHT)}
         self._evict = lambda own, wm, side: evicts[side](own, wm)

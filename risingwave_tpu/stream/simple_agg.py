@@ -26,6 +26,7 @@ from ..common.chunk import (
 )
 from ..common.types import Field, Schema
 from ..expr.agg import AggCall, AggKind
+from ..ops.jit_state import jit_state
 from ..state.state_table import StateTable
 from .executor import Executor, StatefulUnaryExecutor
 from .message import Barrier, Watermark
@@ -44,7 +45,8 @@ class StatelessSimpleAggExecutor(Executor):
             Field(f"agg{j}", c.ret_type) for j, c in enumerate(agg_calls)))
         self.pk_indices = ()
         self.identity = "StatelessSimpleAgg"
-        self._step = jax.jit(self._step_impl)
+        self._step = jit_state(self._step_impl,
+                               name="stateless_simple_agg_step")
 
     def _step_impl(self, chunk: StreamChunk):
         signs = jnp.where(chunk.vis, op_sign(chunk.ops), 0)
@@ -104,7 +106,9 @@ class SimpleAggExecutor(StatefulUnaryExecutor):
         self.row_count = jnp.zeros((), dtype=jnp.int64)
         self._emitted = False
         self._prev_emit: Optional[tuple] = None
-        self._apply = jax.jit(self._apply_impl)
+        # states + row_count are threaded scalars, re-bound in on_chunk
+        self._apply = jit_state(self._apply_impl, donate_argnums=(0, 1),
+                                name="simple_agg_apply")
         self._init_stateful(state_table, 1)
 
     def fence_tokens(self) -> list:

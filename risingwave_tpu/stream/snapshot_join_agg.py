@@ -48,6 +48,7 @@ from ..common.chunk import (
 )
 from ..common.types import Field, Schema
 from ..expr.agg import AggCall, AggKind
+from ..ops.jit_state import jit_state
 from .align import LEFT, RIGHT, barrier_align
 from .executor import Executor
 from .message import Barrier, BarrierKind, Watermark
@@ -133,9 +134,18 @@ class SnapshotJoinAggExecutor(Executor):
         # errs[0] = fact overflow, errs[1] = dim overflow,
         # errs[2] = retraction seen on an append-only input
         self._errs = jnp.zeros(3, dtype=jnp.int32)
-        self._append_fact = jax.jit(self._append_fact_impl)
-        self._append_dim = jax.jit(self._append_dim_impl)
-        self._flush = jax.jit(self._flush_impl)
+        # appends thread (store arrays, count, errs) — re-bound at the
+        # call sites, aliased nowhere else: donate. _flush reads the
+        # stores (NOT donated — they stay live) and consumes/replaces the
+        # previous-emission triplet (args 5-7).
+        self._append_fact = jit_state(self._append_fact_impl,
+                                      donate_argnums=(0, 1, 2, 3),
+                                      name="snapshot_join_agg_append_fact")
+        self._append_dim = jit_state(self._append_dim_impl,
+                                     donate_argnums=(0, 1, 2),
+                                     name="snapshot_join_agg_append_dim")
+        self._flush = jit_state(self._flush_impl, donate_argnums=(5, 6, 7),
+                                name="snapshot_join_agg_flush")
         self._dirty = False
         # host upper bounds for growth triggers (no d2h on the hot path)
         self._applied_rows_upper = 0

@@ -27,6 +27,7 @@ from ..ops.hash_table import stable_lexsort
 from ..state.state_table import StateTable
 from .executor import Executor, StatefulUnaryExecutor
 from .message import Barrier, Watermark
+from ..ops.jit_state import jit_state
 
 
 class SortExecutor(StatefulUnaryExecutor):
@@ -47,8 +48,14 @@ class SortExecutor(StatefulUnaryExecutor):
         self.rows = tuple(jnp.zeros(C, dtype=dt) for dt in self._col_dtypes)
         self.live = jnp.zeros(C, dtype=bool)
         self._pending_wm: Optional[int] = None
-        self._append = jax.jit(self._append_impl)
-        self._flush_ripe = jax.jit(self._flush_ripe_impl)
+        # buffer arrays + errs are threaded and re-bound at both call
+        # sites; nothing aliases them between steps: donate
+        self._append = jit_state(self._append_impl,
+                                 donate_argnums=(0, 1, 2),
+                                 name="sort_append")
+        self._flush_ripe = jit_state(self._flush_ripe_impl,
+                                     donate_argnums=(0, 1),
+                                     name="sort_flush_ripe")
         self._errs_dev = jnp.zeros((), dtype=jnp.int32)
         self._init_stateful(state_table, watchdog_interval)
 

@@ -84,6 +84,7 @@ from ..common.chunk import (
 )
 from ..common.types import Field, Schema
 from ..ops.hash_table import stable_lexsort
+from ..ops.jit_state import jit_state
 from .align import LEFT, RIGHT, barrier_align
 from .executor import Executor
 from .message import Barrier, BarrierKind, Watermark
@@ -291,10 +292,18 @@ class SortedJoinExecutor(Executor):
         # device snapshot as of the last durable flush (diff base)
         self._snap = [self.sides[LEFT], self.sides[RIGHT]]
         self._flush_dirty = [False, False]
-        self._apply = jax.jit(self._apply_impl,
-                              static_argnames=("side", "match_factor"))
-        self._evict = jax.jit(self._evict_impl, static_argnames=("side",))
-        self._diff = jax.jit(self._diff_impl)
+        # Donation: ONLY the error accumulator (arg 2). The side states
+        # must NOT be donated here, unlike hash_join: `_snap` keeps the
+        # last-persisted side as the durable diff base by ALIASING the
+        # live arrays (`self._snap[s] = self.sides[s]` in _persist), so
+        # the buffers an apply consumes are still live as the snapshot.
+        self._apply = jit_state(self._apply_impl,
+                                static_argnames=("side", "match_factor"),
+                                donate_argnums=(2,),
+                                name="sorted_join_apply")
+        self._evict = jit_state(self._evict_impl, static_argnames=("side",),
+                                name="sorted_join_evict")
+        self._diff = jit_state(self._diff_impl, name="sorted_join_diff")
         if watchdog_interval not in (None, 1):
             raise ValueError("watchdog_interval must be 1 or None")
         self.watchdog_interval = watchdog_interval
@@ -305,8 +314,9 @@ class SortedJoinExecutor(Executor):
         zero = jnp.zeros((), dtype=jnp.int32)
         self._n_dev = [zero, zero]
         self._dirty = [False, False]
-        self._watchdog_pack = jax.jit(
-            lambda errs, nl, nr: jnp.concatenate([errs, jnp.stack([nl, nr])]))
+        self._watchdog_pack = jit_state(
+            lambda errs, nl, nr: jnp.concatenate([errs, jnp.stack([nl, nr])]),
+            name="sorted_join_watchdog_pack")
         self._key_wms: list[dict[int, int]] = [{}, {}]
         self._emitted_key_wm: dict[int, int] = {}
         # watermark value a side's state is already clean to (skip

@@ -138,8 +138,8 @@ class GrowableSortedStore:
     _SECONDARY: tuple = ()
 
     def _grow_to(self, new_c: int) -> None:
-        import jax
         from functools import partial
+        from ..ops.jit_state import jit_state
         from .sorted_join import grow_sorted_arrays
         self.khash, self.cols, self.valids = grow_sorted_arrays(
             self.khash, self.cols, self.valids, new_c)
@@ -150,9 +150,13 @@ class GrowableSortedStore:
         setattr(self, c, c2)
         setattr(self, v, v2)
         self.capacity = new_c
-        self._apply = jax.jit(partial(sorted_store_apply,
-                                      pk_idx=self.pk_indices,
-                                      capacity=new_c))
+        # same donation contract as the constructor-time _apply: the
+        # primary store pytree is threaded, the secondary never aliases it
+        self._apply = jit_state(
+            partial(sorted_store_apply, pk_idx=self.pk_indices,
+                    capacity=new_c),
+            donate_argnums=(0, 1, 2, 3, 4),
+            name=f"{type(self).__name__}_apply")
 
     def _maybe_grow(self, n_live: int) -> None:
         if n_live > 0.7 * self.capacity:

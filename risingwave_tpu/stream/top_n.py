@@ -40,6 +40,7 @@ import numpy as np
 from ..common.chunk import Column, StreamChunk, OP_DELETE, OP_INSERT, op_sign
 from ..ops.hash_table import (HashTable, lookup_or_insert,
                               stable_lexsort, stable_lexsort_rows)
+from ..ops.jit_state import jit_state
 from ..state.state_table import StateTable
 from .executor import Executor, StatefulUnaryExecutor
 from .message import Barrier, Watermark
@@ -89,8 +90,16 @@ class GroupTopNExecutor(StatefulUnaryExecutor):
         self.prev_valid = jnp.zeros((C, K), dtype=bool)
         self.prev_payload = tuple(
             jnp.zeros((C, K), dtype=dt) for dt in self._col_dtypes)
-        self._apply = jax.jit(self._apply_impl)
-        self._flush = jax.jit(self._flush_impl)
+        # Donate only state that is never aliased: the group table, the
+        # dirty bitmap, and the error accumulator. keys_sorted / valid /
+        # payload must NOT be donated — flush() re-binds them as prev_*
+        # (the diff base), so the same arrays stay live across the next
+        # apply. In _flush the OLD prev_* (args 4-6) are consumed and
+        # replaced, so those donate.
+        self._apply = jit_state(self._apply_impl, donate_argnums=(0, 4, 5),
+                                name="top_n_apply")
+        self._flush = jit_state(self._flush_impl, donate_argnums=(4, 5, 6),
+                                name="top_n_flush")
         self._errs_dev = jnp.zeros((), dtype=jnp.int32)
         self._init_stateful(state_table, watchdog_interval)
 

@@ -147,3 +147,12 @@ class MetricsRegistry:
 
 # the process-default registry (reference GLOBAL_METRICS_REGISTRY)
 GLOBAL_METRICS = MetricsRegistry()
+
+# Pre-registered process totals for the jitted step programs (incremented
+# by ops/jit_state.py — one compile per traced signature, one dispatch per
+# program invocation; per-program labelled series ride alongside). The
+# north-star queries are host-dispatch-bound, so dispatches per barrier
+# interval and recompiles after warmup are headline health series: they
+# always render in `\metrics` / scrapes, even at zero.
+JIT_COMPILES = GLOBAL_METRICS.counter("jit_compile_count")
+DEVICE_DISPATCHES = GLOBAL_METRICS.counter("device_dispatch_count")

@@ -16,9 +16,24 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import pickle
 import struct
 import sys
+
+
+def _pin_jax_platform() -> None:
+    """Honor JAX_PLATFORMS IN-PROCESS before any jax use.
+
+    Deployment images may carry a sitecustomize that updates jax.config
+    at interpreter startup (e.g. to the real accelerator), which beats
+    the environment variable — so a parent that spawned this worker with
+    JAX_PLATFORMS=cpu would still get a worker touching (and possibly
+    hanging on) the device. jax.config.update wins over both."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
 
 
 async def _recv_blob(reader) -> bytes:
@@ -108,6 +123,7 @@ async def serve(port: int = 0, host: str = "127.0.0.1"):
 def main(argv=None) -> None:
     argv = sys.argv[1:] if argv is None else argv
     port = int(argv[0]) if argv else 0
+    _pin_jax_platform()
     asyncio.run(serve(port))
 
 

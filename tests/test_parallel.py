@@ -8,7 +8,7 @@ on exactly the shard that owns its vnode, no row is duplicated or lost
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from risingwave_tpu.parallel.mesh import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from risingwave_tpu.common.vnode import compute_vnodes_numpy

@@ -76,6 +76,12 @@ _CHILD = r"""
 import asyncio, sys, os
 sys.path.insert(0, os.getcwd())
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The env var alone does NOT pin the platform on this image: its
+# sitecustomize updates jax.config at interpreter startup (to the real
+# chip), which wins over JAX_PLATFORMS. Force it in-process before any
+# jax-using import so the child never touches (or hangs on) the device.
+import jax
+jax.config.update("jax_platforms", "cpu")
 import numpy as np
 from risingwave_tpu.common.epoch import EpochPair
 from risingwave_tpu.connectors import NexmarkGenerator
@@ -118,11 +124,25 @@ async def test_multiprocess_pipeline():
     filt = FilterExecutor(rx, call("greater_than", col(2),
                                    lit(5_000_000)))
     got = Counter()
-    async for msg in filt.execute():
-        if isinstance(msg, StreamChunk):
-            for _, vals in msg.to_rows():
-                got[(vals[0], vals[2])] += 1
-    await rx.stop()
+
+    async def consume():
+        async for msg in filt.execute():
+            if isinstance(msg, StreamChunk):
+                for _, vals in msg.to_rows():
+                    got[(vals[0], vals[2])] += 1
+
+    # hard deadline: a child with a sick device (or a platform pin that
+    # didn't take) never sends its stop barrier — fail the test with the
+    # child's stderr instead of hanging the suite forever
+    try:
+        await asyncio.wait_for(consume(), timeout=120)
+    except asyncio.TimeoutError:
+        child.kill()
+        err = child.stderr.read().decode()[-500:]
+        raise AssertionError(
+            f"producer subprocess never finished (device stall?): {err}")
+    finally:
+        await rx.stop()
     rc = child.wait(timeout=60)
     assert rc == 0, child.stderr.read().decode()[-500:]
 

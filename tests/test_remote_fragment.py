@@ -24,6 +24,17 @@ JOIN_SQL = (f"SELECT P.id, P.window_start "
             f"JOIN TUMBLE(auction, date_time, {W}) A "
             f"ON P.id = A.seller AND P.window_start = A.window_start")
 
+# Hard deadline on every cross-process await: the worker pins its jax
+# platform in-process (risingwave_tpu/worker.py _pin_jax_platform — the
+# env var alone is overridden by this image's sitecustomize), but if the
+# worker still wedges on a sick device the test must FAIL, not hang the
+# suite forever.
+STEP_TIMEOUT_S = 120
+
+
+async def _step(coro):
+    return await asyncio.wait_for(coro, timeout=STEP_TIMEOUT_S)
+
 
 @pytest.fixture()
 def worker_proc():
@@ -110,22 +121,22 @@ def _source_offsets(session, mv):
 
 async def test_join_fragment_runs_in_worker_process(worker_proc):
     s = Session()
-    await _mk(s, worker_proc)
+    await _step(_mk(s, worker_proc))
     rf = [r for roots in
           s.catalog.mvs["rj"].deployment.roots.values() for r in roots
           if isinstance(r, RemoteFragmentExecutor)]
     assert rf, "join fragment was not placed remotely"
-    await s.tick(4)
+    await _step(s.tick(4))
     # quiesce: pause sources so the connector offsets match the
     # materialized prefix exactly
     from risingwave_tpu.stream.message import PauseMutation
-    b = await s.coord.inject_barrier(mutation=PauseMutation())
-    await s.coord.wait_collected(b)
+    b = await _step(s.coord.inject_barrier(mutation=PauseMutation()))
+    await _step(s.coord.wait_collected(b))
     # epochs commit IN ORDER at the NEXT barrier: two quiesce rounds
     # after the pause make everything the offsets cover durable
     for _ in range(2):
-        b = await s.coord.inject_barrier()
-        await s.coord.wait_collected(b)
+        b = await _step(s.coord.inject_barrier())
+        await _step(s.coord.wait_collected(b))
     got = Counter(s.query("SELECT id, window_start FROM rj"))
     exp = _oracle(_source_offsets(s, "rj"))
     assert sum(exp.values()) > 0, "oracle vacuous"
@@ -138,26 +149,26 @@ async def test_join_fragment_runs_in_worker_process(worker_proc):
 
 async def test_remote_fragment_survives_recovery(worker_proc):
     s = Session()
-    await _mk(s, worker_proc)
-    await s.tick(2)
+    await _step(_mk(s, worker_proc))
+    await _step(s.tick(2))
     victim = s.catalog.mvs["rj"].deployment.tasks[-1]
     victim.cancel()
     try:
         await victim
     except (asyncio.CancelledError, Exception):
         pass
-    await s.tick(3)
+    await _step(s.tick(3))
     assert s.recoveries >= 1
     rf = [r for roots in
           s.catalog.mvs["rj"].deployment.roots.values() for r in roots
           if isinstance(r, RemoteFragmentExecutor)]
     assert rf, "recovery dropped the remote placement"
     from risingwave_tpu.stream.message import PauseMutation
-    b = await s.coord.inject_barrier(mutation=PauseMutation())
-    await s.coord.wait_collected(b)
+    b = await _step(s.coord.inject_barrier(mutation=PauseMutation()))
+    await _step(s.coord.wait_collected(b))
     for _ in range(2):
-        b = await s.coord.inject_barrier()
-        await s.coord.wait_collected(b)
+        b = await _step(s.coord.inject_barrier())
+        await _step(s.coord.wait_collected(b))
     got = Counter(s.query("SELECT id, window_start FROM rj"))
     exp = _oracle(_source_offsets(s, "rj"))
     assert sum(exp.values()) > 0
