@@ -38,6 +38,10 @@ def _coerce(current, raw):
 class StreamingConfig:
     barrier_interval_ms: int = 1000
     checkpoint_frequency: int = 1
+    # bounded window of sealed-but-uncommitted checkpoint epochs the
+    # background uploader may hold (meta/barrier_manager.py); 0 = inline
+    # sync on the barrier path (the pre-pipeline behavior)
+    checkpoint_max_inflight: int = 2
     chunk_size: int = 8192
     channel_capacity: int = 64
     max_inflight_chunks: int = 16
@@ -99,13 +103,16 @@ class SystemParams:
     """Cluster-wide runtime-mutable params (ALTER SYSTEM analogue);
     observers are notified on change (the notification-service shape)."""
 
-    MUTABLE = {"barrier_interval_ms", "checkpoint_frequency"}
+    MUTABLE = {"barrier_interval_ms", "checkpoint_frequency",
+               "checkpoint_max_inflight"}
 
     def __init__(self, config: Optional[RwConfig] = None):
         cfg = config or RwConfig()
         self._values = {
             "barrier_interval_ms": cfg.streaming.barrier_interval_ms,
             "checkpoint_frequency": cfg.streaming.checkpoint_frequency,
+            "checkpoint_max_inflight":
+                cfg.streaming.checkpoint_max_inflight,
         }
         self._observers = []
 

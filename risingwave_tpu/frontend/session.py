@@ -131,6 +131,11 @@ class Session:
         # interval instead of one per chunk (common/chunk.py
         # ChunkCoalescer). 0 = off.
         "streaming_chunk_coalesce": (0, int),
+        # bounded window of sealed-but-uncommitted checkpoint epochs the
+        # background uploader may hold (meta/barrier_manager.py): barriers
+        # complete at seal, SST build/upload/manifest-swap overlap the next
+        # epochs' compute. 0 = inline sync on the barrier path.
+        "checkpoint_max_inflight": (2, int),
     }
 
     def __init__(self, store=None):
@@ -191,6 +196,10 @@ class Session:
         if objects is None:
             raise BindError("backup needs a durable (Hummock) store")
         async with self.coord._rounds_lock:
+            # the rounds lock stops NEW barriers; the background uploader
+            # may still hold sealed-but-uncommitted epochs — drain them so
+            # no manifest swap runs mid-copy
+            await self.coord.drain_uploads()
             # the rounds lock quiesces sync/compaction (every MANIFEST
             # swap), but DDL catalog uploads run outside it — snapshot
             # the catalog NOW and write the snapshot last, so the backup
@@ -327,6 +336,10 @@ class Session:
                 # build-time knob, read by build_graph when wiring
                 # exchange receivers (plan/build.py)
                 self.env.chunk_coalesce_max = self.config[stmt.name]
+            elif stmt.name == "checkpoint_max_inflight":
+                # runtime-mutable on the LIVE coordinator (the ALTER
+                # SYSTEM analogue): takes effect at the next barrier
+                self.coord.checkpoint_max_inflight = self.config[stmt.name]
             return self.config[stmt.name]
         if isinstance(stmt, ast.Select):
             return self.query_select(stmt)
@@ -745,7 +758,10 @@ class Session:
         # stale in-flight state (the dict-delta cursor carries over — the
         # dictionary itself survives in-process recovery)
         old_cursor = self.coord.dict_cursor
-        self.coord = BarrierCoordinator(self.store)
+        self.coord = BarrierCoordinator(
+            self.store,
+            checkpoint_max_inflight=self.config.get(
+                "checkpoint_max_inflight", 2))
         self.coord.dict_cursor = old_cursor
         self.env = BuildEnv(
             self.store, self.coord,
@@ -796,7 +812,11 @@ class Session:
     async def crash(self) -> None:
         """Abandon every actor task WITHOUT the stop protocol — the
         process-kill simulation used by restart/recovery tests. Catalog
-        and store are left as-is (a real crash persists both)."""
+        and store are left as-is (a real crash persists both). The
+        background uploader dies with the process too: sealed-but-
+        uncommitted epochs are dropped (commit point = manifest swap, so
+        nothing torn is ever visible) and recovery replays from the last
+        committed epoch."""
         for d in (list(self.catalog.mvs.values())
                   + list(self.catalog.sinks.values())):
             for t in d.deployment.tasks:
@@ -806,6 +826,7 @@ class Session:
                     await t
                 except (asyncio.CancelledError, Exception):
                     pass
+        await self.coord.abort_uploads()
 
     async def drop_all(self) -> None:
         for name in reversed(list(self.catalog.sinks)):

@@ -5,9 +5,22 @@ Reference: meta's GlobalBarrierManager (src/meta/src/barrier/mod.rs:481,634,
 barrier_manager.rs) collapsed into one in-process coordinator: paces barrier
 injection (`barrier_interval_ms`, system_param/mod.rs:77), pushes barriers
 into every source's dedicated channel, waits until every actor reports
-collection, then syncs the state store (the Hummock `commit_epoch` step) and
-completes the epoch IN ORDER. Barrier latency (inject -> fully synced) is the
-headline latency metric (grafana meta_barrier_latency).
+collection, then completes the epoch IN ORDER. Barrier latency (inject ->
+collected) is the headline latency metric (grafana meta_barrier_latency).
+
+Checkpoint durability is PIPELINED (reference: the Hummock event-handler
+uploader, src/storage/src/hummock/event_handler/uploader/ — epochs seal at
+the barrier, SSTs build/upload in background tasks, version commits apply
+in order): a checkpoint barrier only ENQUEUES its epoch to the background
+uploader task; the deferred executor flushes (blocking d2h), shared-buffer
+seal, SST build/upload and the in-order manifest swap all run behind the
+stream, so epoch N+1's compute overlaps epoch N's durable flush. A bounded
+in-flight window (`checkpoint_max_inflight`, default 2) backpressures
+barrier INJECTION when full — recovery replay distance stays bounded and a
+slow object store degrades throughput, never correctness. `committed_epoch`
+still advances only at the manifest swap, strictly in epoch order; with
+`checkpoint_max_inflight=0` (or a store without seal support) the old
+inline `store.sync()` path runs unchanged.
 """
 
 from __future__ import annotations
@@ -29,9 +42,17 @@ class EpochState:
     done: asyncio.Event = field(default_factory=asyncio.Event)
 
 
+@dataclass
+class _UploadJob:
+    """One checkpoint handed to the background uploader."""
+    prev_epoch: int          # the epoch being made durable
+    curr_epoch: int          # barrier whose trace gets the phase spans
+
+
 class BarrierCoordinator:
     def __init__(self, store: StateStore, interval_ms: int = 1000,
-                 checkpoint_frequency: int = 1):
+                 checkpoint_frequency: int = 1,
+                 checkpoint_max_inflight: int = 2):
         self.store = store
         self.interval_ms = interval_ms
         self.checkpoint_frequency = checkpoint_frequency
@@ -71,6 +92,45 @@ class BarrierCoordinator:
         # print ONE stuck-barrier diagnosis (spans + await tree) when a
         # collection exceeds this many seconds; None disables
         self.stuck_report_s: float | None = 60.0
+        # ---- async epoch uploader (the checkpoint pipeline) ----
+        self._upload_q: asyncio.Queue[_UploadJob] = asyncio.Queue()
+        self._uploader_task: Optional[asyncio.Task] = None
+        self._inflight = 0            # enqueued-but-uncommitted checkpoints
+        self._slot_free = asyncio.Event()
+        self._slot_free.set()
+        self._upload_failure: Optional[BaseException] = None
+        self.upload_busy_ns = 0       # total background flush+upload+commit
+        self.backpressure_wait_ns = 0  # injection stalls on a full window
+        from ..utils.metrics import (
+            CHECKPOINT_BACKPRESSURE_SECONDS, CHECKPOINT_COMMIT_SECONDS,
+            CHECKPOINT_INFLIGHT, CHECKPOINT_SEAL_SECONDS,
+            CHECKPOINT_UPLOAD_SECONDS)
+        self._m_seal = CHECKPOINT_SEAL_SECONDS
+        self._m_upload = CHECKPOINT_UPLOAD_SECONDS
+        self._m_commit = CHECKPOINT_COMMIT_SECONDS
+        self._m_inflight = CHECKPOINT_INFLIGHT
+        self._m_backpressure = CHECKPOINT_BACKPRESSURE_SECONDS
+        self.checkpoint_max_inflight = checkpoint_max_inflight
+
+    # ------------------------------------------------- checkpoint pipeline
+    @property
+    def checkpoint_max_inflight(self) -> int:
+        return self._ckpt_max_inflight
+
+    @checkpoint_max_inflight.setter
+    def checkpoint_max_inflight(self, n: int) -> None:
+        """Runtime-mutable (SET checkpoint_max_inflight / ALTER SYSTEM):
+        0 restores the inline-sync path; >0 bounds the pipeline window.
+        Also flips the store's deferred-flush gate so executors only defer
+        their d2h persists when a background uploader will drain them."""
+        self._ckpt_max_inflight = int(n)
+        if hasattr(self.store, "defer_enabled"):
+            self.store.defer_enabled = self.pipelined
+        self._slot_free.set()         # re-evaluate any backpressured waiter
+
+    @property
+    def pipelined(self) -> bool:
+        return self._ckpt_max_inflight > 0 and hasattr(self.store, "seal")
 
     # -------------------------------------------------------- registration
     def register_source(self, queue: asyncio.Queue) -> None:
@@ -104,12 +164,22 @@ class BarrierCoordinator:
         if self._failure is not None:
             actor_id, exc = self._failure
             raise RuntimeError(f"actor {actor_id} died") from exc
-        curr = next_epoch(self._prev_epoch)
-        epoch = EpochPair(curr, self._prev_epoch)
+        if self._upload_failure is not None:
+            exc = self._upload_failure
+            raise RuntimeError(
+                "checkpoint upload/commit failed; recovery must replay "
+                "from the last committed epoch") from exc
         if kind is None:
             self._barrier_count += 1
             is_ckpt = (self._barrier_count % self.checkpoint_frequency) == 0
             kind = BarrierKind.CHECKPOINT if is_ckpt else BarrierKind.BARRIER
+        if kind is BarrierKind.CHECKPOINT:
+            # bounded in-flight window: a full uploader queue backpressures
+            # INJECTION (not collection) so barrier latency stays honest
+            # and recovery replay distance stays <= the window
+            await self._acquire_ckpt_slot()
+        curr = next_epoch(self._prev_epoch)
+        epoch = EpochPair(curr, self._prev_epoch)
         barrier = Barrier(epoch, kind, mutation, (), time.monotonic_ns())
         self._epochs[curr] = EpochState(barrier, set(self.actor_ids))
         self._prev_epoch = curr
@@ -162,11 +232,18 @@ class BarrierCoordinator:
                 from ..common.types import persist_dict_delta
                 self.dict_cursor = persist_dict_delta(
                     objects, self.dict_cursor)
-            t_sync = time.monotonic_ns()
-            self.store.sync(barrier.epoch.prev)
-            self.committed_epochs.append(barrier.epoch.prev)
-            self.tracer.end(barrier.epoch.curr,
-                            sync_ns=time.monotonic_ns() - t_sync)
+            if self.pipelined:
+                # seal/upload/commit run behind the stream: the barrier
+                # completes as soon as the epoch is enqueued, so the
+                # latency below excludes the whole durable flush
+                self._enqueue_upload(barrier)
+                self.tracer.end(barrier.epoch.curr)
+            else:
+                t_sync = time.monotonic_ns()
+                self.store.sync(barrier.epoch.prev)
+                self.committed_epochs.append(barrier.epoch.prev)
+                self.tracer.end(barrier.epoch.curr,
+                                sync_ns=time.monotonic_ns() - t_sync)
         else:
             self.tracer.end(barrier.epoch.curr)
         lat_ns = time.monotonic_ns() - barrier.inject_time_ns
@@ -191,6 +268,14 @@ class BarrierCoordinator:
                     await asyncio.sleep(interval_s)
                 b = await self.inject_barrier()
                 await self.wait_collected(b)
+            # settle: uploads overlap ACROSS the rounds above, but callers
+            # of run_rounds/tick (tests, the playground ticker, DDL
+            # bring-up) expect the committed snapshot to include every
+            # ticked epoch once this returns. Latency metrics are already
+            # recorded per barrier, so the drain never inflates them; the
+            # bench/profile measured loops call inject/wait directly and
+            # keep full overlap.
+            await self.drain_uploads()
 
     async def stop_all(self, actor_ids: Optional[set[int]] = None) -> None:
         from ..stream.message import StopMutation
@@ -199,6 +284,120 @@ class BarrierCoordinator:
                             else self.actor_ids)
             b = await self.inject_barrier(mutation=StopMutation(ids))
             await self.wait_collected(b)
+            # a stop is a quiesce point: everything enqueued must be
+            # durable before the caller reads committed state / exits
+            await self.drain_uploads()
+
+    # -------------------------------------------------- background uploader
+    def _enqueue_upload(self, barrier: Barrier) -> None:
+        self._inflight += 1
+        self._m_inflight.set(self._inflight)
+        self._upload_q.put_nowait(
+            _UploadJob(barrier.epoch.prev, barrier.epoch.curr))
+        if self._uploader_task is None or self._uploader_task.done():
+            self._uploader_task = asyncio.get_running_loop().create_task(
+                self._upload_worker(), name="epoch-uploader")
+
+    async def _acquire_ckpt_slot(self) -> None:
+        if not self.pipelined:
+            return
+        t0 = time.monotonic_ns()
+        while (self._inflight >= self._ckpt_max_inflight
+               and self.pipelined and self._upload_failure is None
+               and self._failure is None):
+            self._slot_free.clear()
+            await self._slot_free.wait()
+        waited = time.monotonic_ns() - t0
+        if waited:
+            self.backpressure_wait_ns += waited
+            self._m_backpressure.inc(waited / 1e9)
+
+    async def _upload_worker(self) -> None:
+        """Drains the checkpoint queue STRICTLY in order: per epoch, run
+        the executors' deferred flush stages (blocking d2h waits on a
+        worker thread, count-dependent dispatch continuations back on the
+        loop — dispatching from two threads concurrently deadlocks jax),
+        seal the shared buffer, build+upload the SST off the loop, then
+        swap the manifest on the loop. A failure parks the error for the
+        next inject_barrier (fail-stop: recovery replays from the last
+        committed epoch, exactly like an actor death)."""
+        store = self.store
+        while True:
+            if self._upload_q.empty():
+                return        # respawned by the next enqueue; no parked task
+            job = self._upload_q.get_nowait()
+            try:
+                t0 = time.monotonic_ns()
+                for stages in store.take_deferred(job.prev_epoch):
+                    for wait, cont in stages:
+                        payload = (await asyncio.to_thread(wait)
+                                   if wait is not None else None)
+                        cont(payload)
+                batch = store.seal(job.prev_epoch)
+                t1 = time.monotonic_ns()
+                await asyncio.to_thread(store.upload_sealed, batch)
+                t2 = time.monotonic_ns()
+                store.commit_sealed(batch)
+                t3 = time.monotonic_ns()
+                self.committed_epochs.append(job.prev_epoch)
+                self.upload_busy_ns += t3 - t0
+                self._m_seal.observe((t1 - t0) / 1e9)
+                self._m_upload.observe((t2 - t1) / 1e9)
+                self._m_commit.observe((t3 - t2) / 1e9)
+                self.tracer.annotate(job.curr_epoch, seal_ns=t1 - t0,
+                                     upload_ns=t2 - t1, commit_ns=t3 - t2)
+            except asyncio.CancelledError:
+                self._inflight -= 1
+                self._slot_free.set()
+                self._upload_q.task_done()
+                raise
+            except BaseException as e:  # noqa: BLE001 — park for injection
+                self._upload_failure = e
+            self._inflight -= 1
+            self._m_inflight.set(self._inflight)
+            self._slot_free.set()
+            self._upload_q.task_done()
+
+    async def drain_uploads(self) -> None:
+        """Block until every enqueued checkpoint has committed (or failed).
+        Quiesce point for stop/backup/profiling — NOT part of the barrier
+        path."""
+        if self._uploader_task is not None:
+            await self._upload_q.join()
+        if self._upload_failure is not None:
+            exc = self._upload_failure
+            raise RuntimeError(
+                "checkpoint upload/commit failed during drain") from exc
+
+    async def abort_uploads(self) -> None:
+        """Crash/recovery entry: cancel the uploader and drop queued jobs
+        WITHOUT committing them. An upload already in flight can at worst
+        leave an orphan SST no manifest references; the commit point
+        (manifest swap) never runs for aborted epochs, so the caller's
+        `reset_uncommitted` + replay from `committed_epoch` stays exact."""
+        t = self._uploader_task
+        self._uploader_task = None
+        if t is not None and not t.done():
+            t.cancel()
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        while not self._upload_q.empty():
+            self._upload_q.get_nowait()
+            self._upload_q.task_done()
+        self._inflight = 0
+        self._m_inflight.set(0)
+        self._slot_free.set()
+
+    def upload_overlap_pct(self) -> Optional[float]:
+        """% of background durable-flush busy time hidden behind compute:
+        100 * (1 - injection_backpressure / uploader_busy). None before
+        the first pipelined checkpoint commits."""
+        if self.upload_busy_ns <= 0:
+            return None
+        hidden = max(0, self.upload_busy_ns - self.backpressure_wait_ns)
+        return round(100.0 * hidden / self.upload_busy_ns, 1)
 
     # -------------------------------------------------------------- metrics
     def barrier_latency_percentile(self, p: float) -> float:

@@ -6,12 +6,26 @@ docs/checkpoint.md:38-44). The shape kept here:
 
 - `ingest_batch` stages writes in a per-epoch shared buffer (immediately
   readable — mem-table read-through semantics match MemoryStateStore).
-- `sync(epoch)` seals every buffered epoch <= `epoch`, merges them into ONE
-  sorted run, uploads it as an L0 SST to the object store, then atomically
-  swaps the manifest (the version-commit step meta performs in the
-  reference). Only after the manifest lands is the epoch committed — a crash
-  at any point recovers to the last manifest, never a torn state.
-- Reads merge: shared buffer (newest epoch wins) > L0 (newest SST wins) > L1.
+- The checkpoint pipeline is split into three phases (reference: the
+  event-handler uploader, src/storage/src/hummock/event_handler/uploader/ —
+  epochs seal at the barrier, SSTs build/upload in background tasks, and
+  the version commit applies them strictly in epoch order):
+    * `seal(epoch)`   — cheap: move every buffered epoch <= `epoch` into an
+      immutable SealedBatch on the sealed queue (no merging, no encoding).
+    * `upload_sealed(batch)` — slow, thread-safe: merge the batch into ONE
+      sorted run, build the SST, PUT it to the object store. Touches only
+      the immutable batch and the object store, so a background thread can
+      run it while the stream keeps computing.
+    * `commit_sealed(batch)` — the commit point: insert the SST into L0,
+      maybe compact, atomically swap the manifest. Refuses out-of-order
+      commits (`batch` must be the oldest sealed batch). Only after the
+      manifest lands is the epoch committed — a crash at any point recovers
+      to the last manifest, never a torn state.
+  `sync(epoch)` remains the inline composition of the three (seal + drain
+  the sealed queue in order) for tests and non-pipelined callers.
+- Reads merge: shared buffer (newest epoch wins) > sealed-but-uncommitted
+  batches (newest first) > L0 (newest SST wins) > L1. committed_only reads
+  see neither staged nor sealed data.
 - When L0 grows past a threshold, a full compaction merges L0+L1 into one
   bottom-level SST and drops tombstones (the reference's compactor collapsed
   to its essential effect).
@@ -37,13 +51,39 @@ def _sst_path(sst_id: int) -> str:
     return f"ssts/{sst_id:010d}.sst"
 
 
+class SealedBatch:
+    """Immutable snapshot of shared-buffer epochs <= seal_epoch, queued for
+    background upload. The per-epoch dicts are kept distinct (not merged)
+    so reads and `max_epoch` filtering keep exact shared-buffer semantics
+    until the commit lands; the merge happens in `upload_sealed`, off the
+    barrier path. `sst_id` is allocated at seal time (on the event loop, so
+    ids stay ordered even with uploads in flight); `data` is set by the
+    upload phase and is what `commit_sealed` installs into L0."""
+
+    __slots__ = ("seal_epoch", "epochs", "sst_id", "data")
+
+    def __init__(self, seal_epoch: int,
+                 epochs: dict[int, dict[bytes, Optional[bytes]]]):
+        self.seal_epoch = seal_epoch
+        self.epochs = epochs
+        self.sst_id: Optional[int] = None
+        self.data: Optional[bytes] = None
+
+    @property
+    def is_empty(self) -> bool:
+        return not any(self.epochs.values())
+
+
 class HummockStateStore(StateStore):
     L0_COMPACT_THRESHOLD = 8
 
     def __init__(self, object_store: ObjectStore):
+        super().__init__()
         self.objects = object_store
         # epoch -> {key: value|None}; dict order = staging order within epoch
         self._shared: dict[int, dict[bytes, Optional[bytes]]] = {}
+        # sealed-but-uncommitted batches, oldest first (the uploader queue)
+        self._sealed: list[SealedBatch] = []
         self._l0: list[SsTable] = []   # newest first
         self._l1: Optional[SsTable] = None
         self._next_sst_id = 1
@@ -78,6 +118,11 @@ class HummockStateStore(StateStore):
             buf = self._shared[epoch]
             if key in buf:
                 return buf[key]
+        for batch in reversed(self._sealed):          # newest batch first
+            for epoch in sorted(batch.epochs, reverse=True):
+                buf = batch.epochs[epoch]
+                if key in buf:
+                    return buf[key]
         for sst in self._l0:
             found, v = sst.get(key)
             if found:
@@ -101,10 +146,15 @@ class HummockStateStore(StateStore):
         in-flight barrier epoch, so only staged epochs need filtering)."""
         streams = []
         if not committed_only:
-            for epoch in sorted(self._shared, reverse=True):  # newest first
+            buffers = [(e, self._shared[e])
+                       for e in sorted(self._shared, reverse=True)]
+            for batch in reversed(self._sealed):  # sealed = still staged
+                buffers.extend(
+                    (e, batch.epochs[e])
+                    for e in sorted(batch.epochs, reverse=True))
+            for epoch, buf in buffers:            # newest first
                 if max_epoch is not None and epoch > max_epoch:
                     continue
-                buf = self._shared[epoch]
                 streams.append(sorted(
                     (k, v) for k, v in buf.items()
                     if start <= k and (not end or k < end)))
@@ -118,37 +168,73 @@ class HummockStateStore(StateStore):
         return self._committed_epoch
 
     def reset_uncommitted(self) -> None:
-        """Drop the shared buffer — the recovery entry point (reference:
-        recovery resumes at the last committed Hummock version; anything
-        newer was never externally visible). A process restart gets this
-        for free; an in-process restart (rescale, failover tests) must
-        call it or stale uncommitted epochs would leak into new ones."""
+        """Drop the shared buffer AND the sealed-but-uncommitted queue —
+        the recovery entry point (reference: recovery resumes at the last
+        committed Hummock version; anything newer was never externally
+        visible). A process restart gets this for free; an in-process
+        restart (rescale, failover tests) must call it or stale
+        uncommitted epochs would leak into new ones. The caller must have
+        stopped the background uploader first (BarrierCoordinator.
+        abort_uploads) — an in-flight upload can at worst leave an orphan
+        SST, which no manifest references."""
         self._shared.clear()
+        self._sealed.clear()
+        self._deferred.clear()
 
     # -------------------------------------------------------------- writes
     def ingest_batch(self, batch: WriteBatch) -> None:
         self._shared.setdefault(batch.epoch, {}).update(batch.puts)
 
-    def sync(self, epoch: int) -> dict:
-        sealed = sorted(e for e in self._shared if e <= epoch)
-        merged: dict[bytes, Optional[bytes]] = {}
-        for e in sealed:                         # oldest -> newest overlay
-            merged.update(self._shared[e])
-        new_ids: list[int] = []
-        if merged:
-            sst_id = self._next_sst_id
+    # ------------------------------------------------- seal/upload/commit
+    def seal(self, epoch: int) -> SealedBatch:
+        """Phase 1, cheap (at the barrier / on the event loop): move every
+        shared-buffer epoch <= `epoch` into an immutable SealedBatch on the
+        sealed queue. The batch stays readable (and retryable: the staged
+        writes are not dropped until `commit_sealed`) — the generalization
+        of the old upload-before-drop invariant to a queue of batches."""
+        assert not self._sealed or epoch >= self._sealed[-1].seal_epoch, \
+            f"seal epochs must be monotone ({epoch} after " \
+            f"{self._sealed[-1].seal_epoch})"
+        eps = sorted(e for e in self._shared if e <= epoch)
+        batch = SealedBatch(epoch, {e: self._shared.pop(e) for e in eps})
+        if not batch.is_empty:
+            batch.sst_id = self._next_sst_id
             self._next_sst_id += 1
-            data = build_sstable(epoch, sorted(merged.items()))
-            # upload BEFORE dropping the shared-buffer epochs: an upload
-            # failure must leave the staged writes intact so a retry (or
-            # fail-stop replay) can still commit them — popping first would
-            # let a later sync() silently commit a manifest missing them
-            self.objects.upload(_sst_path(sst_id), data)
-            self._l0.insert(0, SsTable.parse(sst_id, data))
-            new_ids.append(sst_id)
-        for e in sealed:
-            del self._shared[e]
-        self._committed_epoch = max(self._committed_epoch, epoch)
+        self._sealed.append(batch)
+        return batch
+
+    def upload_sealed(self, batch: SealedBatch) -> None:
+        """Phase 2, slow: merge + build + PUT the batch's SST. Thread-safe
+        (touches only the immutable batch and the object store), so the
+        background uploader runs it via asyncio.to_thread while the stream
+        keeps computing. No store state mutates here; a failure or a crash
+        mid-upload leaves at worst an orphan object no manifest references."""
+        if batch.sst_id is None or batch.data is not None:
+            return
+        merged: dict[bytes, Optional[bytes]] = {}
+        for e in sorted(batch.epochs):           # oldest -> newest overlay
+            merged.update(batch.epochs[e])
+        data = build_sstable(batch.seal_epoch, sorted(merged.items()))
+        self.objects.upload(_sst_path(batch.sst_id), data)
+        batch.data = data
+
+    def commit_sealed(self, batch: SealedBatch) -> dict:
+        """Phase 3, the commit point (event loop only): install the SST
+        into L0, advance the committed epoch, maybe compact, atomically
+        swap the manifest. STRICTLY in seal order — `batch` must be the
+        oldest sealed batch, so a fast epoch N+1 upload can never publish
+        a manifest missing epoch N."""
+        assert self._sealed and self._sealed[0] is batch, (
+            "manifest swaps must land in seal order (epoch "
+            f"{batch.seal_epoch} is not the oldest sealed batch)")
+        new_ids: list[int] = []
+        if batch.sst_id is not None:
+            assert batch.data is not None, \
+                "commit_sealed before upload_sealed"
+            self._l0.insert(0, SsTable.parse(batch.sst_id, batch.data))
+            new_ids.append(batch.sst_id)
+        self._sealed.pop(0)
+        self._committed_epoch = max(self._committed_epoch, batch.seal_epoch)
         obsolete: list[int] = []
         if len(self._l0) > self.L0_COMPACT_THRESHOLD:
             obsolete = self._compact()
@@ -156,6 +242,21 @@ class HummockStateStore(StateStore):
         self._write_manifest()
         for sst_id in obsolete:
             self.objects.delete(_sst_path(sst_id))
+        return {"uncommitted_ssts": new_ids}
+
+    def sync(self, epoch: int) -> dict:
+        """Inline composition of the pipeline: run any deferred executor
+        flushes, seal, then drain the sealed queue in order (uploading
+        batches the background path has not gotten to). Tests and the
+        non-pipelined coordinator mode call this; the pipelined path calls
+        the phases directly."""
+        self.run_deferred(epoch)
+        self.seal(epoch)
+        new_ids: list[int] = []
+        while self._sealed and self._sealed[0].seal_epoch <= epoch:
+            b = self._sealed[0]
+            self.upload_sealed(b)
+            new_ids.extend(self.commit_sealed(b)["uncommitted_ssts"])
         return {"uncommitted_ssts": new_ids}
 
     # ---------------------------------------------------------- compaction

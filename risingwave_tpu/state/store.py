@@ -59,7 +59,56 @@ class WriteBatch:
 class StateStore:
     """Epoch-versioned KV. Writes are staged per epoch and become readable
     immediately to the writer (mem-table semantics handled by StateTable);
-    `sync(epoch)` makes everything up to `epoch` durable."""
+    `sync(epoch)` makes everything up to `epoch` durable.
+
+    Deferred-flush protocol (the async-checkpoint hook): a stateful
+    executor's barrier-time persist splits into a device-dispatch half
+    (runs at the barrier) and a staged host half registered here via
+    `defer_flush(epoch, *stages)`, each stage a `(wait, cont)` pair:
+
+      * `wait()` -> payload: a PURE device wait / host computation (an
+        `np.asarray` of an already-dispatched buffer, `utils/d2h.py
+        fetch_flat`). The background uploader runs it on a worker
+        thread. It MUST NOT dispatch jax ops — a second thread
+        dispatching concurrently with the event loop deadlocks jax.
+      * `cont(payload)`: runs on the event loop; may dispatch follow-up
+        device ops (count-dependent prefix slicing/packing) and write/
+        commit state tables.
+
+    With `defer_enabled` False (the default — unit tests driving
+    executors directly, inline-sync mode) all stages run immediately in
+    order, which is exactly the pre-pipeline behavior. The barrier
+    coordinator's background uploader enables deferral and drains the
+    queue before sealing each epoch, so the stream never waits for the
+    d2h + encode + ingest cost."""
+
+    def __init__(self):
+        # FIFO of (epoch, stages); epoch = the shared-buffer epoch the
+        # flush writes into (must run before that epoch seals)
+        self._deferred: list[tuple] = []
+        self.defer_enabled = False
+
+    @staticmethod
+    def _run_stages(stages) -> None:
+        for wait, cont in stages:
+            cont(wait() if wait is not None else None)
+
+    def defer_flush(self, epoch: int, *stages) -> None:
+        if self.defer_enabled:
+            self._deferred.append((epoch, stages))
+        else:
+            self._run_stages(stages)
+
+    def take_deferred(self, epoch: int) -> list[tuple]:
+        """Pop every stage list registered for epochs <= epoch, in
+        registration order."""
+        taken = [st for e, st in self._deferred if e <= epoch]
+        self._deferred = [t for t in self._deferred if t[0] > epoch]
+        return taken
+
+    def run_deferred(self, epoch: int) -> None:
+        for stages in self.take_deferred(epoch):
+            self._run_stages(stages)
 
     def get(self, key: bytes) -> Optional[bytes]:
         raise NotImplementedError
@@ -96,6 +145,7 @@ class MemoryStateStore(StateStore):
     snapshot reads on the in-memory store too."""
 
     def __init__(self):
+        super().__init__()
         self._keys: list[bytes] = []       # sorted, synced base
         self._vals: dict[bytes, bytes] = {}
         self._shared: dict[int, dict[bytes, Optional[bytes]]] = {}
@@ -133,6 +183,7 @@ class MemoryStateStore(StateStore):
         self._shared.setdefault(batch.epoch, {}).update(batch.puts)
 
     def sync(self, epoch: int) -> dict:
+        self.run_deferred(epoch)
         for e in sorted(e for e in self._shared if e <= epoch):
             for k, v in self._shared.pop(e).items():
                 if v is None:
@@ -154,3 +205,4 @@ class MemoryStateStore(StateStore):
     def reset_uncommitted(self) -> None:
         """Recovery entry point (see HummockStateStore.reset_uncommitted)."""
         self._shared.clear()
+        self._deferred.clear()

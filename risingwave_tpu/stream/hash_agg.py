@@ -446,38 +446,88 @@ class HashAggExecutor(Executor):
 
     # ------------------------------------------------------- persistence
     def _persist(self, barrier: Barrier) -> None:
+        """Overlap-friendly durable flush: the packed persist/evict views
+        are DISPATCHED here (device work queues behind the epoch's applies,
+        into fresh non-donated buffers), and the blocking work hands off
+        to the store as a staged deferred flush — inline by default,
+        drained by the barrier coordinator's background uploader in
+        pipelined mode, so the stream resumes as soon as the dispatch is
+        queued. Stage waits are PURE (np.asarray of dispatched buffers,
+        thread-safe); the count-dependent prefix slicing/packing happens
+        in the stage continuations, which always run on the event loop.
+
+        d2h discipline (tunneled TPU charges ~0.15-0.3s PER FETCH CALL
+        regardless of size): dirty rows are compacted to the buffer
+        prefix, and the whole payload — ops, vis, every column (floats
+        bitcast), evict keys — ships in TWO calls (counts, then one
+        packed buffer)."""
         if self.state_table is None:
             return
+        from ..utils.d2h import (fetch_flat, finish_prefix_groups,
+                                 prepare_prefix_groups)
+        st = self.state_table
+        dev_rows = n_dirty = None
         if self._applied_since_flush:
             cols, ops, vis, n_dirty = self._flush_persist_view()
-            # d2h discipline (tunneled TPU charges ~0.15-0.3s PER FETCH
-            # CALL regardless of size): dirty rows are compacted to the
-            # buffer prefix, and the whole prefix — ops, vis, every
-            # column (floats bitcast) — ships in TWO calls
-            nd = int(n_dirty)
-            if nd:
-                from ..utils.d2h import fetch_prefix_groups
-                (host,) = fetch_prefix_groups(
-                    [([ops, vis] + list(cols), nd)])
-                self.state_table.write_chunk_columns(
-                    host[0], host[2:], host[1])
+            dev_rows = [ops, vis] + list(cols)
+        dev_evict = n_ev = None
         if (self.cleaning_watermark_key is not None
                 and self._pending_clean_wm is not None):
             # evicted groups leave the durable table in the SAME epoch their
             # device state is zeroed, so committed state stays bounded and
             # recovery never resurrects dead windows (mem-table is a dict:
             # these tombstones override any insert staged above)
-            self._write_evict_deletes(self._pending_clean_wm)
-        self.state_table.commit(barrier.epoch.curr)
+            keys_dev, n_ev = self._evict_keys(self.state,
+                                              self._pending_clean_wm)
+            dev_evict = list(keys_dev)
+        count_parts = [jnp.ravel(x) for x in (n_dirty, n_ev)
+                       if x is not None]
+        counts_dev = (jnp.concatenate(count_parts) if count_parts
+                      else None)
+        new_epoch = barrier.epoch.curr
+        cell: dict = {}
 
-    def _write_evict_deletes(self, watermark: int) -> None:
-        keys, n = self._evict_keys(self.state, watermark)
-        n = int(n)
-        if not n:
-            return
-        # one packed fetch (same per-call d2h discipline as _persist)
-        from ..utils.d2h import fetch_prefix_groups
-        (keys_np,) = fetch_prefix_groups([(list(keys), n)])
+        def wait_counts():
+            return np.asarray(counts_dev) if counts_dev is not None else None
+
+        def cont_prepare(counts):
+            groups, i = [], 0
+            cell["nd"] = cell["nev"] = 0
+            if dev_rows is not None:
+                cell["nd"] = int(counts[i])
+                i += 1
+                if cell["nd"]:
+                    groups.append((dev_rows, cell["nd"]))
+            if dev_evict is not None:
+                cell["nev"] = int(counts[i])
+                i += 1
+                if cell["nev"]:
+                    groups.append((dev_evict, cell["nev"]))
+            if groups:
+                cell["prep"] = prepare_prefix_groups(groups)
+
+        def wait_flat():
+            prep = cell.get("prep")
+            return fetch_flat(prep[0]) if prep is not None else None
+
+        def cont_apply(host_flat):
+            prep = cell.get("prep")
+            if prep is not None:
+                outs = finish_prefix_groups(host_flat, prep[1], prep[2])
+                oi = 0
+                if cell["nd"]:
+                    host = outs[oi]
+                    oi += 1
+                    st.write_chunk_columns(host[0], host[2:], host[1])
+                if cell["nev"]:
+                    self._apply_evict_deletes(outs[oi], cell["nev"])
+            st.commit(new_epoch)
+
+        st.store.defer_flush(barrier.epoch.prev,
+                             (wait_counts, cont_prepare),
+                             (wait_flat, cont_apply))
+
+    def _apply_evict_deletes(self, keys_np, n: int) -> None:
         width = sum(self._call_persist_width(j)
                     for j in range(len(self.specs))) + 1
         pad = (0,) * width                  # non-pk columns unused by delete

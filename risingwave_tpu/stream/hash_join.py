@@ -416,39 +416,93 @@ class HashJoinExecutor(Executor):
         return cols, ops, vis
 
     def _persist(self, barrier: Barrier) -> None:
+        """Overlap-friendly durable flush (see HashAggExecutor._persist):
+        the persist/evict views dispatch here against non-donated buffers
+        and the dirty bits reset on-device immediately; the blocking d2h
+        + columnar writes + commit run as a staged deferred store flush —
+        inline by default, on the background uploader in pipelined mode.
+        Both sides' payloads (full persist views + evict-delete prefixes)
+        pack into ONE flat fetch; evict counts ride a separate tiny
+        counts fetch first."""
+        jobs = []    # (state_table, persist-view arrays|None, evict|None)
+        ev_counts = []
         for s in (LEFT, RIGHT):
             st = self.state_tables[s]
             if st is None:
                 continue
+            dev = None
             if self._dirty_since_flush[s]:
                 cols, ops, vis = self._persist_view(self.sides[s])
-                vis_np = np.asarray(vis)
-                if vis_np.any():
-                    # columnar batch write (state_table.rs:946): the C++
-                    # codec path, no per-row Python on the barrier
-                    st.write_chunk_columns(
-                        np.asarray(ops), [np.asarray(c) for c in cols],
-                        vis_np)
+                dev = [ops, vis] + list(cols)
                 side = self.sides[s]
                 self.sides[s] = JoinSideState(
                     side.key_table, side.head, side.rows, side.valids,
                     side.next, side.live,
                     jnp.zeros(side.row_capacity, dtype=bool), side.top)
                 self._dirty_since_flush[s] = False
-            if self._pending_clean[s] is not None and self.clean_cols[s] is not None:
-                self._write_evict_deletes(s, self._pending_clean[s])
-            st.commit(barrier.epoch.curr)
-
-    def _write_evict_deletes(self, s: int, wm: int) -> None:
-        cols, n = self._evict_rows(self.sides[s], wm, side=s)
-        n = int(n)
-        if not n:
+            ev = None
+            if self._pending_clean[s] is not None \
+                    and self.clean_cols[s] is not None:
+                ev_cols_dev, n_ev = self._evict_rows(
+                    self.sides[s], self._pending_clean[s], side=s)
+                ev = list(ev_cols_dev)
+                ev_counts.append(jnp.ravel(n_ev))
+            jobs.append((st, dev, ev))
+        if not jobs:
             return
-        cols_np = [np.asarray(c) for c in cols]
-        vis = np.zeros(len(cols_np[0]), dtype=bool)
-        vis[:n] = True
-        self.state_tables[s].write_chunk_columns(
-            np.full(len(vis), OP_DELETE, dtype=np.int8), cols_np, vis)
+        from ..utils.d2h import (fetch_flat, finish_prefix_groups,
+                                 prepare_prefix_groups)
+        counts_dev = jnp.concatenate(ev_counts) if ev_counts else None
+        new_epoch = barrier.epoch.curr
+        cell: dict = {}
+
+        def wait_counts():
+            return np.asarray(counts_dev) if counts_dev is not None else None
+
+        def cont_prepare(counts):
+            groups, plan, ci = [], [], 0
+            for _, dev, ev in jobs:
+                g_dev = g_ev = None
+                n_ev = 0
+                if dev is not None:
+                    g_dev = len(groups)
+                    groups.append((dev, int(dev[0].shape[0])))  # full view
+                if ev is not None:
+                    n_ev = int(counts[ci])
+                    ci += 1
+                    if n_ev:
+                        g_ev = len(groups)
+                        groups.append((ev, n_ev))
+                plan.append((g_dev, g_ev, n_ev))
+            cell["plan"] = plan
+            if groups:
+                cell["prep"] = prepare_prefix_groups(groups)
+
+        def wait_flat():
+            prep = cell.get("prep")
+            return fetch_flat(prep[0]) if prep is not None else None
+
+        def cont_apply(host_flat):
+            prep = cell.get("prep")
+            outs = (finish_prefix_groups(host_flat, prep[1], prep[2])
+                    if prep is not None else [])
+            for (st, _, _), (g_dev, g_ev, n_ev) in zip(jobs, cell["plan"]):
+                if g_dev is not None:
+                    host = outs[g_dev]
+                    vis_np = host[1].astype(bool, copy=False)
+                    if vis_np.any():
+                        # columnar batch write (state_table.rs:946): the
+                        # C++ codec path, no per-row Python
+                        st.write_chunk_columns(host[0], host[2:], vis_np)
+                if g_ev is not None:
+                    st.write_chunk_columns(
+                        np.full(n_ev, OP_DELETE, dtype=np.int8),
+                        outs[g_ev], np.ones(n_ev, dtype=bool))
+                st.commit(new_epoch)
+
+        jobs[0][0].store.defer_flush(barrier.epoch.prev,
+                                     (wait_counts, cont_prepare),
+                                     (wait_flat, cont_apply))
 
     def _evict_rows_impl(self, side_state: JoinSideState, wm, side: int):
         col = self.clean_cols[side]

@@ -14,9 +14,14 @@ This is the SAME executor logic as HashAggExecutor — `_apply_impl`,
 `_flush_impl`, `_evict_impl`, `_rehash_impl` are inherited unchanged and
 wrapped in shard_map; capacities inside are the per-shard local shapes.
 
-v1 scope: device-resident only (no durable state table) and static
-capacity (overflow still fail-stops via the device watchdog; the
-transfer-free purge path works per shard).
+Durability: fully supported — `_persist` runs a per-shard persist view
+(each shard's dirty rows compact to its local prefix) and ships all
+shards' prefixes in two packed d2h calls into the state table, and
+`recover` rebuilds the sharded device state by routing durable rows
+through the same vnode->shard map the apply path masks by. Per-shard
+capacity stays static at runtime (growth would need a global re-layout;
+recovery may re-size from the worst shard's row count), and the
+transfer-free purge path works per shard.
 """
 
 from __future__ import annotations
@@ -171,31 +176,78 @@ class ShardedHashAggExecutor(HashAggExecutor):
         """Durable flush of the SHARDED state: the per-shard persist
         view compacts each shard's dirty rows to its LOCAL prefix; all
         shards' prefixes ship in TWO d2h calls (counts, then one packed
-        buffer — same per-call d2h discipline as the parent's)."""
+        buffer — same per-call d2h discipline as the parent's). Like the
+        parent's, the device views dispatch AT the barrier and the
+        blocking fetch + writes + commit defer to the store (drained by
+        the background uploader in pipelined mode)."""
         if self.state_table is None:
             return
+        from ..utils.d2h import (fetch_flat, finish_prefix_groups,
+                                 prepare_prefix_groups)
+        st = self.state_table
+        dev = None
         if self._applied_since_flush:
-            from ..utils.d2h import fetch_prefix_groups
-            cols, ops, vis, n_dirty = self._persist_view_sh(self.state)
-            nds = np.asarray(n_dirty)
-            C = self.capacity
-            groups = []
-            for sh in range(self.n_shards):
-                nd = int(nds[sh])
-                if not nd:
-                    continue
-                lo = sh * C
-                groups.append((
-                    [ops[lo:lo + C], vis[lo:lo + C]]
-                    + [c[lo:lo + C] for c in cols], nd))
-            if groups:
-                for seg in fetch_prefix_groups(groups):
-                    self.state_table.write_chunk_columns(
-                        seg[0], seg[2:], seg[1])
+            dev = self._persist_view_sh(self.state)
+        dev_evict = n_ev = None
         if (self.cleaning_watermark_key is not None
                 and self._pending_clean_wm is not None):
-            self._write_evict_deletes(self._pending_clean_wm)
-        self.state_table.commit(barrier.epoch.curr)
+            keys_dev, n_ev = self._evict_keys(self.state,
+                                              self._pending_clean_wm)
+            dev_evict = list(keys_dev)
+        count_parts = []
+        if dev is not None:
+            count_parts.append(jnp.ravel(dev[3]))      # n_dirty per shard
+        if dev_evict is not None:
+            count_parts.append(jnp.ravel(n_ev))
+        counts_dev = (jnp.concatenate(count_parts) if count_parts
+                      else None)
+        new_epoch = barrier.epoch.curr
+        C, S = self.capacity, self.n_shards
+        cell: dict = {}
+
+        def wait_counts():
+            return np.asarray(counts_dev) if counts_dev is not None else None
+
+        def cont_prepare(counts):
+            groups, i = [], 0
+            cell["n_rows_groups"] = 0
+            cell["nev"] = 0
+            if dev is not None:
+                cols, ops, vis, _ = dev
+                for sh in range(S):
+                    nd = int(counts[i + sh])
+                    if not nd:
+                        continue
+                    lo = sh * C
+                    groups.append((
+                        [ops[lo:lo + C], vis[lo:lo + C]]
+                        + [c[lo:lo + C] for c in cols], nd))
+                cell["n_rows_groups"] = len(groups)
+                i += S
+            if dev_evict is not None:
+                cell["nev"] = int(counts[i])
+                if cell["nev"]:
+                    groups.append((dev_evict, cell["nev"]))
+            if groups:
+                cell["prep"] = prepare_prefix_groups(groups)
+
+        def wait_flat():
+            prep = cell.get("prep")
+            return fetch_flat(prep[0]) if prep is not None else None
+
+        def cont_apply(host_flat):
+            prep = cell.get("prep")
+            if prep is not None:
+                outs = finish_prefix_groups(host_flat, prep[1], prep[2])
+                for seg in outs[:cell["n_rows_groups"]]:
+                    st.write_chunk_columns(seg[0], seg[2:], seg[1])
+                if cell["nev"]:
+                    self._apply_evict_deletes(outs[-1], cell["nev"])
+            st.commit(new_epoch)
+
+        st.store.defer_flush(barrier.epoch.prev,
+                             (wait_counts, cont_prepare),
+                             (wait_flat, cont_apply))
 
     def recover(self, barrier_epoch: int) -> None:
         """Rebuild SHARDED device state: rows partition by

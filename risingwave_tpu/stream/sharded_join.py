@@ -153,10 +153,20 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
         (each shard's slice is a valid local sorted state, the parent's
         diff program is shape-local), with ALL shards'/sides' payloads
         shipped in TWO d2h calls — one counts fetch, one packed buffer
-        (the per-call fetch tax would otherwise multiply by 2·S·sides)."""
+        (the per-call fetch tax would otherwise multiply by 2·S·sides).
+        The diff programs dispatch AT the barrier (against non-donated
+        snapshot bases); the blocking fetches run as PURE waits on the
+        uploader thread, with the count-dependent slicing/packing done in
+        a loop-side continuation (two threads dispatching concurrently
+        deadlocks jax)."""
         from ..common.chunk import OP_DELETE, OP_INSERT
-        from ..utils.d2h import fetch_prefix_groups
-        pending = []     # (side, table, [per-shard diff tuples])
+        from ..utils.d2h import (fetch_flat, finish_prefix_groups,
+                                 prepare_prefix_groups)
+        tables = [st for st in (self.state_tables[LEFT],
+                                self.state_tables[RIGHT]) if st is not None]
+        if not tables:
+            return
+        pending = []     # (table, [per-shard diff tuples])
         for s in (LEFT, RIGHT):
             st = self.state_tables[s]
             if st is None:
@@ -166,41 +176,63 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
                     self._shard_slice(self.sides[s], sh, s),
                     self._shard_slice(self._snap[s], sh, s))
                     for sh in range(self.n_shards)]
-                pending.append((s, st, diffs))
+                pending.append((st, diffs))
                 self._snap[s] = self.sides[s]
                 self._flush_dirty[s] = False
-        if pending:
-            counts = np.asarray(jnp.stack(
-                [x for _, _, diffs in pending
-                 for d in diffs for x in (d[1], d[3])]))
+        counts_dev = (jnp.stack(
+            [x for _, diffs in pending
+             for d in diffs for x in (d[1], d[3])])
+            if pending else None)
+        new_epoch = barrier.epoch.curr
+        cell: dict = {}
+
+        def wait_counts():
+            return np.asarray(counts_dev) if counts_dev is not None else None
+
+        def cont_prepare(counts):
+            if counts is None:
+                return
+            cell["counts"] = counts
             groups, ci = [], 0
-            for _, _, diffs in pending:
+            for _, diffs in pending:
                 for d in diffs:
                     nd, ni = int(counts[ci]), int(counts[ci + 1])
                     ci += 2
                     groups.append((list(d[0]), nd))
                     groups.append((list(d[2]), ni))
-            fetched = fetch_prefix_groups(groups)
-            gi = ci = 0
-            for _, st, diffs in pending:
-                for d in diffs:
-                    nd, ni = int(counts[ci]), int(counts[ci + 1])
-                    ci += 2
-                    del_cols = fetched[gi]
-                    ins_cols = fetched[gi + 1]
-                    gi += 2
-                    if nd:
-                        st.write_chunk_columns(
-                            np.full(nd, OP_DELETE, dtype=np.int8),
-                            del_cols, np.ones(nd, dtype=bool))
-                    if ni:
-                        st.write_chunk_columns(
-                            np.full(ni, OP_INSERT, dtype=np.int8),
-                            ins_cols, np.ones(ni, dtype=bool))
-        for s in (LEFT, RIGHT):
-            st = self.state_tables[s]
-            if st is not None:
-                st.commit(barrier.epoch.curr)
+            cell["prep"] = prepare_prefix_groups(groups)
+
+        def wait_flat():
+            prep = cell.get("prep")
+            return fetch_flat(prep[0]) if prep is not None else None
+
+        def cont_apply(host_flat):
+            prep = cell.get("prep")
+            if prep is not None:
+                fetched = finish_prefix_groups(host_flat, prep[1], prep[2])
+                counts = cell["counts"]
+                gi = ci = 0
+                for st, diffs in pending:
+                    for d in diffs:
+                        nd, ni = int(counts[ci]), int(counts[ci + 1])
+                        ci += 2
+                        del_cols = fetched[gi]
+                        ins_cols = fetched[gi + 1]
+                        gi += 2
+                        if nd:
+                            st.write_chunk_columns(
+                                np.full(nd, OP_DELETE, dtype=np.int8),
+                                del_cols, np.ones(nd, dtype=bool))
+                        if ni:
+                            st.write_chunk_columns(
+                                np.full(ni, OP_INSERT, dtype=np.int8),
+                                ins_cols, np.ones(ni, dtype=bool))
+            for st in tables:
+                st.commit(new_epoch)
+
+        tables[0].store.defer_flush(barrier.epoch.prev,
+                                    (wait_counts, cont_prepare),
+                                    (wait_flat, cont_apply))
 
     def _recover_reset(self, s: int, rows: list) -> None:
         """Per-shard capacity is sized by the WORST shard's row count

@@ -227,8 +227,8 @@ class GroupTopNExecutor(StatefulUnaryExecutor):
                 flushed: Optional[StreamChunk]) -> None:
         """Persist the window CHANGELOG: inserts for rows that entered,
         deletes (tombstones) for rows that left — committed state stays
-        bounded by the live windows (hash_agg's _write_evict_deletes has
-        the same role)."""
+        bounded by the live windows (hash_agg's evict-delete persist path
+        has the same role)."""
         if self.state_table is None:
             return
         if flushed is not None:

@@ -50,16 +50,60 @@ def unpack_fetched(flat: np.ndarray, metas) -> list[np.ndarray]:
     return out
 
 
+def fetch_flat(flat) -> np.ndarray:
+    """Blocking d2h of an already-packed flat device buffer — a PURE
+    WAIT (`np.asarray` on a concrete array; no op dispatch), so it is
+    the ONE d2h primitive safe to run on a worker thread while the
+    event-loop thread keeps dispatching. Dispatching eager jax ops from
+    two threads concurrently deadlocks (observed: a background slice
+    gather vs. the loop blocked in `_value`); every deferred-flush wait
+    phase must therefore bottom out here or in a bare np.asarray of a
+    dispatched buffer."""
+    from .metrics import D2H_BYTES, D2H_FETCHES
+    host = np.asarray(flat)
+    D2H_FETCHES.inc()
+    D2H_BYTES.inc(host.nbytes)
+    return host
+
+
 def fetch_columns(arrays) -> list[np.ndarray]:
     """Pack + single fetch + unpack."""
     flat, metas = pack_for_fetch(arrays)
-    return unpack_fetched(np.asarray(flat), metas)
+    return unpack_fetched(fetch_flat(flat), metas)
 
 
 def _bucket(n: int, cap: int) -> int:
     if n <= 0:
         return 0
     return min(1 << (n - 1).bit_length(), cap)
+
+
+def prepare_prefix_groups(groups):
+    """Dispatch-only half of fetch_prefix_groups: slice each group's
+    arrays to the pow2 bucket of its host-known prefix length and pack
+    everything into ONE flat int64 device buffer. Returns
+    (flat, metas, group_meta) for `finish_prefix_groups`. MUST run on
+    the event-loop thread — it dispatches device ops (see fetch_flat)."""
+    sliced, meta = [], []
+    for arrays, n in groups:
+        cap = int(arrays[0].shape[0]) if arrays else 0
+        b = _bucket(int(n), cap)
+        for a in arrays:
+            sliced.append(a[:b])
+        meta.append((len(arrays), int(n)))
+    flat, metas = pack_for_fetch(sliced)
+    return flat, metas, meta
+
+
+def finish_prefix_groups(host_flat: np.ndarray, metas, group_meta) -> list:
+    """Host-only half: unpack the fetched flat buffer and trim each
+    group to its exact prefix length. No device work — safe anywhere."""
+    host = unpack_fetched(host_flat, metas)
+    out, i = [], 0
+    for cnt, n in group_meta:
+        out.append([h[:n] for h in host[i:i + cnt]])
+        i += cnt
+    return out
 
 
 def fetch_prefix_groups(groups) -> list:
@@ -69,16 +113,5 @@ def fetch_prefix_groups(groups) -> list:
     barriers — every fresh shape signature costs a compile round trip
     (~1-3s on the tunneled link), which exact per-epoch lengths would
     pay at every single barrier."""
-    sliced, meta = [], []
-    for arrays, n in groups:
-        cap = int(arrays[0].shape[0]) if arrays else 0
-        b = _bucket(int(n), cap)
-        for a in arrays:
-            sliced.append(a[:b])
-        meta.append((len(arrays), int(n)))
-    host = fetch_columns(sliced)
-    out, i = [], 0
-    for cnt, n in meta:
-        out.append([h[:n] for h in host[i:i + cnt]])
-        i += cnt
-    return out
+    flat, metas, meta = prepare_prefix_groups(groups)
+    return finish_prefix_groups(fetch_flat(flat), metas, meta)

@@ -156,3 +156,26 @@ GLOBAL_METRICS = MetricsRegistry()
 # always render in `\metrics` / scrapes, even at zero.
 JIT_COMPILES = GLOBAL_METRICS.counter("jit_compile_count")
 DEVICE_DISPATCHES = GLOBAL_METRICS.counter("device_dispatch_count")
+
+# Checkpoint pipeline phases (meta/barrier_manager.py): the old opaque
+# `sync_ns` splits into seal (deferred executor flushes + shared-buffer
+# seal), upload (SST build + object PUT, runs in background) and commit
+# (manifest swap). Always rendered so `\metrics` shows the split even
+# before the first checkpoint.
+CHECKPOINT_SEAL_SECONDS = GLOBAL_METRICS.histogram(
+    "checkpoint_seal_seconds")
+CHECKPOINT_UPLOAD_SECONDS = GLOBAL_METRICS.histogram(
+    "checkpoint_upload_seconds")
+CHECKPOINT_COMMIT_SECONDS = GLOBAL_METRICS.histogram(
+    "checkpoint_commit_seconds")
+# sealed-but-uncommitted epochs currently in the background uploader
+CHECKPOINT_INFLIGHT = GLOBAL_METRICS.gauge("checkpoint_inflight_epochs")
+# time barrier injection spent waiting for a free in-flight slot
+CHECKPOINT_BACKPRESSURE_SECONDS = GLOBAL_METRICS.counter(
+    "checkpoint_backpressure_seconds_total")
+
+# Device->host transfer accounting (utils/d2h.py packs every persist
+# payload through fetch_columns): bytes moved and fetch calls made — the
+# durable bench's d2h_bytes_per_s comes from here.
+D2H_BYTES = GLOBAL_METRICS.counter("d2h_bytes_total")
+D2H_FETCHES = GLOBAL_METRICS.counter("d2h_fetch_count")

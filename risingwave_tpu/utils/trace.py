@@ -29,13 +29,22 @@ class EpochTrace:
     epoch: int
     inject_ns: int
     collects: list = field(default_factory=list)   # (actor_id, ns_after)
-    sync_ns: int = 0                               # store sync duration
+    sync_ns: int = 0        # inline store sync duration (pipelining off)
+    # checkpoint-pipeline phases (annotated AFTER the span closes — the
+    # uploader commits in the background, off the barrier critical path)
+    seal_ns: int = 0
+    upload_ns: int = 0
+    commit_ns: int = 0
     total_ns: int = 0
 
     def render(self) -> str:
-        lines = [f"epoch {self.epoch}: total "
-                 f"{self.total_ns / 1e6:.1f}ms, sync "
-                 f"{self.sync_ns / 1e6:.1f}ms"]
+        head = (f"epoch {self.epoch}: total {self.total_ns / 1e6:.1f}ms, "
+                f"sync {self.sync_ns / 1e6:.1f}ms")
+        if self.seal_ns or self.upload_ns or self.commit_ns:
+            head += (f" [bg seal {self.seal_ns / 1e6:.1f}ms, "
+                     f"upload {self.upload_ns / 1e6:.1f}ms, "
+                     f"commit {self.commit_ns / 1e6:.1f}ms]")
+        lines = [head]
         for actor_id, dt in sorted(self.collects, key=lambda x: x[1]):
             lines.append(f"  actor {actor_id} collected at "
                          f"+{dt / 1e6:.1f}ms")
@@ -64,6 +73,20 @@ class EpochTracer:
             t.total_ns = time.monotonic_ns() - t.inject_ns
             t.sync_ns = sync_ns
             self._ring.append(t)
+
+    def annotate(self, epoch: int, *, seal_ns: int = 0, upload_ns: int = 0,
+                 commit_ns: int = 0) -> None:
+        """Attach checkpoint-pipeline phase durations to an epoch whose
+        span already closed — the background uploader reports these after
+        the barrier completed (which is the whole point of the pipeline)."""
+        t = self._open.get(epoch)
+        if t is None:
+            for cand in reversed(self._ring):
+                if cand.epoch == epoch:
+                    t = cand
+                    break
+        if t is not None:
+            t.seal_ns, t.upload_ns, t.commit_ns = seal_ns, upload_ns, commit_ns
 
     def recent(self, n: int = 8) -> list[EpochTrace]:
         return list(self._ring)[-n:]
