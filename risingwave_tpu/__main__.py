@@ -9,7 +9,8 @@ on the barrier interval in the background. With --data, state lives in a
 durable Hummock store under DIR and survives restarts. Meta commands:
     \\tick [n]    advance n barrier rounds now
     \\mvs         list materialized views
-    \\metrics     dump the metrics registry
+    \\metrics     dump the metrics registry (+ per-MV HBM accounting)
+    \\metrics prom   full Prometheus text exposition (# TYPE metadata)
     \\trace       recent per-epoch barrier spans
     \\stacks      await-tree dump of every live task
     \\q           quit
@@ -86,7 +87,12 @@ async def repl(args) -> None:
                 for name, mv in session.catalog.mvs.items():
                     print(f"  {name}: {', '.join(mv.schema.names)}")
             elif parts[0] == "\\metrics":
-                print(GLOBAL_METRICS.render())
+                if len(parts) > 1 and parts[1] == "prom":
+                    print(GLOBAL_METRICS.render_prometheus())
+                else:
+                    print(GLOBAL_METRICS.render())
+                    for ln in session.coord.memory.render():
+                        print(ln)
             elif parts[0] == "\\trace":
                 for t in session.coord.tracer.recent():
                     print(t.render())

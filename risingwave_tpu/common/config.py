@@ -45,6 +45,14 @@ class StreamingConfig:
     chunk_size: int = 8192
     channel_capacity: int = 64
     max_inflight_chunks: int = 16
+    # HBM budget for device-resident executor state (memory/manager.py):
+    # 0 = accounting only (today's grow-or-fail behavior); > 0 = the
+    # memory manager evicts cold key groups to host at barriers to keep
+    # the accounted total under budget
+    hbm_budget_bytes: int = 0
+    # 'lru' (default) = epoch-stamped coldest-first eviction when a
+    # budget is set; 'none' = never evict even when over budget
+    memory_eviction_policy: str = "lru"
 
 
 @dataclass
@@ -104,7 +112,8 @@ class SystemParams:
     observers are notified on change (the notification-service shape)."""
 
     MUTABLE = {"barrier_interval_ms", "checkpoint_frequency",
-               "checkpoint_max_inflight"}
+               "checkpoint_max_inflight", "hbm_budget_bytes",
+               "memory_eviction_policy"}
 
     def __init__(self, config: Optional[RwConfig] = None):
         cfg = config or RwConfig()
@@ -113,6 +122,9 @@ class SystemParams:
             "checkpoint_frequency": cfg.streaming.checkpoint_frequency,
             "checkpoint_max_inflight":
                 cfg.streaming.checkpoint_max_inflight,
+            "hbm_budget_bytes": cfg.streaming.hbm_budget_bytes,
+            "memory_eviction_policy":
+                cfg.streaming.memory_eviction_policy,
         }
         self._observers = []
 

@@ -136,6 +136,14 @@ class Session:
         # complete at seal, SST build/upload/manifest-swap overlap the next
         # epochs' compute. 0 = inline sync on the barrier path.
         "checkpoint_max_inflight": (2, int),
+        # HBM budget for device-resident executor state (memory/): 0 =
+        # accounting only; > 0 = the coordinator's MemoryManager evicts
+        # cold key groups to host at barriers (read-through reload on a
+        # later touch) so the accounted total stays under budget
+        "hbm_budget_bytes": (0, int),
+        # 'lru' = epoch-stamped coldest-first (the only policy); 'none'
+        # disables eviction while keeping accounting
+        "memory_eviction_policy": ("lru", str),
     }
 
     def __init__(self, store=None):
@@ -166,6 +174,14 @@ class Session:
         if blob:
             self._ddl_log = list(json.loads(blob)["ddl"])
         self.recoveries = 0
+        self._apply_memory_config()
+
+    def _apply_memory_config(self) -> None:
+        """Plumb the memory session vars to the live coordinator's
+        MemoryManager (re-applied after auto-recovery rebuilds it)."""
+        self.coord.memory.configure(
+            budget_bytes=self.config["hbm_budget_bytes"],
+            policy=self.config["memory_eviction_policy"])
 
     # ------------------------------------------------------ durable catalog
     def _persist_catalog(self) -> None:
@@ -325,6 +341,8 @@ class Session:
             return self._insert(stmt)
         if isinstance(stmt, ast.Explain):
             return self.explain(stmt.stmt)
+        if isinstance(stmt, ast.ExplainMv):
+            return self.explain_mv(stmt.name)
         if isinstance(stmt, ast.Show):
             return self.show(stmt.what)
         if isinstance(stmt, ast.SetVar):
@@ -340,6 +358,11 @@ class Session:
                 # runtime-mutable on the LIVE coordinator (the ALTER
                 # SYSTEM analogue): takes effect at the next barrier
                 self.coord.checkpoint_max_inflight = self.config[stmt.name]
+            elif stmt.name in ("hbm_budget_bytes",
+                               "memory_eviction_policy"):
+                # runtime-mutable on the live MemoryManager: enabling a
+                # budget starts LRU tracking on every deployed executor
+                self._apply_memory_config()
             return self.config[stmt.name]
         if isinstance(stmt, ast.Select):
             return self.query_select(stmt)
@@ -485,9 +508,47 @@ class Session:
                 "CREATE SINK")
         return [(ln,) for ln in render_graph(plan.graph)]
 
+    def explain_mv(self, name: str) -> list:
+        """EXPLAIN MATERIALIZED VIEW <name>: the LIVE deployed executor
+        chains annotated with per-executor HBM accounting — which MV owns
+        the device memory, what spilled, how often reloads hit."""
+        from ..memory.accounting import format_bytes
+        from ..plan.build import _iter_executor_chain
+        if name not in self.catalog.mvs:
+            raise BindError(f"unknown materialized view {name!r}")
+        mv = self.catalog.mvs[name]
+        participants = {id(p) for p in
+                        self.coord.memory._participants.values()}
+        lines = [f"materialized view {name} "
+                 f"(parallelism={mv.parallelism})"]
+        for fid in sorted(mv.deployment.roots):
+            lines.append(f"fragment {fid}")
+            for root in mv.deployment.roots[fid]:
+                for ex in _iter_executor_chain(root):
+                    if id(ex) in participants:
+                        lines.append(
+                            f"  {ex.identity}: "
+                            f"state_bytes={ex.state_bytes()} "
+                            f"({format_bytes(ex.state_bytes())}) "
+                            f"evicted_bytes="
+                            f"{getattr(ex, 'mem_evicted_bytes', 0)} "
+                            f"reload_count="
+                            f"{getattr(ex, 'mem_reload_count', 0)} "
+                            f"spilled_rows="
+                            f"{getattr(ex, 'mem_spilled_rows', 0)}")
+                    else:
+                        lines.append(f"  {ex.identity}")
+        return [(ln,) for ln in lines]
+
     def show(self, what: str) -> list:
         """SHOW <objects|variable> (reference: handler/show.rs +
         session_config reads)."""
+        if what == "memory":
+            # per-executor HBM accounting from the memory manager
+            return [(r["executor"], str(r["state_bytes"]),
+                     str(r["evicted_bytes"]), str(r["reload_count"]),
+                     str(r["spilled_rows"]))
+                    for r in self.coord.memory.report()]
         if what == "sources":
             return [(n,) for n in sorted(self.catalog.sources)]
         if what in ("tables", "materialized_views"):
@@ -596,7 +657,9 @@ class Session:
         # reference pauses the barrier loop around an Add command)
         async with self.coord._rounds_lock:
             self.env.pending_taps = []
+            self.env.memory_scope = stmt.name
             dep = build_graph(plan.graph, self.env)
+            self.env.memory_scope = None
             root = dep.roots[plan.mv_fragment][0]
             actor = next(a for a in dep.actors if a.consumer is root)
             assert actor.dispatcher is None, "MV fragment must be terminal"
@@ -635,7 +698,9 @@ class Session:
         plan = planner.plan_sink(stmt.select, stmt.options)
         async with self.coord._rounds_lock:
             self.env.pending_taps = []
+            self.env.memory_scope = stmt.name
             dep = build_graph(plan.graph, self.env)
+            self.env.memory_scope = None
             dep_ids = {a.actor_id for a in dep.actors}
             for up, ch in self.env.pending_taps:
                 up.tap.set_consumers(ch, dep_ids)
@@ -768,6 +833,7 @@ class Session:
             chunk_coalesce_max=self.config.get(
                 "streaming_chunk_coalesce", 0))
         self.env.session = self
+        self._apply_memory_config()
         self.catalog.mvs.clear()
         self.catalog.sinks.clear()
         log = list(self._ddl_log)

@@ -177,6 +177,15 @@ class Explain:
 
 
 @dataclass
+class ExplainMv:
+    """EXPLAIN MATERIALIZED VIEW <name> — the DEPLOYED graph of a live
+    MV annotated with per-executor HBM accounting (state_bytes /
+    evicted_bytes / reload_count), so operators can see which MV owns
+    the device memory."""
+    name: str
+
+
+@dataclass
 class Show:
     what: str           # sources|tables|materialized_views|sinks|all|<var>
 
@@ -261,6 +270,15 @@ class Parser:
 
     def _statement(self):
         if self.accept("kw", "explain"):
+            # EXPLAIN MATERIALIZED VIEW <name>: live deployed graph +
+            # memory accounting (a bare EXPLAIN CREATE ... still plans
+            # without deploying, below)
+            if self.peek().kind == "kw" and self.peek().val == "materialized":
+                self.next()
+                self.expect("kw", "view")
+                name = self.expect("ident").val
+                self.accept("op", ";")
+                return ExplainMv(name)
             return Explain(self._statement())
         if self.accept("kw", "show"):
             t = self.next()

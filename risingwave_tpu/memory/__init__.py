@@ -1,0 +1,17 @@
+"""HBM memory management — accounting, LRU group eviction, host spill.
+
+Reference analogues: `EstimateSize` accounting (src/common/src/
+estimate_size/), the executor LRU caches (src/stream/src/cache/) and the
+compute-node memory controller (src/compute/src/memory/) — collapsed here
+into one subsystem sized for device-resident state: every stateful
+executor reports the EXACT byte size of its jax state pytree, a
+`MemoryManager` aggregates per-flow and globally, and when the total
+crosses `hbm_budget_bytes` the coldest key groups spill to host with
+transparent read-through reload.
+"""
+
+from .accounting import format_bytes, pytree_bytes
+from .manager import MemoryManager
+from .spill import HostSpill
+
+__all__ = ["MemoryManager", "HostSpill", "pytree_bytes", "format_bytes"]

@@ -1,0 +1,38 @@
+"""Exact state-size accounting for device-resident pytrees.
+
+Reference: src/common/src/estimate_size/ — RisingWave ESTIMATES heap sizes
+because Rust collections hide their allocation; here every executor's
+state is a jax pytree of fixed-shape arrays, so the size is EXACT:
+sum(prod(shape) * itemsize) over the leaves. No estimation, no sampling.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+
+
+def pytree_bytes(tree) -> int:
+    """Exact byte size of every array leaf in `tree` (host scalars and
+    non-array leaves count 0). Pure host arithmetic over static shapes —
+    never touches the device or forces a transfer."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        total += math.prod(shape) * np.dtype(dtype).itemsize
+    return total
+
+
+def format_bytes(n: int) -> str:
+    """Human-readable bytes for EXPLAIN / \\metrics output."""
+    f = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(f) < 1024.0 or unit == "GiB":
+            return f"{f:.1f}{unit}" if unit != "B" else f"{int(f)}B"
+        f /= 1024.0
+    return f"{f:.1f}GiB"

@@ -1,0 +1,178 @@
+"""MemoryManager — the per-coordinator HBM budget authority.
+
+Reference: the compute-node memory controller (src/compute/src/memory/
+controller.rs) — a control loop that watches total memory against a
+budget and tells the executor LRU caches how far to evict. Here the loop
+runs at barrier collection (meta/barrier_manager.py calls `on_barrier`
+once per completed epoch, when every executor is idle between epochs):
+
+  * accounting is ALWAYS on — `state_bytes()` is pure host arithmetic
+    over static pytree shapes, so per-executor and global gauges update
+    every barrier at zero device cost;
+  * eviction runs only when `hbm_budget_bytes > 0` and
+    `memory_eviction_policy == 'lru'`: the worst offenders (largest
+    accounted state) are asked to `memory_evict(target_bytes, epoch)`
+    until the overage is covered, and occupancy-driven participants
+    (dense sorted stores with fixed capacity) get a `memory_maintain`
+    tick to spill ahead of their overflow cliff.
+
+Participants are duck-typed executors:
+  state_bytes() -> int                      required (registration key)
+  memory_evict(target, epoch) -> int freed  optional (budget eviction)
+  memory_maintain(epoch) -> None            optional (occupancy spill)
+  memory_enable_lru() -> None               optional (start LRU tracking)
+plus optional counters read for reports: mem_evicted_bytes,
+mem_reload_count, mem_spilled_rows.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils.metrics import (
+    GLOBAL_METRICS, HBM_BUDGET_BYTES, HBM_EVICTED_BYTES, HBM_EVICTIONS,
+    HBM_RELOADS, HBM_SPILLED_ROWS, HBM_STATE_BYTES,
+)
+from .accounting import format_bytes
+
+POLICY_LRU = "lru"
+POLICY_NONE = "none"
+
+
+class MemoryManager:
+    def __init__(self, budget_bytes: int = 0, policy: str = POLICY_LRU):
+        self.budget_bytes = int(budget_bytes)
+        self.policy = policy
+        self._participants: dict[str, object] = {}
+        self.evictions = 0
+
+    # ---------------------------------------------------------- config
+    @property
+    def enabled(self) -> bool:
+        return self.budget_bytes > 0 and self.policy == POLICY_LRU
+
+    def configure(self, budget_bytes: Optional[int] = None,
+                  policy: Optional[str] = None) -> None:
+        """SET hbm_budget_bytes / memory_eviction_policy (the ALTER SYSTEM
+        analogue). Enabling starts LRU tracking on every registered
+        participant; disabling stops NEW evictions but already-spilled
+        state keeps its read-through reload path (dropping it would lose
+        exactness)."""
+        was = self.enabled
+        if budget_bytes is not None:
+            self.budget_bytes = int(budget_bytes)
+        if policy is not None:
+            if policy not in (POLICY_LRU, POLICY_NONE):
+                raise ValueError(
+                    f"unknown memory_eviction_policy {policy!r} "
+                    f"(expected 'lru' or 'none')")
+            self.policy = policy
+        HBM_BUDGET_BYTES.set(float(self.budget_bytes))
+        if self.enabled and not was:
+            for p in self._participants.values():
+                enable = getattr(p, "memory_enable_lru", None)
+                if enable is not None:
+                    enable()
+
+    # ------------------------------------------------------ registration
+    def register(self, name: str, participant) -> str:
+        """Register a stateful executor; returns the (uniquified) name
+        used for per-executor metrics and EXPLAIN output."""
+        base, i = name, 1
+        while name in self._participants:
+            i += 1
+            name = f"{base}#{i}"
+        self._participants[name] = participant
+        if self.enabled:
+            enable = getattr(participant, "memory_enable_lru", None)
+            if enable is not None:
+                enable()
+        return name
+
+    def unregister(self, name: str) -> None:
+        p = self._participants.pop(name, None)
+        if p is not None:
+            GLOBAL_METRICS.gauge("hbm_state_bytes", executor=name).set(0.0)
+
+    # --------------------------------------------------------- reporting
+    def total_bytes(self) -> int:
+        return sum(p.state_bytes() for p in self._participants.values())
+
+    def report(self) -> list[dict]:
+        """Per-executor accounting rows (\\metrics / EXPLAIN / SHOW)."""
+        rows = []
+        for name, p in sorted(self._participants.items(),
+                              key=lambda kv: -kv[1].state_bytes()):
+            rows.append({
+                "executor": name,
+                "state_bytes": p.state_bytes(),
+                "evicted_bytes": int(getattr(p, "mem_evicted_bytes", 0)),
+                "reload_count": int(getattr(p, "mem_reload_count", 0)),
+                "spilled_rows": int(getattr(p, "mem_spilled_rows", 0)),
+            })
+        return rows
+
+    def render(self) -> list[str]:
+        lines = [f"hbm budget: "
+                 f"{format_bytes(self.budget_bytes) if self.budget_bytes else 'unset'}"
+                 f" policy: {self.policy} "
+                 f"total: {format_bytes(self.total_bytes())}"]
+        for r in self.report():
+            lines.append(
+                f"  {r['executor']}: state={format_bytes(r['state_bytes'])} "
+                f"evicted={format_bytes(r['evicted_bytes'])} "
+                f"reloads={r['reload_count']} "
+                f"spilled_rows={r['spilled_rows']}")
+        return lines
+
+    # ------------------------------------------------------ control loop
+    def on_barrier(self, epoch: int) -> None:
+        """Barrier-collection hook: refresh gauges; under an exceeded
+        budget, ask the worst offenders to evict. Runs synchronously on
+        the event loop with every executor idle between epochs — eviction
+        dispatches device programs and (rarely) blocks on a packed d2h
+        fetch, exactly the per-barrier transfer discipline the watchdogs
+        already follow."""
+        if not self._participants:
+            return
+        total = 0
+        spilled = 0
+        for name, p in self._participants.items():
+            b = p.state_bytes()
+            total += b
+            spilled += int(getattr(p, "mem_spilled_rows", 0))
+            GLOBAL_METRICS.gauge("hbm_state_bytes", executor=name).set(
+                float(b))
+        HBM_STATE_BYTES.set(float(total))
+        HBM_SPILLED_ROWS.set(float(spilled))
+        HBM_BUDGET_BYTES.set(float(self.budget_bytes))
+        if not self.enabled:
+            return
+        # occupancy-driven participants spill ahead of their cliff even
+        # when the global budget still has headroom
+        for p in self._participants.values():
+            maintain = getattr(p, "memory_maintain", None)
+            if maintain is not None:
+                maintain(epoch)
+        over = total - self.budget_bytes
+        if over <= 0:
+            return
+        # worst offenders first (largest accounted state)
+        for name, p in sorted(self._participants.items(),
+                              key=lambda kv: -kv[1].state_bytes()):
+            evict = getattr(p, "memory_evict", None)
+            if evict is None:
+                continue
+            freed = int(evict(over, epoch) or 0)
+            if freed > 0:
+                self.evictions += 1
+                HBM_EVICTIONS.inc()
+                HBM_EVICTED_BYTES.inc(freed)
+                over -= freed
+            if over <= 0:
+                break
+
+    def note_reload(self, n_keys: int) -> None:
+        """Executors report read-through reloads here (process counter;
+        their own mem_reload_count feeds the per-executor report)."""
+        HBM_RELOADS.inc(n_keys)

@@ -1,0 +1,78 @@
+"""Host spill store — the landing zone for evicted device state.
+
+One `HostSpill` per executor (per side, for joins): evicted rows are
+fetched off the device ONCE (the packed-d2h discipline of utils/d2h.py)
+and parked here keyed by the executor's logical key tuple, so a later
+touch of an evicted key is a dict lookup, not a store scan. The durable
+StateTable keeps its own copy of every spilled row (they were persisted
+at the barrier that last dirtied them and eviction never deletes them),
+which is what makes crash recovery exact: `recover()` rebuilds the FULL
+state — resident and spilled — from the committed store, and the spill
+dict is simply dropped.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Optional
+
+
+class HostSpill:
+    """key tuple -> list of row payload tuples (one for single-row-per-key
+    executors like HashAgg, many for multimap executors like joins)."""
+
+    def __init__(self):
+        self._d: dict[tuple, list[tuple]] = {}
+        self.rows = 0                    # payload rows currently parked
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def __bool__(self) -> bool:
+        return bool(self._d)
+
+    def __contains__(self, key: tuple) -> bool:
+        return key in self._d
+
+    def keys(self) -> Iterator[tuple]:
+        return iter(self._d)
+
+    def add(self, key: tuple, row: tuple) -> None:
+        """Append one payload row under `key` (multimap semantics)."""
+        self._d.setdefault(key, []).append(row)
+        self.rows += 1
+
+    def set(self, key: tuple, row: tuple) -> None:
+        """Replace the payload for `key` (single-row semantics)."""
+        prev = self._d.get(key)
+        if prev is not None:
+            self.rows -= len(prev)
+        self._d[key] = [row]
+        self.rows += 1
+
+    def pop(self, key: tuple) -> list[tuple]:
+        rows = self._d.pop(key, [])
+        self.rows -= len(rows)
+        return rows
+
+    def take_touched(self, keys: Iterable[tuple]) -> dict[tuple, list[tuple]]:
+        """Pop every spilled key present in `keys` (the read-through
+        reload set for one drain). Dedups on the way."""
+        out: dict[tuple, list[tuple]] = {}
+        for k in keys:
+            if k in self._d and k not in out:
+                out[k] = self.pop(k)
+        return out
+
+    def purge(self, pred) -> list[tuple[tuple, list[tuple]]]:
+        """Drop every (key, rows) where pred(key, rows) — watermark state
+        cleaning of evicted ranges. Returns what was dropped so the caller
+        can write the matching durable tombstones."""
+        dead = [(k, rows) for k, rows in self._d.items() if pred(k, rows)]
+        for k, rows in dead:
+            del self._d[k]
+            self.rows -= len(rows)
+        return dead
+
+    def clear(self) -> None:
+        self._d.clear()
+        self.rows = 0

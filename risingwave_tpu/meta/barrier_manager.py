@@ -31,6 +31,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..common.epoch import EpochPair, next_epoch, INVALID_EPOCH
+from ..memory.manager import MemoryManager
 from ..state.store import StateStore
 from ..stream.message import Barrier, BarrierKind, Mutation
 
@@ -92,6 +93,12 @@ class BarrierCoordinator:
         # print ONE stuck-barrier diagnosis (spans + await tree) when a
         # collection exceeds this many seconds; None disables
         self.stuck_report_s: float | None = 60.0
+        # HBM budget authority (memory/manager.py): executors register at
+        # build time, accounting gauges refresh at every collected
+        # barrier, and eviction runs here — between epochs, when every
+        # executor is idle — once a budget is configured (Session plumbs
+        # hbm_budget_bytes / memory_eviction_policy through).
+        self.memory = MemoryManager()
         # ---- async epoch uploader (the checkpoint pipeline) ----
         self._upload_q: asyncio.Queue[_UploadJob] = asyncio.Queue()
         self._uploader_task: Optional[asyncio.Task] = None
@@ -250,6 +257,11 @@ class BarrierCoordinator:
         self.latencies_ns.append(lat_ns)
         self._metrics_latency.observe(lat_ns / 1e9)
         del self._epochs[barrier.epoch.curr]
+        # budget check at barrier collection: the epoch is complete and
+        # every executor idle, so eviction device work cannot race an
+        # in-flight apply; runs synchronously (no awaits) so no actor
+        # interleaves mid-eviction
+        self.memory.on_barrier(barrier.epoch.curr)
 
     async def run_rounds(self, n: int, interval_s: Optional[float] = None) -> None:
         """Inject n barriers, waiting for each to complete. The very first

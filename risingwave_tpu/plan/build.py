@@ -69,6 +69,10 @@ class BuildEnv:
         self.session = None
         self.pending_taps: list = []          # (upstream MvDef, Channel)
         self.pending_source_queues: list = []
+        # label prefix for memory-manager registration — the Session sets
+        # this to the MV/sink name around build_graph so EXPLAIN and
+        # \metrics attribute HBM to the flow that owns it
+        self.memory_scope: Optional[str] = None
 
     def alloc_table_id(self) -> int:
         t = self._next_table_id
@@ -116,6 +120,7 @@ class Deployment:
     roots: dict[int, list[Executor]] = field(default_factory=dict)
     tasks: list[asyncio.Task] = field(default_factory=list)
     source_queues: list = field(default_factory=list)
+    memory_names: list = field(default_factory=list)
 
     def spawn(self) -> "Deployment":
         self.tasks = [a.spawn() for a in self.actors]
@@ -143,6 +148,39 @@ class Deployment:
             for q in self.source_queues:
                 if q in self.coord.source_queues:
                     self.coord.source_queues.remove(q)
+            for n in self.memory_names:
+                self.coord.memory.unregister(n)
+
+
+def _iter_executor_chain(root):
+    """Every executor reachable from a fragment root through its
+    input(s) — the registration walk for the memory manager."""
+    seen: set[int] = set()
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if id(node) in seen or node is None:
+            continue
+        seen.add(id(node))
+        yield node
+        inp = getattr(node, "input", None)
+        if inp is not None:
+            stack.append(inp)
+        for i in getattr(node, "inputs", ()) or ():
+            stack.append(i)
+
+
+def _register_memory(dep: Deployment, env: BuildEnv, root,
+                     actor_id: int) -> None:
+    """Register every stateful executor in the chain (duck-typed on
+    `state_bytes`) with the coordinator's MemoryManager, labelled by the
+    owning flow so operators can see which MV owns the HBM."""
+    scope = env.memory_scope or "flow"
+    for ex in _iter_executor_chain(root):
+        if hasattr(ex, "state_bytes"):
+            name = env.coord.memory.register(
+                f"{scope}/{ex.identity}@a{actor_id}", ex)
+            dep.memory_names.append(name)
 
 
 def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
@@ -247,6 +285,7 @@ def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
 
             root = build_node(f.root)
             dep.roots[fid].append(root)
+            _register_memory(dep, env, root, actor_id)
             if idx == 0:
                 built_schema[fid] = root.schema
 

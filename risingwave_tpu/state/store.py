@@ -113,6 +113,14 @@ class StateStore:
     def get(self, key: bytes) -> Optional[bytes]:
         raise NotImplementedError
 
+    def get_many(self, keys) -> list:
+        """Batch point-get over the same read view as `get` (mem-table
+        merging is the StateTable's job): the evicted-range read-through
+        path — a reload of spilled state resolves its keys against the
+        committed + sealed (staged) view in one call. Backends with a
+        cheaper batched lookup override this."""
+        return [self.get(k) for k in keys]
+
     def iter_range(self, start: bytes, end: bytes,
                    committed_only: bool = False,
                    max_epoch: Optional[int] = None

@@ -40,7 +40,12 @@ from ..ops.extrema import (
     extrema_emit, extrema_empty, extrema_gather, extrema_mask_keep,
     extrema_underflow, extrema_update,
 )
-from ..ops.hash_table import HashTable, lookup_or_insert, needs_rebuild
+from ..memory.accounting import pytree_bytes
+from ..memory.spill import HostSpill
+from ..ops.hash_table import (
+    BUCKET_SLOTS, HashTable, compact_mask, lookup_or_insert, lru_stamp,
+    needs_rebuild,
+)
 from ..ops.jit_state import jit_state
 from ..state.state_table import StateTable
 from .executor import Executor
@@ -161,6 +166,34 @@ class HashAggExecutor(Executor):
         self.rebuilds = 0
         self._occ_known = 0
         self._applied_since_flush = False
+        # ---- HBM memory manager hooks (memory/manager.py) ----
+        # LRU hotness is an int64 epoch stamp PER SLOT, advanced at each
+        # barrier from the interval's dirty bitmap — one elementwise
+        # select per interval, no device->host sync on the data path.
+        # Cold slots spill their rows to the host dict; a later touch of
+        # a spilled key reloads it at drain time before the chunk
+        # applies.
+        self._mem_lru_on = False
+        self._slot_epoch = None             # int64 [C] device, lazy
+        # shrink floor: below ~64 buckets the two-choice overflow
+        # probability stops being negligible at moderate load, so
+        # eviction never shrinks under this (tests override)
+        self._mem_min_capacity = 1024
+        self._spill = HostSpill()
+        self.mem_evicted_bytes = 0
+        self.mem_reload_count = 0
+        self._lru_stamp = jit_state(self._lru_stamp_impl,
+                                    donate_argnums=(1,),
+                                    name="hash_agg_lru_stamp")
+        self._mem_stats = jit_state(self._mem_stats_impl,
+                                    name="hash_agg_mem_stats")
+        self._mem_pack = jit_state(self._mem_pack_impl,
+                                   name="hash_agg_mem_pack")
+        self._mem_rehash = jit_state(self._mem_rehash_impl,
+                                     static_argnames=("new_capacity",),
+                                     donate_argnums=(0,),
+                                     name="hash_agg_mem_rehash")
+        self._mem_reloads: dict[int, object] = {}
         self._overflow_dev = jnp.zeros((), dtype=jnp.int32)
         self._occ_dev = jnp.zeros((), dtype=jnp.int32)
         self._watchdog_pack = jit_state(
@@ -363,14 +396,17 @@ class HashAggExecutor(Executor):
         capacity CHANGE triggers a recompile (distinct static shape)."""
         keep = state.table.occupied & (
             (state.row_count > 0) | (state.dirty & state.prev_exists))
+        return self._rehash_keep(state, keep, new_capacity)
+
+    def _rehash_keep(self, state: AggState, keep: jnp.ndarray,
+                     new_capacity: int) -> AggState:
+        """Shared rebuild body: re-insert exactly the `keep` slots into a
+        fresh table (growth/purge keeps all survivors; memory eviction
+        additionally drops the cold groups)."""
         fresh = HashTable.empty(new_capacity, self._key_dtypes)
         # compact surviving entries to the front so insertion order is dense
         C = state.table.capacity
-        rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
-        sel = jnp.zeros(C, dtype=jnp.int32).at[
-            jnp.where(keep, rank, C)].set(jnp.arange(C, dtype=jnp.int32),
-                                          mode="drop")
-        n_keep = jnp.sum(keep.astype(jnp.int32))
+        sel, n_keep = compact_mask(keep)
         active = jnp.arange(C) < n_keep
         key_cols = [tk[sel] for tk in state.table.keys]
         table, slots, n_un = lookup_or_insert(fresh, key_cols, active)
@@ -404,6 +440,9 @@ class HashAggExecutor(Executor):
         self.state = self._rehash(self.state, new_capacity)
         self.capacity = new_capacity
         self.rebuilds += 1
+        # slot geometry changed: restamp lazily (everything hot, and one
+        # interval later the LRU discriminates again)
+        self._slot_epoch = None
         occ, _ = self._live_zombie(self.state)
         return int(occ)
 
@@ -443,6 +482,297 @@ class HashAggExecutor(Executor):
         rebuild, cap = needs_rebuild(int(occ), int(live), self.capacity)
         if rebuild:
             self._occ_known = self._rebuild(cap)
+
+    # ------------------------------------------------- HBM memory manager
+    def state_bytes(self) -> int:
+        """EXACT device-state bytes (memory/accounting.py): static pytree
+        shapes, no transfer, no estimate."""
+        extra = () if self._slot_epoch is None else (self._slot_epoch,)
+        return pytree_bytes((self.state,) + extra)
+
+    @property
+    def mem_spilled_rows(self) -> int:
+        return self._spill.rows
+
+    def memory_enable_lru(self) -> None:
+        self._mem_lru_on = True
+
+    def _lru_stamp_impl(self, dirty, slot_epoch, epoch):
+        return lru_stamp(slot_epoch, dirty, epoch)
+
+    def _mem_stamp(self, epoch: int) -> None:
+        if self._slot_epoch is None \
+                or self._slot_epoch.shape[0] != self.capacity:
+            # first stamp / post-rebuild: everything counts as hot now;
+            # one interval later untouched slots fall behind again
+            self._slot_epoch = jnp.full(self.capacity, epoch,
+                                        dtype=jnp.int64)
+            return
+        self._slot_epoch = self._lru_stamp(self.state.dirty,
+                                           self._slot_epoch, epoch)
+
+    def _mem_stats_impl(self, state: AggState, slot_epoch):
+        """Per-slot (live, stamp) packed for ONE fetch (eviction only)."""
+        live = state.table.occupied & (state.row_count > 0) & ~state.dirty
+        return live, slot_epoch
+
+    def _mem_pack_impl(self, state: AggState, slot_epoch, thresh):
+        """Compact the to-evict rows (live, clean, stamp <= thresh) to
+        the buffer prefix in durable-row layout."""
+        evict = (state.table.occupied & (state.row_count > 0)
+                 & ~state.dirty & (slot_epoch <= thresh))
+        sel, n = compact_mask(evict)
+        return tuple(self._durable_cols_at(state, sel)), n
+
+    def _mem_rehash_impl(self, state: AggState, slot_epoch, thresh,
+                         new_capacity: int) -> AggState:
+        """Rebuild WITHOUT the evicted cold rows — frees their slots and
+        (with a smaller new_capacity) the HBM behind them."""
+        drop = ((state.row_count > 0) & ~state.dirty
+                & (slot_epoch <= thresh))
+        keep = (state.table.occupied
+                & ((state.row_count > 0) | (state.dirty & state.prev_exists))
+                & ~drop)
+        return self._rehash_keep(state, keep, new_capacity)
+
+    def _mem_fetch_stats(self, epoch: int):
+        """(live mask, stamps, cold stamps asc, this-interval touch count)
+        in ONE packed fetch — the eviction decision inputs."""
+        from ..utils.d2h import fetch_columns
+        live_dev, ep_dev = self._mem_stats(self.state, self._slot_epoch)
+        live_np, ep_np = fetch_columns([live_dev, ep_dev])
+        live_np = live_np.astype(bool)
+        cold = np.sort(ep_np[live_np & (ep_np < epoch)])
+        return live_np, ep_np, cold, int((ep_np == epoch).sum())
+
+    def _mem_cap_for(self, n_survive: int, touched_now: int) -> int:
+        """Post-eviction capacity: survivors + one more interval of fresh
+        keys at a 0.35 target load, so the shrunk table neither re-grows
+        immediately nor hits a mid-epoch bucket-overflow fail-stop."""
+        c = max(2 * BUCKET_SLOTS, self._mem_min_capacity)
+        while n_survive + touched_now > 0.35 * c:
+            c *= 2
+        return c
+
+    def _mem_do_evict(self, epoch: int, thresh: int,
+                      new_cap: int, survivors: int) -> int:
+        """Pack + spill slots stamped <= thresh, rehash at new_cap.
+        Returns bytes freed (0 for a same-capacity cold purge — the win
+        there is distance from the overflow cliff, not bytes)."""
+        from ..utils.d2h import fetch_prefix_groups
+        cols_dev, n_dev = self._mem_pack(self.state, self._slot_epoch,
+                                         jnp.int64(thresh))
+        n = int(np.asarray(n_dev))
+        if n:
+            host = fetch_prefix_groups([(list(cols_dev), n)])[0]
+            nk = len(self.group_key_indices)
+            for r in range(n):
+                row = tuple(c[r].item() for c in host)
+                self._spill.set(row[:nk], row)
+        before = self.state_bytes()
+        self.state = self._mem_rehash(self.state, self._slot_epoch,
+                                      jnp.int64(thresh),
+                                      new_capacity=new_cap)
+        self.capacity = new_cap
+        self._slot_epoch = jnp.full(new_cap, epoch, dtype=jnp.int64)
+        self._occ_known = max(0, survivors)
+        freed = max(0, before - self.state_bytes())
+        self.mem_evicted_bytes += freed
+        return freed
+
+    def memory_evict(self, target_bytes: int, epoch: int) -> int:
+        """Budget response: spill the coldest slots to host and SHRINK
+        the table. Called by the MemoryManager between epochs (executor
+        idle); the packed fetches follow the same per-barrier d2h
+        discipline as the persist path. Returns bytes actually freed."""
+        if not self._mem_lru_on or self._slot_epoch is None:
+            return 0
+        live_np, ep_np, cold, touched_now = self._mem_fetch_stats(epoch)
+        if cold.size == 0:
+            return 0
+        total_live = int(live_np.sum())
+        bps = max(1, self.state_bytes() // max(1, self.capacity))
+        # oldest-first: the smallest evicted count whose shrink covers
+        # the target (stamps are whole epochs — the cut is exact)
+        removed, thresh = 0, None
+        for t in np.unique(cold):
+            removed = int((cold <= t).sum())
+            thresh = int(t)
+            if (self.capacity
+                    - self._mem_cap_for(total_live - removed,
+                                        touched_now)) * bps \
+                    >= target_bytes:
+                break
+        new_cap = self._mem_cap_for(total_live - removed, touched_now)
+        if thresh is None or new_cap >= self.capacity:
+            return 0               # shrink impossible — hot set owns it
+        return self._mem_do_evict(epoch, thresh, new_cap,
+                                  total_live - removed)
+
+    def memory_maintain(self, epoch: int) -> None:
+        """Steady-state LRU tick: once eviction is on, cold slots spill
+        BEFORE occupancy reaches the growth threshold — eviction is the
+        plan, capacity resize the fallback. Evicts the oldest stamps
+        until occupancy (plus one interval of headroom) sits at the 0.35
+        target; a same-capacity purge still counts (it buys distance
+        from the overflow cliff)."""
+        if not self._mem_lru_on or self._slot_epoch is None:
+            return
+        if self._occ_known <= 0.55 * self.capacity:
+            return
+        live_np, ep_np, cold, touched_now = self._mem_fetch_stats(epoch)
+        if cold.size == 0:
+            return
+        total_live = int(live_np.sum())
+        need = total_live + touched_now - int(0.35 * self.capacity)
+        removed, thresh = 0, None
+        for t in np.unique(cold):
+            removed = int((cold <= t).sum())
+            thresh = int(t)
+            if removed >= need:
+                break
+        new_cap = min(self.capacity,
+                      self._mem_cap_for(total_live - removed,
+                                        touched_now))
+        self._mem_do_evict(epoch, thresh, new_cap, total_live - removed)
+
+    def _mem_check_reload(self, chunks: list) -> None:
+        """Read-through miss handling: before a drain applies, reload any
+        spilled key the chunks touch (one packed fetch of the chunks' key
+        columns — only paid while spilled state exists)."""
+        if not self._spill:
+            return
+        from ..utils.d2h import fetch_columns
+        nk = len(self.group_key_indices)
+        arrays = []
+        for ch in chunks:
+            arrays.extend(ch.columns[i].data for i in self.group_key_indices)
+            arrays.append(ch.vis)
+        host = fetch_columns(arrays)
+        seen: set = set()
+        touched: list = []
+        for ci in range(len(chunks)):
+            part = host[ci * (nk + 1):(ci + 1) * (nk + 1)]
+            vis = part[-1].astype(bool)
+            idx = np.flatnonzero(vis)
+            for vals in zip(*(c[idx] for c in part[:nk])):
+                k = tuple(v.item() for v in vals)
+                if k in seen:
+                    continue
+                seen.add(k)
+                if k in self._spill:
+                    touched.append(k)
+        if not touched:
+            return
+        rows = [row for k in touched for row in self._spill.pop(k)]
+        self._mem_reload_rows(rows)
+        self.mem_reload_count += len(touched)
+        from ..utils.metrics import HBM_RELOADS
+        HBM_RELOADS.inc(len(touched))
+
+    def _mem_reload_rows(self, rows: list) -> None:
+        """Scatter spilled durable-layout rows back into live state (the
+        same row format recovery replays — read-through rides the replay
+        machinery). Keys insert via lookup_or_insert; unresolved inserts
+        accumulate into the overflow watchdog (fail-stop -> recovery
+        rebuilds larger), but the host pre-grows when occupancy is known
+        to crowd."""
+        if not rows:
+            return
+        n = len(rows)
+        if self._occ_known + n > 0.7 * self.capacity:
+            cap = self.capacity
+            while self._occ_known + n > 0.7 * cap:
+                cap *= 2
+            self._occ_known = self._rebuild(cap)
+        B = 1 << max(0, (n - 1).bit_length())
+        pad = rows + [rows[0]] * (B - n)
+        active = jnp.asarray(np.arange(B) < n)
+        nk = len(self.group_key_indices)
+        key_cols = tuple(
+            jnp.asarray(np.asarray([r[j] for r in pad],
+                                   dtype=np.dtype(self._key_dtypes[j])))
+            for j in range(nk))
+        call_cols = []
+        off = nk
+        for j, spec in enumerate(self.specs):
+            if self._retractable[j]:
+                K = self.minput_k
+                vals = jnp.asarray(np.asarray(
+                    [[r[off + k] for k in range(K)] for r in pad]),
+                    dtype=spec.state_dtype)
+                cnts = jnp.asarray(np.asarray(
+                    [[r[off + K + k] for k in range(K)] for r in pad],
+                    dtype=np.int32))
+                lossy = jnp.asarray(np.asarray(
+                    [bool(r[off + 2 * K]) for r in pad]))
+                call_cols.append((vals, cnts, lossy))
+                off += 2 * K + 1
+            else:
+                call_cols.append(jnp.asarray(
+                    np.asarray([r[off] for r in pad])).astype(
+                        spec.state_dtype))
+                off += 1
+        row_count = jnp.asarray(np.asarray([r[off] for r in pad],
+                                           dtype=np.int64))
+        reload = self._mem_reloads.get(B)
+        if reload is None:
+            reload = jit_state(self._mem_reload_impl, donate_argnums=(0, 1),
+                               name=f"hash_agg_mem_reload{B}")
+            self._mem_reloads[B] = reload
+        self.state, self._overflow_dev = reload(
+            self.state, self._overflow_dev, key_cols, tuple(call_cols),
+            row_count, active)
+        self._applied_since_flush = True
+        self._occ_known += n
+
+    def _mem_reload_impl(self, state: AggState, overflow, key_cols,
+                         call_cols, row_count, active):
+        table, slots, n_un = lookup_or_insert(state.table, key_cols, active)
+        C = table.capacity
+        ok = active & (slots >= 0)
+        tgt = jnp.where(ok, slots, C)
+        agg_states, prev_emit = [], []
+        for j in range(len(self.specs)):
+            cs = call_cols[j]
+            if self._retractable[j]:
+                vals_b, cnts_b, lossy_b = cs
+                e_vals, e_cnts, e_lossy = state.agg_states[j]
+                agg_states.append((
+                    e_vals.at[tgt].set(vals_b, mode="drop"),
+                    e_cnts.at[tgt].set(cnts_b, mode="drop"),
+                    e_lossy.at[tgt].set(lossy_b, mode="drop")))
+            else:
+                agg_states.append(state.agg_states[j].at[tgt].set(
+                    cs.astype(state.agg_states[j].dtype), mode="drop"))
+            prev_emit.append(state.prev_emit[j].at[tgt].set(
+                self._call_emit(j, cs), mode="drop"))
+        # dirty=True: re-persists the rows (idempotent upsert), keeps the
+        # LRU stamp hot, and the flush's no-change skip still emits no
+        # changelog because prev_emit matches
+        return AggState(
+            table=table,
+            agg_states=tuple(agg_states),
+            row_count=state.row_count.at[tgt].set(row_count, mode="drop"),
+            dirty=state.dirty.at[tgt].set(True, mode="drop"),
+            prev_exists=state.prev_exists.at[tgt].set(True, mode="drop"),
+            prev_emit=tuple(prev_emit),
+        ), (overflow + n_un).astype(overflow.dtype)
+
+    def _clean_spilled(self, wm) -> None:
+        """Watermark state cleaning of EVICTED ranges: spilled keys below
+        the cleaning watermark leave the spill dict and (when durable)
+        the state table, in step with the device-side zeroing."""
+        if not self._spill or self.cleaning_watermark_key is None:
+            return
+        j = self.cleaning_watermark_key
+        dead = self._spill.purge(lambda k, rows: k[j] < wm)
+        if dead and self.state_table is not None:
+            keys_np = [
+                np.asarray([k[i] for k, _ in dead],
+                           dtype=np.dtype(self._key_dtypes[i]))
+                for i in range(len(self.group_key_indices))]
+            self._apply_evict_deletes(keys_np, len(dead))
 
     # ------------------------------------------------------- persistence
     def _persist(self, barrier: Barrier) -> None:
@@ -545,29 +875,33 @@ class HashAggExecutor(Executor):
         # sharded subclass can run it per shard under shard_map.
         C = st.table.capacity
         exists_now = st.row_count > 0
-        rank = jnp.cumsum(st.dirty.astype(jnp.int32)) - 1
-        slot_ids = jnp.arange(C, dtype=jnp.int32)
-        d_slot = jnp.zeros(C, dtype=jnp.int32).at[
-            jnp.where(st.dirty, rank, C)].set(slot_ids, mode="drop")
-        n_dirty = jnp.sum(st.dirty.astype(jnp.int32))
-        is_dirty = slot_ids < n_dirty
+        d_slot, n_dirty = compact_mask(st.dirty)
+        is_dirty = jnp.arange(C, dtype=jnp.int32) < n_dirty
         exists = exists_now[d_slot]
         existed = st.prev_exists[d_slot]
         vis = is_dirty & (exists | existed)
         ops = jnp.where(exists, OP_INSERT, OP_DELETE).astype(jnp.int8)
-        cols = [tk[d_slot] for tk in st.table.keys]
+        cols = self._durable_cols_at(st, d_slot)
+        return cols, ops, vis, n_dirty
+
+    def _durable_cols_at(self, st: AggState, sel: jnp.ndarray) -> list:
+        """Durable-row column layout (keys ++ raw agg states ++
+        row_count) gathered at `sel` — shared by the persist view and the
+        memory-eviction spill pack, so spilled rows and persisted rows
+        are byte-for-byte the same format."""
+        cols = [tk[sel] for tk in st.table.keys]
         for j, ags in enumerate(st.agg_states):
             if self._retractable[j]:
                 vals, cnts, lossy = ags
                 for k in range(self.minput_k):
-                    cols.append(vals[d_slot, k])
+                    cols.append(vals[sel, k])
                 for k in range(self.minput_k):
-                    cols.append(cnts[d_slot, k].astype(jnp.int64))
-                cols.append(lossy[d_slot].astype(jnp.int64))
+                    cols.append(cnts[sel, k].astype(jnp.int64))
+                cols.append(lossy[sel].astype(jnp.int64))
             else:
-                cols.append(ags[d_slot])
-        cols.append(st.row_count[d_slot])
-        return cols, ops, vis, n_dirty
+                cols.append(ags[sel])
+        cols.append(st.row_count[sel])
+        return cols
 
     def _call_persist_width(self, j: int) -> int:
         """Columns one agg call contributes to the durable state row."""
@@ -575,6 +909,10 @@ class HashAggExecutor(Executor):
 
     def recover(self, barrier_epoch: int) -> None:
         """Rebuild device state from the state table (recovery path)."""
+        # spilled rows are in the durable table too (eviction never
+        # deletes them), so recovery rebuilds EVERYTHING resident and the
+        # host spill is simply dropped
+        self._spill.clear()
         if self.state_table is None:
             return
         rows = [r for _, r in self.state_table.iter_all()]
@@ -646,6 +984,10 @@ class HashAggExecutor(Executor):
 
     # ---------------------------------------------------- multi-chunk apply
     def _apply_chunk_now(self, chunk: StreamChunk) -> None:
+        self._mem_check_reload([chunk])
+        self._apply_chunk_raw(chunk)
+
+    def _apply_chunk_raw(self, chunk: StreamChunk) -> None:
         self.state, self._overflow_dev, self._occ_dev = self._apply(
             self.state, self._overflow_dev, chunk)
         self._applied_since_flush = True
@@ -676,6 +1018,7 @@ class HashAggExecutor(Executor):
         if len(p) == 1:
             self._apply_chunk_now(p[0])
             return
+        self._mem_check_reload(p)
         # bucket the batch length to a power of two so the scan program
         # set stays tiny; filler chunks are all-invisible views of the
         # last chunk's arrays (zero-copy) and contribute nothing
@@ -737,6 +1080,10 @@ class HashAggExecutor(Executor):
                 if self.watchdog_interval and (
                         stopping or self._applied_since_flush):
                     self._check_watchdog()
+                # LRU epoch stamp BEFORE the flush resets dirty (one
+                # segment_max per interval; no-op while eviction is off)
+                if self._mem_lru_on and self._applied_since_flush:
+                    self._mem_stamp(msg.epoch.curr)
                 self._persist(msg)
                 flushed = self._applied_since_flush
                 if flushed:
@@ -746,6 +1093,7 @@ class HashAggExecutor(Executor):
                         tuple(Column(c) for c in cols), ops, vis, self.schema)
                 if (self.cleaning_watermark_key is not None
                         and self._pending_clean_wm is not None):
+                    self._clean_spilled(self._pending_clean_wm)
                     self.state = self._evict(self.state, self._pending_clean_wm)
                     self._pending_clean_wm = None
                     flushed = True

@@ -41,8 +41,10 @@ from ..common.chunk import (
     Column, StreamChunk, OP_DELETE, OP_INSERT, op_sign,
 )
 from ..common.types import Field, Schema
+from ..memory.accounting import pytree_bytes
+from ..memory.spill import HostSpill
 from ..ops.hash_table import (HashTable, lookup, lookup_or_insert,
-                              stable_lexsort)
+                              lru_stamp, pack_rows, stable_lexsort)
 from ..ops.jit_state import jit_state
 from ..state.state_table import StateTable
 from .align import LEFT, RIGHT, barrier_align
@@ -251,6 +253,27 @@ class HashJoinExecutor(Executor):
         # watermark bookkeeping: per side, last seen watermark per key position
         self._key_wms: list[dict[int, int]] = [{}, {}]
         self._emitted_key_wm: dict[int, int] = {}
+        # ---- HBM memory manager hooks (memory/manager.py): per-ROW
+        # int64 LRU epoch stamps per side; cold clean rows tombstone +
+        # spill to host, the shrinking rehash reclaims their HBM, and a
+        # later touch (probe, delete, or same-key insert) reloads the
+        # key's rows at drain time before the chunk applies.
+        self._mem_lru_on = False
+        self._slot_epoch: list = [None, None]      # int64 [CR] per side
+        self._spill = [HostSpill(), HostSpill()]
+        self.mem_evicted_bytes = 0
+        self.mem_reload_count = 0
+        self._lru_stamp = jit_state(self._lru_stamp_impl,
+                                    donate_argnums=(1,),
+                                    name="hash_join_lru_stamp")
+        self._mem_stats = jit_state(self._mem_stats_impl,
+                                    name="hash_join_mem_stats")
+        self._mem_pack = jit_state(self._mem_pack_impl,
+                                   name="hash_join_mem_pack")
+        self._mem_evict_apply = jit_state(self._mem_evict_impl,
+                                          donate_argnums=(0,),
+                                          name="hash_join_mem_evict")
+        self._mem_reloads: dict = {}
 
     def fence_tokens(self) -> list:
         toks = [s.top for s in self.sides if s is not None]
@@ -524,6 +547,11 @@ class HashJoinExecutor(Executor):
             side_state.dirty, side_state.top)
 
     def recover(self) -> None:
+        # spilled rows live in the durable tables too; recovery rebuilds
+        # everything resident and drops the host spill
+        for sp in self._spill:
+            sp.clear()
+        self._slot_epoch = [None, None]
         for s in (LEFT, RIGHT):
             st = self.state_tables[s]
             if st is None:
@@ -553,6 +581,279 @@ class HashJoinExecutor(Executor):
             self.sides[s] = JoinSideState(
                 side.key_table, side.head, side.rows, side.valids, side.next,
                 side.live, jnp.zeros(side.row_capacity, dtype=bool), side.top)
+
+    # ------------------------------------------------- HBM memory manager
+    def state_bytes(self) -> int:
+        extras = tuple(g for g in self._slot_epoch if g is not None)
+        return pytree_bytes((self.sides, extras))
+
+    @property
+    def mem_spilled_rows(self) -> int:
+        return self._spill[LEFT].rows + self._spill[RIGHT].rows
+
+    def memory_enable_lru(self) -> None:
+        self._mem_lru_on = True
+
+    def _lru_stamp_impl(self, dirty, slot_epoch, epoch):
+        return lru_stamp(slot_epoch, dirty, epoch)
+
+    def _mem_stamp(self, s: int, epoch: int) -> None:
+        if self._slot_epoch[s] is None \
+                or self._slot_epoch[s].shape[0] != self.row_capacity[s]:
+            self._slot_epoch[s] = jnp.full(self.row_capacity[s], epoch,
+                                           dtype=jnp.int64)
+            return
+        self._slot_epoch[s] = self._lru_stamp(
+            self.sides[s].dirty, self._slot_epoch[s], epoch)
+
+    def _mem_stats_impl(self, side_state: JoinSideState, slot_epoch):
+        return side_state.live & ~side_state.dirty, slot_epoch
+
+    def _mem_pack_impl(self, side_state: JoinSideState, slot_epoch, thresh):
+        evict = (side_state.live & ~side_state.dirty
+                 & (slot_epoch <= thresh))
+        return pack_rows(evict, list(side_state.rows)
+                         + list(side_state.valids))
+
+    def _mem_evict_impl(self, side_state: JoinSideState, slot_epoch,
+                        thresh):
+        """Tombstone the cold rows (chains stay intact); the shrinking
+        rehash right after reclaims the slots."""
+        drop = (side_state.live & ~side_state.dirty
+                & (slot_epoch <= thresh))
+        return JoinSideState(
+            side_state.key_table, side_state.head, side_state.rows,
+            side_state.valids, side_state.next, side_state.live & ~drop,
+            side_state.dirty, side_state.top)
+
+    def _mem_fetch_stats(self, s: int, epoch: int):
+        """(live mask, stamps, cold stamps asc, this-interval churn) for
+        one side in ONE packed fetch."""
+        from ..utils.d2h import fetch_columns
+        live_dev, ep_dev = self._mem_stats(self.sides[s],
+                                           self._slot_epoch[s])
+        live_np, ep_np = fetch_columns([live_dev, ep_dev])
+        live_np = live_np.astype(bool)
+        cold = np.sort(ep_np[live_np & (ep_np < epoch)])
+        return live_np, ep_np, cold, int((ep_np == epoch).sum())
+
+    @staticmethod
+    def _mem_cap_for(n_survive: int, touched_now: int) -> int:
+        """Survivors + one interval of fresh rows at 0.35 target load —
+        no immediate re-grow, no mid-epoch overflow."""
+        c = 256
+        while n_survive + touched_now > 0.35 * c:
+            c *= 2
+        return c
+
+    def _mem_do_evict(self, s: int, epoch: int, thresh: int,
+                      new_cr: int, survivors_hint: int) -> int:
+        """Pack + spill side `s` rows stamped <= thresh, tombstone them,
+        rehash the row store at new_cr. Returns bytes freed."""
+        from ..utils.d2h import fetch_prefix_groups
+        t_dev = jnp.int64(thresh)
+        cols_dev, n_dev = self._mem_pack(self.sides[s],
+                                         self._slot_epoch[s], t_dev)
+        n = int(np.asarray(n_dev))
+        nc = len(self._col_dtypes[s])
+        if n:
+            host = fetch_prefix_groups([(list(cols_dev), n)])[0]
+            for r in range(n):
+                vals = tuple(host[c][r].item() for c in range(nc))
+                valids = tuple(bool(host[nc + c][r]) for c in range(nc))
+                key = tuple(vals[i] for i in self.key_indices[s])
+                self._spill[s].add(key, (vals, valids))
+        before = pytree_bytes(self.sides[s])
+        self.sides[s] = self._mem_evict_apply(
+            self.sides[s], self._slot_epoch[s], t_dev)
+        self.sides[s] = self._rehash(
+            self.sides[s], side=s, new_ck=self.key_capacity[s],
+            new_cr=new_cr)
+        self.row_capacity[s] = new_cr
+        self._slot_epoch[s] = None
+        self.rebuilds += 1
+        occ2, _, top2 = self._stats(self.sides[s])
+        self._occ_known[s], self._top_known[s] = int(occ2), int(top2)
+        freed = max(0, before - pytree_bytes(self.sides[s]))
+        self.mem_evicted_bytes += freed
+        return freed
+
+    def memory_evict(self, target_bytes: int, epoch: int) -> int:
+        """Budget response: spill each side's coldest rows to host and
+        rehash the row store smaller. Runs between epochs (manager
+        hook); dirty rows never spill — the persist path owns them until
+        the next flush."""
+        if not self._mem_lru_on:
+            return 0
+        freed_total = 0
+        order = sorted((LEFT, RIGHT),
+                       key=lambda s: -pytree_bytes(self.sides[s]))
+        for s in order:
+            if freed_total >= target_bytes:
+                break
+            if self._slot_epoch[s] is None:
+                continue
+            live_np, ep_np, cold, touched_now = \
+                self._mem_fetch_stats(s, epoch)
+            if cold.size == 0:
+                continue
+            total_live = int(live_np.sum())
+            bps = max(1, pytree_bytes(self.sides[s])
+                      // max(1, self.row_capacity[s]))
+            removed, thresh = 0, None
+            for t in np.unique(cold):
+                removed = int((cold <= t).sum())
+                thresh = int(t)
+                if (self.row_capacity[s]
+                        - self._mem_cap_for(total_live - removed,
+                                            touched_now)) * bps \
+                        >= target_bytes - freed_total:
+                    break
+            new_cr = self._mem_cap_for(total_live - removed, touched_now)
+            if thresh is None or new_cr >= self.row_capacity[s]:
+                continue
+            freed_total += self._mem_do_evict(s, epoch, thresh, new_cr,
+                                              total_live - removed)
+        return freed_total
+
+    def memory_maintain(self, epoch: int) -> None:
+        """Steady-state LRU tick: spill cold rows BEFORE a side's row
+        store reaches the growth threshold — eviction is the plan,
+        capacity resize the fallback."""
+        if not self._mem_lru_on:
+            return
+        for s in (LEFT, RIGHT):
+            if self._slot_epoch[s] is None:
+                continue
+            if self._top_known[s] <= 0.55 * self.row_capacity[s]:
+                continue
+            live_np, ep_np, cold, touched_now = \
+                self._mem_fetch_stats(s, epoch)
+            if cold.size == 0:
+                continue
+            total_live = int(live_np.sum())
+            need = (total_live + touched_now
+                    - int(0.35 * self.row_capacity[s]))
+            removed, thresh = 0, None
+            for t in np.unique(cold):
+                removed = int((cold <= t).sum())
+                thresh = int(t)
+                if removed >= need:
+                    break
+            new_cr = min(self.row_capacity[s],
+                         self._mem_cap_for(total_live - removed,
+                                           touched_now))
+            self._mem_do_evict(s, epoch, thresh, new_cr,
+                               total_live - removed)
+
+    def _mem_check_reload(self, side: int, chunks: list) -> None:
+        """Read-through miss handling before a run applies: a chunk from
+        `side` probes the other side and mutates its own, so spilled keys
+        on EITHER side that the chunk's keys touch reload first."""
+        if not (self._spill[LEFT] or self._spill[RIGHT]):
+            return
+        from ..utils.d2h import fetch_columns
+        key_idx = self.key_indices[side]
+        nk = len(key_idx)
+        arrays = []
+        for ch in chunks:
+            arrays.extend(ch.columns[i].data for i in key_idx)
+            arrays.append(ch.vis)
+        host = fetch_columns(arrays)
+        keys: list = []
+        seen: set = set()
+        for ci in range(len(chunks)):
+            part = host[ci * (nk + 1):(ci + 1) * (nk + 1)]
+            idx = np.flatnonzero(part[-1].astype(bool))
+            for vals in zip(*(c[idx] for c in part[:nk])):
+                k = tuple(v.item() for v in vals)
+                if k not in seen:
+                    seen.add(k)
+                    keys.append(k)
+        for t in (side, 1 - side):
+            touched = self._spill[t].take_touched(keys)
+            if touched:
+                self._mem_reload_rows(
+                    t, [rw for rows in touched.values() for rw in rows])
+                self.mem_reload_count += len(touched)
+                from ..utils.metrics import HBM_RELOADS
+                HBM_RELOADS.inc(len(touched))
+
+    def _mem_reload_rows(self, t: int, entries: list) -> None:
+        """Re-insert spilled rows into side `t`'s store (clean — they are
+        already durable); rides the same bulk-insert machinery recovery
+        replays through."""
+        if not entries:
+            return
+        n = len(entries)
+        # pre-grow so the reload cannot overflow the row store
+        if self._top_known[t] + n > 0.7 * self.row_capacity[t] \
+                or self._occ_known[t] + n > 0.7 * self.key_capacity[t]:
+            new_cr, new_ck = self.row_capacity[t], self.key_capacity[t]
+            while self._top_known[t] + n > 0.7 * new_cr:
+                new_cr *= 2
+            while self._occ_known[t] + n > 0.7 * new_ck:
+                new_ck *= 2
+            self.sides[t] = self._rehash(self.sides[t], side=t,
+                                         new_ck=new_ck, new_cr=new_cr)
+            self.row_capacity[t], self.key_capacity[t] = new_cr, new_ck
+            self._slot_epoch[t] = None
+            occ2, _, top2 = self._stats(self.sides[t])
+            self._occ_known[t], self._top_known[t] = int(occ2), int(top2)
+        B = 1 << max(0, (n - 1).bit_length())
+        pad = entries + [entries[0]] * (B - n)
+        active = jnp.asarray(np.arange(B) < n)
+        col_data = tuple(
+            jnp.asarray(np.asarray([e[0][c] for e in pad],
+                                   dtype=np.dtype(dt)))
+            for c, dt in enumerate(self._col_dtypes[t]))
+        col_valid = tuple(
+            jnp.asarray(np.asarray([e[1][c] for e in pad], dtype=bool))
+            for c in range(len(self._col_dtypes[t])))
+        prog = self._mem_reloads.get((B, t))
+        if prog is None:
+            prog = jit_state(partial(self._mem_reload_impl, side=t),
+                             donate_argnums=(0, 1),
+                             name=f"hash_join_mem_reload{B}_s{t}")
+            self._mem_reloads[(B, t)] = prog
+        self.sides[t], self._errs_dev = prog(
+            self.sides[t], self._errs_dev, col_data, col_valid, active)
+        self._top_known[t] += n
+
+    def _mem_reload_impl(self, own: JoinSideState, errs, col_data,
+                         col_valid, active, side: int):
+        key_cols = [col_data[i] for i in self.key_indices[side]]
+        table, slots, n_un = lookup_or_insert(own.key_table, key_cols,
+                                              active)
+        own = JoinSideState(table, own.head, own.rows, own.valids,
+                            own.next, own.live, own.dirty, own.top)
+        B = active.shape[0]
+        own, n_ro = _bulk_insert(own, slots, active & (slots >= 0),
+                                 col_data, col_valid,
+                                 jnp.zeros(B, dtype=bool))
+        zero = jnp.int32(0)
+        errs = errs + jnp.stack([n_un.astype(jnp.int32), zero, zero,
+                                 n_ro.astype(jnp.int32)])
+        return own, errs
+
+    def _clean_spilled(self, s: int, wm) -> None:
+        """Watermark cleaning of evicted (spilled) join rows: rows whose
+        clean column fell below the watermark can never match again —
+        drop them from the spill and tombstone them durably."""
+        col = self.clean_cols[s]
+        if col is None or not self._spill[s]:
+            return
+        dead_rows: list = []
+        for k in list(self._spill[s].keys()):
+            rows = self._spill[s].pop(k)
+            for vals, valids in rows:
+                if vals[col] < wm:
+                    dead_rows.append((vals, valids))
+                else:
+                    self._spill[s].add(k, (vals, valids))
+        if dead_rows and self.state_tables[s] is not None:
+            self.state_tables[s].write_chunk_rows(
+                [(int(OP_DELETE), vals) for vals, _ in dead_rows])
 
     # ---------------------------------------------------------- rebuild
     def _stats_impl(self, side_state: JoinSideState):
@@ -614,6 +915,7 @@ class HashJoinExecutor(Executor):
             self.sides[s] = self._rehash(self.sides[s], side=s,
                                          new_ck=new_ck, new_cr=new_cr)
             self.key_capacity[s], self.row_capacity[s] = new_ck, new_cr
+            self._slot_epoch[s] = None       # geometry changed: restamp
             self.rebuilds += 1
             occ2, _, top2 = self._stats(self.sides[s])
             self._occ_known[s], self._top_known[s] = int(occ2), int(top2)
@@ -701,6 +1003,7 @@ class HashJoinExecutor(Executor):
         if not run:
             return []
         self._run_chunks, self._run_side = [], None
+        self._mem_check_reload(s, run)
         if len(run) == 1:
             (self.sides[s], cols, ops, vis, self._errs_dev, occ,
              top) = self._apply(self.sides[s], self.sides[1 - s],
@@ -760,10 +1063,16 @@ class HashJoinExecutor(Executor):
                 if self.watchdog_interval and (
                         stopping or any(self._dirty_since_flush)):
                     self._check_watchdog()
+                # LRU epoch stamp BEFORE persist resets the dirty bits
+                if self._mem_lru_on:
+                    for s2 in (LEFT, RIGHT):
+                        if self._dirty_since_flush[s2]:
+                            self._mem_stamp(s2, barrier.epoch.curr)
                 self._persist(barrier)
                 for s2 in (LEFT, RIGHT):
                     if (self._pending_clean[s2] is not None
                             and self.clean_cols[s2] is not None):
+                        self._clean_spilled(s2, self._pending_clean[s2])
                         self.sides[s2] = self._evict(
                             self.sides[s2], self._pending_clean[s2], side=s2)
                         self._pending_clean[s2] = None

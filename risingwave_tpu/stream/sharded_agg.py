@@ -285,6 +285,17 @@ class ShardedHashAggExecutor(HashAggExecutor):
         self.state = jax.tree_util.tree_map(concat, *locals_)
         self._occ_known = worst
 
+    # ------------------------------------------------- HBM memory manager
+    # Accounting is inherited (pytree_bytes over the global [S*C] arrays
+    # is exact), but per-shard capacity is STATIC in v1 — a shrinking
+    # rehash would need a global re-layout — so the sharded agg reports
+    # bytes and never evicts (ROADMAP open item).
+    def memory_enable_lru(self) -> None:
+        pass
+
+    def memory_evict(self, target_bytes: int, epoch: int) -> int:
+        return 0
+
     def _check_watchdog(self) -> None:
         vals = np.asarray(self._watchdog_pack(self._overflow_dev,
                                               self._occ_dev))[0]

@@ -96,14 +96,15 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
         self._apply = apply_dispatch
 
         def make_evict(side):
-            def evict_sharded(own, wm):
-                return _vec_n(self._evict_impl(_scalar_n(own), wm, side))
+            def evict_sharded(own, wm, kh):
+                return _vec_n(self._evict_impl(_scalar_n(own), wm, kh,
+                                               side))
             return jit_state(shard_map(
-                evict_sharded, mesh=mesh, in_specs=(shard, repl),
+                evict_sharded, mesh=mesh, in_specs=(shard, repl, repl),
                 out_specs=shard), name=f"sharded_join_evict_s{side}")
 
         evicts = {LEFT: make_evict(LEFT), RIGHT: make_evict(RIGHT)}
-        self._evict = lambda own, wm, side: evicts[side](own, wm)
+        self._evict = lambda own, wm, kh, side: evicts[side](own, wm, kh)
 
         # sharded accumulators replace the parent's scalars
         sharding = NamedSharding(mesh, P(VNODE_AXIS))
@@ -250,6 +251,21 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
         while worst > 0.7 * self.capacity[s]:
             self.capacity[s] *= 2
         self.sides[s] = self._sharded_empty(s)
+
+    # ------------------------------------------------- HBM memory manager
+    def _mem_local_slices(self, s: int) -> list:
+        """Spill programs run per shard slice — each is a valid local
+        sorted side (the same shape trick the sharded persist diff uses),
+        so the parent's pack/range kernels apply unchanged."""
+        return [self._shard_slice(self.sides[s], sh, s)
+                for sh in range(self.n_shards)]
+
+    def _mem_live_ns(self) -> list:
+        """Worst-shard occupancy per side (capacity is PER SHARD)."""
+        vals = np.asarray(jnp.concatenate([self.sides[LEFT].n,
+                                           self.sides[RIGHT].n]))
+        S = self.n_shards
+        return [int(vals[:S].max()), int(vals[S:].max())]
 
     # --------------------------------------------------------- watchdog
     def _check_watchdog(self) -> None:

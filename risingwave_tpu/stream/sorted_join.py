@@ -83,7 +83,9 @@ from ..common.chunk import (
     Column, StreamChunk, OP_DELETE, OP_INSERT, op_sign,
 )
 from ..common.types import Field, Schema
-from ..ops.hash_table import stable_lexsort
+from ..memory.accounting import pytree_bytes
+from ..memory.spill import HostSpill
+from ..ops.hash_table import pack_rows, stable_lexsort
 from ..ops.jit_state import jit_state
 from .align import LEFT, RIGHT, barrier_align
 from .executor import Executor
@@ -322,6 +324,28 @@ class SortedJoinExecutor(Executor):
         # watermark value a side's state is already clean to (skip
         # repeated idle-evicts while the watermark holds still)
         self._cleaned_to = [NO_WATERMARK, NO_WATERMARK]
+        # ---- HBM memory manager hooks (memory/manager.py): the dense
+        # sorted stores have fixed capacity, so the pressure response is
+        # occupancy-driven SPILL — ahead of the overflow cliff, the
+        # OLDEST rows (by the state-cleaning column, the coldness axis of
+        # a windowed join) move to host; a chunk whose key touches a
+        # spilled window reloads it through the normal apply path (the
+        # recovery-replay shape) before probing. Inner joins only —
+        # eviction cannot maintain outer-join degrees, same restriction
+        # as watermark cleaning.
+        self._mem_on = False
+        self._spill = [HostSpill(), HostSpill()]
+        self.mem_evicted_bytes = 0
+        self.mem_reload_count = 0
+        self._mem_cc_range_prog = jit_state(
+            self._mem_cc_range_impl, static_argnames=("side",),
+            name="sorted_join_mem_range")
+        self._mem_pack_prog = jit_state(
+            self._mem_pack_impl, static_argnames=("side",),
+            name="sorted_join_mem_pack")
+        self._mem_kh_cut_prog = jit_state(
+            self._mem_kh_cut_impl, static_argnames=("frac_num",),
+            name="sorted_join_mem_kh_cut")
 
     def fence_tokens(self) -> list:
         return [s.n for s in self.sides] + super().fence_tokens()
@@ -588,13 +612,19 @@ class SortedJoinExecutor(Executor):
         return own2, other_degree, tuple(cols), ops_out, emit, errs, own2.n
 
     # ------------------------------------------------------------- evict
-    def _evict_impl(self, own: SortedSideState, wm, side: int):
-        """Barrier-time eviction for a side that saw no chunks (the apply
-        path evicts inline)."""
+    def _evict_impl(self, own: SortedSideState, wm, kh, side: int):
+        """Barrier-time eviction: rows below the side's watermark bound
+        (idle cleaning — the apply path evicts inline) and/or rows whose
+        key hash falls under `kh` (memory spill's fallback axis when the
+        time axis cannot discriminate; pass -1 to disable — key hashes
+        are nonnegative 63-bit)."""
         C = own.capacity
         cc = self.clean_cols[side]
         live = jnp.arange(C, dtype=jnp.int32) < own.n
-        keep = live & ~(own.cols[cc] < wm)
+        drop = own.khash < kh
+        if cc is not None:
+            drop = drop | (own.cols[cc] < wm)
+        keep = live & ~drop
         rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
         tgt = jnp.where(keep, rank, C)
         kh = jnp.full(C, _HSENTINEL, dtype=jnp.int64).at[tgt].set(
@@ -721,6 +751,11 @@ class SortedJoinExecutor(Executor):
         columns on BOTH sides (match_cnt for left inserts, scatter-adds
         for right rows) including the non-equi condition — so degrees need
         no durable table of their own. Replay outputs are discarded."""
+        # spilled rows are in the durable tables too (eviction re-points
+        # the diff base instead of deleting); recovery rebuilds them
+        # resident and the host spill is dropped
+        for sp in self._spill:
+            sp.clear()
         if all(st is None for st in self.state_tables):
             return
         rows_by_side: list[list] = []
@@ -755,6 +790,210 @@ class SortedJoinExecutor(Executor):
                 self._errs_dev = out[5]
                 self._n_dev[s] = out[6]
         self._snap = [self.sides[LEFT], self.sides[RIGHT]]
+
+    # ------------------------------------------------- HBM memory manager
+    def state_bytes(self) -> int:
+        return pytree_bytes(self.sides)
+
+    @property
+    def mem_spilled_rows(self) -> int:
+        return self._spill[LEFT].rows + self._spill[RIGHT].rows
+
+    def memory_enable_lru(self) -> None:
+        self._mem_on = True
+
+    def _mem_local_slices(self, s: int) -> list:
+        """Local side-state views the spill programs run over (the
+        sharded subclass returns one slice per shard)."""
+        return [self.sides[s]]
+
+    def _mem_live_ns(self) -> list:
+        vals = np.asarray(jnp.stack([self.sides[LEFT].n,
+                                     self.sides[RIGHT].n]))
+        return [int(vals[0]), int(vals[1])]
+
+    def _mem_cc_range_impl(self, side_state: SortedSideState, side: int):
+        cc = self.clean_cols[side]
+        C = side_state.capacity
+        live = jnp.arange(C, dtype=jnp.int32) < side_state.n
+        v = side_state.cols[cc].astype(jnp.int64)
+        big = jnp.iinfo(jnp.int64).max
+        lo = jnp.min(jnp.where(live, v, big))
+        hi = jnp.max(jnp.where(live, v, -big))
+        return lo, hi
+
+    def _mem_pack_impl(self, side_state: SortedSideState, cc_thresh,
+                       kh_thresh, side: int):
+        cc = self.clean_cols[side]
+        C = side_state.capacity
+        live = jnp.arange(C, dtype=jnp.int32) < side_state.n
+        mask = side_state.khash < kh_thresh
+        if cc is not None:
+            mask = mask | (side_state.cols[cc] < cc_thresh)
+        return pack_rows(live & mask, list(side_state.cols)
+                         + list(side_state.valids))
+
+    def _mem_kh_cut_impl(self, side_state: SortedSideState, frac_num: int):
+        """Key-hash value at the frac_num/4 quantile of the live prefix
+        (the store is SORTED by khash, so a quantile is one gather)."""
+        idx = jnp.clip(side_state.n * frac_num // 4 - 1, 0,
+                       side_state.capacity - 1)
+        return jnp.where(side_state.n > 0, side_state.khash[idx],
+                         jnp.int64(-1))
+
+    def _mem_cc_range(self, s: int) -> tuple[int, int]:
+        parts = [self._mem_cc_range_prog(sl, side=s)
+                 for sl in self._mem_local_slices(s)]
+        arr = np.asarray(jnp.stack([x for p in parts for x in p]))
+        return int(arr[0::2].min()), int(arr[1::2].max())
+
+    def memory_maintain(self, epoch: int) -> None:
+        """Barrier-time manager tick: sides past 60% occupancy spill cold
+        rows to host ahead of the overflow cliff, so a tight fixed
+        capacity degrades to host traffic instead of fail-stop +
+        recovery-resize. Coldness axis: the state-cleaning (event-time)
+        column when its live range discriminates — oldest windows first;
+        otherwise (one hot window owns the shard) a key-hash prefix, so
+        the spill is uniform over keys and reloads stay key-targeted."""
+        if not self._mem_on or self.join_type != "inner":
+            return
+        ns = None
+        for s in (LEFT, RIGHT):
+            if ns is None:
+                ns = self._mem_live_ns()
+            if ns[s] <= 0.6 * self.capacity[s]:
+                continue
+            cc_t, kh_t = NO_WATERMARK, -1
+            if self.clean_cols[s] is not None:
+                lo, hi = self._mem_cc_range(s)
+                if hi > lo:
+                    cc_t = min(hi, lo + max(1, (hi - lo) // 2))
+            if cc_t == NO_WATERMARK:
+                # hash-prefix fallback: keep only the newest quarter of
+                # capacity's worth so one interval's burst still fits
+                vals = np.asarray(jnp.stack(
+                    [self._mem_kh_cut_prog(sl, 3)
+                     for sl in self._mem_local_slices(s)]))
+                kh_t = int(np.median(vals))
+                if kh_t <= 0:
+                    continue
+            self._mem_spill_below(s, cc_t, kh_t)
+
+    def _mem_spill_below(self, s: int, cc_thresh: int,
+                         kh_thresh: int) -> int:
+        """Pack + fetch the rows under the thresholds, park them in the
+        host spill, drop them on device. The durable table KEEPS them
+        (the snapshot diff base is re-pointed past the eviction), which is
+        what makes crash recovery rebuild them for free."""
+        from ..utils.d2h import fetch_prefix_groups
+        nc = len(self._col_dtypes[s])
+        t_dev = jnp.int64(cc_thresh)
+        kh_dev = jnp.int64(kh_thresh)
+        packs = [self._mem_pack_prog(sl, t_dev, kh_dev, side=s)
+                 for sl in self._mem_local_slices(s)]
+        counts = np.asarray(jnp.stack([p[1] for p in packs]))
+        total = int(counts.sum())
+        if total == 0:
+            return 0
+        groups = [(list(p[0]), int(c))
+                  for p, c in zip(packs, counts) if int(c)]
+        for host in fetch_prefix_groups(groups):
+            for r in range(host[0].shape[0]):
+                vals = tuple(host[c][r].item() for c in range(nc))
+                valids = tuple(bool(host[nc + c][r]) for c in range(nc))
+                key = tuple(vals[i] for i in self.key_indices[s])
+                self._spill[s].add(key, (vals, valids))
+        self.sides[s] = self._evict(self.sides[s], t_dev, kh_dev, side=s)
+        # the eviction must NOT become durable deletes: re-point the diff
+        # base so the next persist diff skips it (the rows stay in the
+        # table for recovery; reloads re-insert them as idempotent
+        # upserts)
+        self._snap[s] = self.sides[s]
+        from ..utils.metrics import HBM_EVICTIONS
+        HBM_EVICTIONS.inc()
+        return total
+
+    def _mem_check_reload(self, side: int, chunk: StreamChunk) -> None:
+        """Read-through before a chunk applies: its keys can probe the
+        other side and retract on its own, so spilled keys on EITHER side
+        reload first (one packed fetch of the chunk's key columns, paid
+        only while spilled state exists)."""
+        from ..utils.d2h import fetch_columns
+        key_idx = self.key_indices[side]
+        host = fetch_columns(
+            [chunk.columns[i].data for i in key_idx] + [chunk.vis])
+        idx = np.flatnonzero(host[-1].astype(bool))
+        keys, seen = [], set()
+        for vals in zip(*(c[idx] for c in host[:-1])):
+            k = tuple(v.item() for v in vals)
+            if k not in seen:
+                seen.add(k)
+                keys.append(k)
+        for t in (side, 1 - side):
+            touched = self._spill[t].take_touched(keys)
+            if touched:
+                self._mem_reload_rows(
+                    t, [rw for rows in touched.values() for rw in rows])
+                self.mem_reload_count += len(touched)
+                from ..utils.metrics import HBM_RELOADS
+                HBM_RELOADS.inc(len(touched))
+
+    def _mem_reload_rows(self, t: int, entries: list) -> None:
+        """Replay spilled rows through the normal apply path — the exact
+        recovery-replay shape — and DISCARD the emitted matches (they
+        were already emitted when the rows first arrived; inner join, so
+        no degree side effects)."""
+        if not entries:
+            return
+        sch = self.inputs[t].schema
+        mf = max(self.match_factors[t], 64)
+        batch = 1 << 12
+        for i in range(0, len(entries), batch):
+            part = entries[i:i + batch]
+            cap = 1 << max(1, (len(part) - 1).bit_length())
+            cols = []
+            for c, f in enumerate(sch):
+                data = np.zeros(cap, dtype=f.data_type.np_dtype)
+                valid = np.zeros(cap, dtype=bool)
+                for r, (vals, valids) in enumerate(part):
+                    data[r] = vals[c]
+                    valid[r] = valids[c]
+                cols.append(Column(jnp.asarray(data), jnp.asarray(valid)))
+            ch = StreamChunk(tuple(cols),
+                             jnp.full(cap, OP_INSERT, dtype=jnp.int8),
+                             jnp.asarray(np.arange(cap) < len(part)), sch)
+            out = self._apply(self.sides[t], self.sides[1 - t],
+                              self._errs_dev, ch,
+                              jnp.int64(self._pending_clean[t]), side=t,
+                              match_factor=mf)
+            self.sides[t] = out[0]
+            o = self.sides[1 - t]
+            self.sides[1 - t] = SortedSideState(o.khash, o.cols, o.valids,
+                                                out[1], o.n)
+            self._errs_dev = out[5]
+            self._n_dev[t] = out[6]
+        self._dirty[t] = True
+        self._flush_dirty[t] = True
+
+    def _mem_clean_spilled(self, s: int) -> None:
+        """Watermark cleaning of evicted ranges: spilled rows below the
+        side's eviction bound can never match again — drop them and write
+        their durable tombstones."""
+        wm = self._pending_clean[s]
+        col = self.clean_cols[s]
+        if col is None or wm == NO_WATERMARK or not self._spill[s]:
+            return
+        dead: list = []
+        for k in list(self._spill[s].keys()):
+            rows = self._spill[s].pop(k)
+            for vals, valids in rows:
+                if vals[col] < wm:
+                    dead.append(vals)
+                else:
+                    self._spill[s].add(k, (vals, valids))
+        if dead and self.state_tables[s] is not None:
+            self.state_tables[s].write_chunk_rows(
+                [(int(OP_DELETE), vals) for vals in dead])
 
     # ---------------------------------------------------------- cleaning
     def _recompute_pending(self) -> None:
@@ -832,6 +1071,8 @@ class SortedJoinExecutor(Executor):
         first = True
         async for kind, s, msg in barrier_align(*self.inputs):
             if kind == "chunk":
+                if self._spill[LEFT] or self._spill[RIGHT]:
+                    self._mem_check_reload(s, msg)
                 wm = jnp.int64(self._pending_clean[s])
                 self._cleaned_to[s] = self._pending_clean[s]
                 (self.sides[s], oth_degree, cols, ops, vis, self._errs_dev,
@@ -868,9 +1109,11 @@ class SortedJoinExecutor(Executor):
                             and not self._dirty[s2]):
                         self.sides[s2] = self._evict(
                             self.sides[s2],
-                            jnp.int64(self._pending_clean[s2]), side=s2)
+                            jnp.int64(self._pending_clean[s2]),
+                            jnp.int64(-1), side=s2)
                         self._cleaned_to[s2] = self._pending_clean[s2]
                         self._flush_dirty[s2] = True
+                    self._mem_clean_spilled(s2)
                     self._dirty[s2] = False
                 # watchdog BEFORE the durable commit: errors fail-stop
                 # this epoch's checkpoint (hash_join.py contract)

@@ -137,6 +137,17 @@ class GrowableSortedStore:
 
     _SECONDARY: tuple = ()
 
+    def state_bytes(self) -> int:
+        """Exact accounted HBM bytes of the primary + secondary stores
+        (memory/accounting.py) — registers the executor with the memory
+        manager for per-flow accounting. Eviction for the dense sorted
+        layout is a ROADMAP open item; growth is the pressure response."""
+        from ..memory.accounting import pytree_bytes
+        h, c, v = self._SECONDARY
+        return pytree_bytes((self.khash, self.cols, self.valids,
+                             getattr(self, h), getattr(self, c),
+                             getattr(self, v)))
+
     def _grow_to(self, new_c: int) -> None:
         from functools import partial
         from ..ops.jit_state import jit_state

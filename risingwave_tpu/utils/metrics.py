@@ -144,6 +144,52 @@ class MetricsRegistry:
             lines.append(f"{name}_count{fmt_labels(labels)} {h.n}")
         return "\n".join(lines) + "\n"
 
+    def render_prometheus(self) -> str:
+        """Full Prometheus text format WITH `# TYPE` metadata, one family
+        block per metric name — the exposition a real scrape endpoint (or
+        `curl | promtool check metrics`) expects. `render()` stays the
+        terse label-value dump for the REPL; this is the export surface
+        (the `\\metrics prom` verb and any future HTTP listener)."""
+        def fmt_labels(labels):
+            if not labels:
+                return ""
+            inner = ",".join(f'{k}="{v}"' for k, v in labels)
+            return "{" + inner + "}"
+
+        by_family: dict[str, tuple[str, list[str]]] = {}
+
+        def family(name: str, typ: str) -> list[str]:
+            if name not in by_family:
+                by_family[name] = (typ, [])
+            return by_family[name][1]
+
+        for (name, labels), c in sorted(self.counters.items()):
+            family(name, "counter").append(
+                f"{name}{fmt_labels(labels)} {c.value}")
+        for (name, labels), g in sorted(self.gauges.items()):
+            family(name, "gauge").append(
+                f"{name}{fmt_labels(labels)} {g.value}")
+        for (name, labels), h in sorted(self.histograms.items()):
+            rows = family(name, "histogram")
+            acc = 0
+            for b, cnt in zip(h.buckets, h.counts):
+                acc += cnt
+                lab = dict(labels)
+                lab["le"] = b
+                rows.append(
+                    f"{name}_bucket{fmt_labels(sorted(lab.items()))} {acc}")
+            lab = dict(labels)
+            lab["le"] = "+Inf"
+            rows.append(
+                f"{name}_bucket{fmt_labels(sorted(lab.items()))} {h.n}")
+            rows.append(f"{name}_sum{fmt_labels(labels)} {h.sum}")
+            rows.append(f"{name}_count{fmt_labels(labels)} {h.n}")
+        lines = []
+        for name, (typ, rows) in sorted(by_family.items()):
+            lines.append(f"# TYPE {name} {typ}")
+            lines.extend(rows)
+        return "\n".join(lines) + "\n"
+
 
 # the process-default registry (reference GLOBAL_METRICS_REGISTRY)
 GLOBAL_METRICS = MetricsRegistry()
@@ -179,3 +225,14 @@ CHECKPOINT_BACKPRESSURE_SECONDS = GLOBAL_METRICS.counter(
 # durable bench's d2h_bytes_per_s comes from here.
 D2H_BYTES = GLOBAL_METRICS.counter("d2h_bytes_total")
 D2H_FETCHES = GLOBAL_METRICS.counter("d2h_fetch_count")
+
+# HBM memory manager (memory/manager.py): exact accounted device-state
+# bytes vs. the configured budget, plus eviction/reload activity. The
+# global series always render; per-executor `hbm_state_bytes{executor=..}`
+# gauges ride alongside once flows register.
+HBM_STATE_BYTES = GLOBAL_METRICS.gauge("hbm_state_bytes")
+HBM_BUDGET_BYTES = GLOBAL_METRICS.gauge("hbm_budget_bytes")
+HBM_EVICTED_BYTES = GLOBAL_METRICS.counter("hbm_evicted_bytes_total")
+HBM_EVICTIONS = GLOBAL_METRICS.counter("hbm_evictions_total")
+HBM_RELOADS = GLOBAL_METRICS.counter("hbm_reloads_total")
+HBM_SPILLED_ROWS = GLOBAL_METRICS.gauge("hbm_spilled_rows")

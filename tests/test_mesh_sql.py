@@ -101,13 +101,16 @@ async def test_mesh_join_planned_and_survives_crash(tmp_path):
     s = Session(store=store)
     await _mk_q8_sources(s)
     await s.execute("SET streaming_parallelism_devices = 8")
-    # headroom for the auction.seller skew (the worst vnode shard holds
-    # ~3.5x the average): 4096 sat exactly at the per-shard cliff. State
-    # grows for the whole test (windows outlive it), so overflow ->
-    # fail-stop -> auto-recovery-resize is part of the ride; give the
-    # retry budget room for it (the pipelined checkpoint keeps one extra
-    # interval in flight, which 3 retries no longer covered).
-    await s.execute("SET streaming_join_capacity = 16384")
+    # 4096 used to sit exactly at the worst-shard overflow cliff
+    # (auction.seller skew: the worst vnode shard holds ~3.5x the
+    # average) and PR 2 bumped it to 16384 to dodge it. With the HBM
+    # memory manager enabled, the sharded join spills its oldest windows
+    # to host ahead of the cliff (read-through reload on late rows), so
+    # the tight capacity is survivable again; max_recoveries keeps
+    # headroom for the fail-stop fallback if a single interval's burst
+    # outruns the spill.
+    await s.execute("SET streaming_join_capacity = 4096")
+    await s.execute("SET hbm_budget_bytes = 1000000000")
     await s.execute(f"CREATE MATERIALIZED VIEW mj AS {JOIN_SQL}")
     assert _executors(s, "mj", ShardedSortedJoinExecutor), \
         "mesh session var did not deploy a sharded join"
