@@ -93,6 +93,8 @@ async def repl(args) -> None:
                     print(GLOBAL_METRICS.render())
                     for ln in session.coord.memory.render():
                         print(ln)
+                    for ln in session.coord.serving.render():
+                        print(ln)
             elif parts[0] == "\\trace":
                 for t in session.coord.tracer.recent():
                     print(t.render())

@@ -53,6 +53,17 @@ class StreamingConfig:
     # 'lru' (default) = epoch-stamped coldest-first eviction when a
     # budget is set; 'none' = never evict even when over budget
     memory_eviction_policy: str = "lru"
+    # serving layer (serving/): bounded worker-thread pool for batch
+    # queries over pinned snapshot caches — at most this many queries
+    # execute concurrently, excess callers queue at admission
+    serving_max_concurrency: int = 4
+    # per-query serving timeout; 0 = unbounded (the worker thread is
+    # abandoned on timeout, the client gets the error immediately)
+    serving_query_timeout_ms: int = 0
+    # 1 = maintain per-MV snapshot caches incrementally from the
+    # changelog (queries pin an epoch); 0 = every SELECT re-scans the
+    # committed LSM snapshot (the pre-serving behavior)
+    serving_cache: int = 1
 
 
 @dataclass
@@ -113,7 +124,8 @@ class SystemParams:
 
     MUTABLE = {"barrier_interval_ms", "checkpoint_frequency",
                "checkpoint_max_inflight", "hbm_budget_bytes",
-               "memory_eviction_policy"}
+               "memory_eviction_policy", "serving_max_concurrency",
+               "serving_query_timeout_ms", "serving_cache"}
 
     def __init__(self, config: Optional[RwConfig] = None):
         cfg = config or RwConfig()
@@ -125,6 +137,11 @@ class SystemParams:
             "hbm_budget_bytes": cfg.streaming.hbm_budget_bytes,
             "memory_eviction_policy":
                 cfg.streaming.memory_eviction_policy,
+            "serving_max_concurrency":
+                cfg.streaming.serving_max_concurrency,
+            "serving_query_timeout_ms":
+                cfg.streaming.serving_query_timeout_ms,
+            "serving_cache": cfg.streaming.serving_cache,
         }
         self._observers = []
 

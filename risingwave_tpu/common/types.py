@@ -150,11 +150,17 @@ class StringDictionary:
     ops on strings must go through the host path.
     """
 
-    __slots__ = ("_strings", "_ids")
+    __slots__ = ("_strings", "_ids", "_mint_lock")
 
     def __init__(self):
+        import threading
         self._strings: list[str] = []
         self._ids: dict[str, int] = {}
+        # serving queries bind literals on worker threads; minting must
+        # be atomic or two threads can hand out the same id for two
+        # different strings. Reads (decode, the hit path below) stay
+        # lock-free — the structures are append-only.
+        self._mint_lock = threading.Lock()
 
     def __len__(self):
         return len(self._strings)
@@ -162,9 +168,12 @@ class StringDictionary:
     def get_or_insert(self, s: str) -> int:
         i = self._ids.get(s)
         if i is None:
-            i = len(self._strings)
-            self._strings.append(s)
-            self._ids[s] = i
+            with self._mint_lock:
+                i = self._ids.get(s)
+                if i is None:
+                    i = len(self._strings)
+                    self._strings.append(s)
+                    self._ids[s] = i
         return i
 
     def encode_many(self, strings) -> np.ndarray:

@@ -53,12 +53,12 @@ def _scan_mv(catalog, name: str, alias: Optional[str]) -> _Rel:
     return _Rel(cols, valids, Scope.of(mv.schema, alias or name))
 
 
-def _bind_rel(catalog, rel) -> _Rel:
+def _bind_rel(catalog, rel, scan=_scan_mv) -> _Rel:
     if isinstance(rel, ast.TableRel):
-        return _scan_mv(catalog, rel.name, rel.alias)
+        return scan(catalog, rel.name, rel.alias)
     if isinstance(rel, ast.JoinRel):
-        left = _bind_rel(catalog, rel.left)
-        right = _bind_rel(catalog, rel.right)
+        left = _bind_rel(catalog, rel.left, scan)
+        right = _bind_rel(catalog, rel.right, scan)
         return _hash_join(left, right, rel.on,
                           getattr(rel, "join_type", "inner"))
     raise BindError(f"batch queries cannot read {rel!r}")
@@ -202,10 +202,12 @@ def run_batch_select(catalog, sel: ast.Select) -> list[tuple]:
     return run_batch_select_full(catalog, sel)[2]
 
 
-def run_batch_select_full(catalog, sel: ast.Select):
+def run_batch_select_full(catalog, sel: ast.Select, scan=None):
     """-> (names, DataTypes, rows) — the wire layer needs the row
-    description, not just the rows."""
-    rel = _bind_rel(catalog, sel.rel)
+    description, not just the rows. `scan` overrides how a TableRel
+    materializes (the serving layer injects pinned-snapshot relations
+    here); the default is the StorageTable committed-snapshot scan."""
+    rel = _bind_rel(catalog, sel.rel, scan if scan is not None else _scan_mv)
     if sel.where is not None:
         pred = bind_scalar(sel.where, rel.scope)
         v, valid = eval_numpy(pred, rel.cols, rel.valids)

@@ -35,9 +35,28 @@ import struct
 from typing import Optional
 
 from ..common.types import DataType
+from ..serving.pool import ServingTimeout
 from . import sql as ast
 from .binder import BindError
 from .sql import SqlError
+
+# per-connection extended-protocol state bounds: long-lived connections
+# (pools, ORMs) Parse named statements forever; without a cap the dicts
+# grow without limit. Least-recently-USED entries evict first (access
+# moves a name to the tail of the insertion-ordered dict).
+MAX_PREPARED_STATEMENTS = 64
+MAX_PORTALS = 64
+
+
+def _lru_touch(d: dict, name: str) -> None:
+    d[name] = d.pop(name)
+
+
+def _lru_insert(d: dict, name: str, value, cap: int) -> None:
+    d.pop(name, None)
+    d[name] = value
+    while len(d) > cap:
+        del d[next(iter(d))]
 
 # text-format type OIDs (pg_catalog): int8, float8, text, bool
 _OID = {
@@ -126,6 +145,13 @@ class PgServer:
                     elif tag == b"H":                    # Flush
                         pass
                     elif tag == b"S":                    # Sync
+                        # statement boundary (autocommit): the unnamed
+                        # portal closes here per the protocol, and any
+                        # cached result rows are dropped — close-portal
+                        # cleanup for drivers that never send Close
+                        portals.pop("", None)
+                        for p in portals.values():
+                            p["cached"] = None
                         skip_to_sync = False
                         self._ready(writer)
                     else:
@@ -134,6 +160,12 @@ class PgServer:
                         skip_to_sync = True
                 except _PgUserError as e:
                     self._error(writer, e.code, str(e))
+                    skip_to_sync = True
+                except ServingTimeout as e:
+                    # pg's query_canceled: the client sees the timeout
+                    # immediately; the abandoned worker thread finishes
+                    # in the background
+                    self._error(writer, "57014", str(e))
                     skip_to_sync = True
                 except (ValueError, struct.error, IndexError,
                         UnicodeDecodeError) as e:
@@ -190,9 +222,8 @@ class PgServer:
             try:
                 stmt = ast.parse(part)
                 if isinstance(stmt, ast.Select):
-                    from .batch import run_batch_select_full
-                    names, types, rows = run_batch_select_full(
-                        self.session.catalog, stmt)
+                    names, types, rows = \
+                        await self.session.run_serving_select(stmt)
                     self._row_description(writer, names, types)
                     for row in rows:
                         self._data_row(writer, row)
@@ -216,6 +247,9 @@ class PgServer:
             except (BindError, SqlError) as e:
                 self._error(writer, "42601", str(e))
                 break     # v3: a failing statement aborts the rest
+            except ServingTimeout as e:
+                self._error(writer, "57014", str(e))
+                break
             except Exception as e:  # noqa: BLE001 — surface, don't kill
                 self._error(writer, "XX000", f"{type(e).__name__}: {e}")
                 break
@@ -228,7 +262,8 @@ class PgServer:
         noids = struct.unpack_from("!h", rest, 0)[0] if len(rest) >= 2 \
             else 0
         oids = struct.unpack_from(f"!{noids}i", rest, 2) if noids else ()
-        stmts[name.decode()] = (sql_text.decode(), tuple(oids))
+        _lru_insert(stmts, name.decode(), (sql_text.decode(), tuple(oids)),
+                    MAX_PREPARED_STATEMENTS)
         writer.write(_msg(b"1", b""))         # ParseComplete
 
     def _bind_msg(self, writer, payload: bytes, stmts: dict,
@@ -263,9 +298,11 @@ class PgServer:
         rfmts = struct.unpack_from(f"!{nrfmt}h", rest, off)
         if any(f == 1 for f in rfmts):
             raise _PgUserError("0A000", "binary result format unsupported")
+        _lru_touch(stmts, stmt_name.decode())
         sql_text, oids = stmts[stmt_name.decode()]
         sql_text = _substitute_params(sql_text, params, oids)
-        portals[portal.decode()] = {"sql": sql_text, "cached": None}
+        _lru_insert(portals, portal.decode(),
+                    {"sql": sql_text, "cached": None}, MAX_PORTALS)
         writer.write(_msg(b"2", b""))         # BindComplete
 
     async def _describe_msg(self, writer, payload: bytes, stmts: dict,
@@ -286,9 +323,8 @@ class PgServer:
                 probe = _substitute_params(sql_text, [None] * n)
                 stmt = ast.parse(probe)
                 if isinstance(stmt, ast.Select):
-                    from .batch import run_batch_select_full
-                    names, types, _rows = run_batch_select_full(
-                        self.session.catalog, stmt)
+                    names, types, _rows = \
+                        await self.session.run_serving_select(stmt)
                     self._row_description(writer, names, types)
                     return
             except Exception:  # noqa: BLE001 — describe must not fail
@@ -303,10 +339,9 @@ class PgServer:
         except (BindError, SqlError) as e:
             raise _PgUserError("42601", str(e))
         if isinstance(stmt, ast.Select):
-            from .batch import run_batch_select_full
             try:
-                names, types, rows = run_batch_select_full(
-                    self.session.catalog, stmt)
+                names, types, rows = \
+                    await self.session.run_serving_select(stmt)
             except (BindError, SqlError) as e:
                 raise _PgUserError("42601", str(e))
             p["cached"] = (names, types, rows)
@@ -332,10 +367,9 @@ class PgServer:
             raise _PgUserError("42601", str(e))
         if isinstance(stmt, ast.Select):
             if p["cached"] is None:
-                from .batch import run_batch_select_full
                 try:
-                    p["cached"] = run_batch_select_full(
-                        self.session.catalog, stmt)
+                    p["cached"] = \
+                        await self.session.run_serving_select(stmt)
                 except (BindError, SqlError) as e:
                     raise _PgUserError("42601", str(e))
             _, _, rows = p["cached"]

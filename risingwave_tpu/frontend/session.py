@@ -144,6 +144,15 @@ class Session:
         # 'lru' = epoch-stamped coldest-first (the only policy); 'none'
         # disables eviction while keeping accounting
         "memory_eviction_policy": ("lru", str),
+        # serving pool admission bound (serving/pool.py): at most this
+        # many batch queries execute concurrently on worker threads
+        "serving_max_concurrency": (4, int),
+        # per-query serving timeout in ms; 0 = unbounded
+        "serving_query_timeout_ms": (0, int),
+        # 1 = per-MV snapshot caches maintained incrementally from the
+        # changelog (epoch-pinned reads, pk point-lookup index); 0 =
+        # every SELECT re-scans the committed LSM snapshot
+        "serving_cache": (1, int),
     }
 
     def __init__(self, store=None):
@@ -175,6 +184,7 @@ class Session:
             self._ddl_log = list(json.loads(blob)["ddl"])
         self.recoveries = 0
         self._apply_memory_config()
+        self._apply_serving_config()
 
     def _apply_memory_config(self) -> None:
         """Plumb the memory session vars to the live coordinator's
@@ -182,6 +192,14 @@ class Session:
         self.coord.memory.configure(
             budget_bytes=self.config["hbm_budget_bytes"],
             policy=self.config["memory_eviction_policy"])
+
+    def _apply_serving_config(self) -> None:
+        """Plumb the serving session vars to the live coordinator's
+        ServingManager (re-applied after auto-recovery rebuilds it)."""
+        self.coord.serving.configure(
+            enabled=bool(self.config["serving_cache"]),
+            max_concurrency=self.config["serving_max_concurrency"],
+            timeout_ms=self.config["serving_query_timeout_ms"])
 
     # ------------------------------------------------------ durable catalog
     def _persist_catalog(self) -> None:
@@ -363,6 +381,11 @@ class Session:
                 # runtime-mutable on the live MemoryManager: enabling a
                 # budget starts LRU tracking on every deployed executor
                 self._apply_memory_config()
+            elif stmt.name in ("serving_max_concurrency",
+                               "serving_query_timeout_ms",
+                               "serving_cache"):
+                # runtime-mutable on the live ServingManager/pool
+                self._apply_serving_config()
             return self.config[stmt.name]
         if isinstance(stmt, ast.Select):
             return self.query_select(stmt)
@@ -549,6 +572,13 @@ class Session:
                      str(r["evicted_bytes"]), str(r["reload_count"]),
                      str(r["spilled_rows"]))
                     for r in self.coord.memory.report()]
+        if what == "serving":
+            # per-MV snapshot-cache state from the serving manager:
+            # (mv, cache epoch, rows, hits, misses, point_lookups)
+            return [(r["mv"], str(r["epoch"]), str(r["rows"]),
+                     str(r["hits"]), str(r["misses"]),
+                     str(r["point_lookups"]))
+                    for r in self.coord.serving.report()]
         if what == "sources":
             return [(n,) for n in sorted(self.catalog.sources)]
         if what in ("tables", "materialized_views"):
@@ -680,6 +710,13 @@ class Session:
                        sources=tuple(sorted(
                            getattr(planner, "used_sources", ()))))
             self.catalog.mvs[stmt.name] = mv
+            # serving registration: the Materialize executor publishes
+            # its effective changelog through the hook; the per-MV
+            # snapshot cache builds lazily on first query touch
+            if len(dep.roots[plan.mv_fragment]) == 1:
+                root.serving_hook = self.coord.serving.register_mv(
+                    stmt.name, root.table, root.table.schema,
+                    root.table.pk_indices)
         # bring the new dataflow up: the first MV gets the Initial
         # barrier; later MVs initialize on the next ordinary barrier.
         # During catalog recovery NO barrier may run until the WHOLE
@@ -834,6 +871,10 @@ class Session:
                 "streaming_chunk_coalesce", 0))
         self.env.session = self
         self._apply_memory_config()
+        # fresh ServingManager with the coordinator: every cache is
+        # invalidated and rebuilds from the recovered epoch on its next
+        # touch (the recovery-consistency contract)
+        self._apply_serving_config()
         self.catalog.mvs.clear()
         self.catalog.sinks.clear()
         log = list(self._ddl_log)
@@ -868,6 +909,7 @@ class Session:
             raise BindError(
                 f"cannot drop {name!r}: {dependents} read it")
         mv = self.catalog.mvs.pop(name)
+        self.coord.serving.unregister_mv(name)
         await mv.deployment.stop()
         for up, ch in mv.upstream_taps:
             up.tap.remove(ch)
@@ -925,11 +967,47 @@ class Session:
         return self.query_select(stmt)
 
     def query_select(self, sel: ast.Select) -> list[tuple]:
-        """Serving path: the batch engine over committed MV snapshots
-        (reference: local batch execution, scheduler/local.rs over
-        batch/src/executor/ — scan/filter/join/agg/sort/limit)."""
-        from .batch import run_batch_select
-        return run_batch_select(self.catalog, sel)
+        """Serving path, synchronous form (REPL / tests on the loop
+        thread): pinned snapshot caches + point-lookup index when the
+        MVs are cached, else the batch engine over committed MV
+        snapshots (reference: local batch execution, scheduler/local.rs
+        over batch/src/executor/ — scan/filter/join/agg/sort/limit)."""
+        return self.query_select_full(sel)[2]
+
+    def query_select_full(self, sel: ast.Select):
+        """-> (names, types, rows), synchronously. A cache miss marks
+        the MV wanted (the next collected barrier builds its cache) and
+        falls back to the full-scan path."""
+        from .batch import run_batch_select_full
+        from ..serving.executor import rel_mv_names, run_pinned_select
+        serving = self.coord.serving
+        names = rel_mv_names(sel.rel)
+        pins = serving.pin(names) if names else None
+        if pins is None:
+            return run_batch_select_full(self.catalog, sel)
+        try:
+            return run_pinned_select(self.catalog, sel, pins, serving)
+        finally:
+            serving.unpin(pins)
+
+    async def run_serving_select(self, sel: ast.Select):
+        """-> (names, types, rows). The concurrent serving path (pgwire
+        and any async caller): snapshots pin ON THE LOOP (atomic wrt
+        barrier-time cache advancement), then the pure-numpy pipeline
+        runs on a ServingPool worker thread under admission control and
+        the per-query timeout — a big scan no longer stalls barrier
+        injection. Uncached queries stay on the loop (the legacy
+        committed-snapshot scan) and mark their MVs wanted."""
+        from .batch import run_batch_select_full
+        from ..serving.executor import rel_mv_names, run_pinned_select
+        serving = self.coord.serving
+        names = rel_mv_names(sel.rel)
+        pins = serving.pin(names) if names else None
+        if pins is None:
+            return run_batch_select_full(self.catalog, sel)
+        return await serving.pool.run(
+            lambda: run_pinned_select(self.catalog, sel, pins, serving),
+            cleanup=lambda: serving.unpin(pins))
 
 
 def _render_batch_plan(sel) -> list:

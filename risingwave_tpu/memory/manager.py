@@ -31,7 +31,7 @@ from typing import Optional
 
 from ..utils.metrics import (
     GLOBAL_METRICS, HBM_BUDGET_BYTES, HBM_EVICTED_BYTES, HBM_EVICTIONS,
-    HBM_RELOADS, HBM_SPILLED_ROWS, HBM_STATE_BYTES,
+    HBM_GUARD_PROTECTED, HBM_RELOADS, HBM_SPILLED_ROWS, HBM_STATE_BYTES,
 )
 from .accounting import format_bytes
 
@@ -39,12 +39,79 @@ POLICY_LRU = "lru"
 POLICY_NONE = "none"
 
 
+class ReloadGuard:
+    """Reload-LFU guard (ROADMAP open item): probe-hot-but-never-dirty
+    keys look cold to the dirty-bitmap LRU — they get evicted, the next
+    probe reloads them, their fresh stamp ages out, and the cycle
+    repeats, thrashing the host spill. The guard tracks read-through
+    reloads per (executor scope, key); a key reloaded >= `threshold`
+    times within the last `window` barriers is EXEMPT from the next
+    eviction round — the executor keeps it device-resident (re-inserts
+    it) instead of spilling.
+
+    `scope` is any hashable the executor chooses (hash_agg uses
+    `id(self)`, hash_join `(id(self), side)`) so key tuples never
+    collide across executors or join sides. `window=0` disables the
+    guard."""
+
+    _MAX_EVENTS_PER_KEY = 4
+
+    def __init__(self, window: int = 8, threshold: int = 2):
+        self.window = int(window)
+        self.threshold = int(threshold)
+        self._seq = 0
+        self._events: dict = {}       # scope -> {key: [barrier seq, ...]}
+        self.protected_total = 0
+
+    def on_barrier(self) -> None:
+        self._seq += 1
+        if self.window > 0 and self._seq % (2 * self.window) == 0:
+            self._prune()
+
+    def note(self, scope, keys) -> None:
+        """Record a read-through reload of `keys` in `scope`."""
+        if self.window <= 0:
+            return
+        d = self._events.setdefault(scope, {})
+        for k in keys:
+            lst = d.setdefault(k, [])
+            lst.append(self._seq)
+            if len(lst) > self._MAX_EVENTS_PER_KEY:
+                del lst[:-self._MAX_EVENTS_PER_KEY]
+
+    def is_protected(self, scope, key) -> bool:
+        if self.window <= 0:
+            return False
+        lst = self._events.get(scope, {}).get(key)
+        if not lst:
+            return False
+        lo = self._seq - self.window
+        return sum(1 for s in lst if s >= lo) >= self.threshold
+
+    def note_protected(self, n: int = 1) -> None:
+        self.protected_total += n
+        HBM_GUARD_PROTECTED.inc(n)
+
+    def _prune(self) -> None:
+        lo = self._seq - self.window
+        for scope in list(self._events):
+            d = self._events[scope]
+            for k in [k for k, lst in d.items() if lst[-1] < lo]:
+                del d[k]
+            if not d:
+                del self._events[scope]
+
+
 class MemoryManager:
-    def __init__(self, budget_bytes: int = 0, policy: str = POLICY_LRU):
+    def __init__(self, budget_bytes: int = 0, policy: str = POLICY_LRU,
+                 guard_window: int = 8, guard_threshold: int = 2):
         self.budget_bytes = int(budget_bytes)
         self.policy = policy
         self._participants: dict[str, object] = {}
         self.evictions = 0
+        # reload-LFU guard shared by every participant (set on them as
+        # `mem_guard` at registration)
+        self.reload_guard = ReloadGuard(guard_window, guard_threshold)
 
     # ---------------------------------------------------------- config
     @property
@@ -83,6 +150,10 @@ class MemoryManager:
             i += 1
             name = f"{base}#{i}"
         self._participants[name] = participant
+        try:
+            participant.mem_guard = self.reload_guard
+        except AttributeError:
+            pass
         if self.enabled:
             enable = getattr(participant, "memory_enable_lru", None)
             if enable is not None:
@@ -109,6 +180,8 @@ class MemoryManager:
                 "evicted_bytes": int(getattr(p, "mem_evicted_bytes", 0)),
                 "reload_count": int(getattr(p, "mem_reload_count", 0)),
                 "spilled_rows": int(getattr(p, "mem_spilled_rows", 0)),
+                "guard_protected": int(
+                    getattr(p, "mem_guard_protected", 0)),
             })
         return rows
 
@@ -122,7 +195,8 @@ class MemoryManager:
                 f"  {r['executor']}: state={format_bytes(r['state_bytes'])} "
                 f"evicted={format_bytes(r['evicted_bytes'])} "
                 f"reloads={r['reload_count']} "
-                f"spilled_rows={r['spilled_rows']}")
+                f"spilled_rows={r['spilled_rows']} "
+                f"guard_protected={r['guard_protected']}")
         return lines
 
     # ------------------------------------------------------ control loop
@@ -135,6 +209,7 @@ class MemoryManager:
         already follow."""
         if not self._participants:
             return
+        self.reload_guard.on_barrier()
         total = 0
         spilled = 0
         for name, p in self._participants.items():

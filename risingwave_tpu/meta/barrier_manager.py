@@ -32,6 +32,7 @@ from typing import Optional
 
 from ..common.epoch import EpochPair, next_epoch, INVALID_EPOCH
 from ..memory.manager import MemoryManager
+from ..serving.manager import ServingManager
 from ..state.store import StateStore
 from ..stream.message import Barrier, BarrierKind, Mutation
 
@@ -99,6 +100,11 @@ class BarrierCoordinator:
         # executor is idle — once a budget is configured (Session plumbs
         # hbm_budget_bytes / memory_eviction_policy through).
         self.memory = MemoryManager()
+        # Serving authority (serving/manager.py): per-MV snapshot caches
+        # advance at every collected barrier — the same between-epochs
+        # moment the memory manager uses — so pinned reads always sit on
+        # a sealed epoch, consistent across every MV of the coordinator.
+        self.serving = ServingManager()
         # ---- async epoch uploader (the checkpoint pipeline) ----
         self._upload_q: asyncio.Queue[_UploadJob] = asyncio.Queue()
         self._uploader_task: Optional[asyncio.Task] = None
@@ -262,6 +268,11 @@ class BarrierCoordinator:
         # in-flight apply; runs synchronously (no awaits) so no actor
         # interleaves mid-eviction
         self.memory.on_barrier(barrier.epoch.curr)
+        # serving caches advance to the sealed epoch in the same
+        # synchronous window (a wanted-but-absent cache pays its one
+        # full build scan here, before incremental maintenance takes
+        # over)
+        self.serving.on_barrier(barrier)
 
     async def run_rounds(self, n: int, interval_s: Optional[float] = None) -> None:
         """Inject n barriers, waiting for each to complete. The very first

@@ -1,0 +1,28 @@
+"""Serving layer — read-optimized query path between the stream engine
+and the batch/pgwire frontends.
+
+Reference: the reference design's serving half (batch RowSeqScan over a
+committed Hummock snapshot, src/batch/src/executor/ + the frontend's
+local execution mode) — here rebuilt around three pieces the TPU build
+needs to serve heavy read traffic without touching the dataflow:
+
+  * SnapshotCache (cache.py): a per-MV columnar numpy snapshot
+    maintained INCREMENTALLY from the Materialize executor's changelog,
+    advanced at each collected barrier and tagged with that epoch.
+  * point-lookup index (cache.py / executor.py): a pk -> row hash index
+    over the cache so `SELECT ... WHERE pk = const` is O(1), never a
+    scan.
+  * concurrent execution (pool.py): queries over pinned snapshots run
+    off the event loop in a bounded thread pool with admission control
+    and per-query timeouts, so a big scan no longer stalls barrier
+    injection.
+"""
+
+from .cache import MvChangelogHook, SnapshotCache, Snapshot
+from .manager import ServingManager
+from .pool import ServingPool, ServingTimeout
+
+__all__ = [
+    "MvChangelogHook", "SnapshotCache", "Snapshot", "ServingManager",
+    "ServingPool", "ServingTimeout",
+]

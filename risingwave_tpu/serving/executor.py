@@ -1,0 +1,137 @@
+"""Serving-side query execution over pinned snapshots.
+
+Two read paths, both reusing the batch engine's operators so results are
+bit-identical to the StorageTable scan path:
+
+  * point lookup: `SELECT ... WHERE pk = const` (all pk columns bound to
+    literals) probes the snapshot's pk index and runs the NORMAL batch
+    pipeline over the zero-or-one matched row — O(result), never a scan;
+    residual predicates, projections, aggregates, ORDER BY and LIMIT all
+    evaluate unchanged on the tiny relation.
+  * cached scan: the snapshot's compacted columns (live rows in
+    store-key order) replace the LSM scan + row decode; everything
+    downstream of the scan is the stock batch pipeline.
+
+This module is pure numpy + host dicts — safe on ServingPool worker
+threads (no jax dispatch off the event loop).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..common.types import DataType, GLOBAL_DICT
+from ..frontend import sql as ast
+from ..frontend.batch import _Rel, run_batch_select_full
+from ..frontend.binder import BindError, Scope, split_conjuncts
+from ..utils.metrics import SERVING_POINT_LOOKUPS
+
+_UNSET = object()
+
+
+def rel_mv_names(rel) -> Optional[list]:
+    """Every MV name a FROM clause reads, or None if any relation is not
+    a plain table reference (those queries take the legacy path)."""
+    if isinstance(rel, ast.TableRel):
+        return [rel.name]
+    if isinstance(rel, ast.JoinRel):
+        left = rel_mv_names(rel.left)
+        right = rel_mv_names(rel.right)
+        if left is None or right is None:
+            return None
+        return left + right
+    return None
+
+
+def run_pinned_select(catalog, sel, pins, serving=None):
+    """Execute a SELECT against pinned snapshots ->
+    (names, types, rows)."""
+    point = _try_point_lookup(sel, pins)
+    if point is not None:
+        SERVING_POINT_LOOKUPS.inc()
+        if serving is not None:
+            serving.note_point_lookup(sel.rel.name)
+
+        def scan(_catalog, _name, _alias):
+            return point
+    else:
+        def scan(_catalog, name, alias):
+            snap = pins[name]
+            cols, valids = snap.compact()
+            return _Rel(list(cols), list(valids),
+                        Scope.of(snap.schema, alias or name))
+    return run_batch_select_full(catalog, sel, scan=scan)
+
+
+def _lit_value(e):
+    if isinstance(e, ast.Lit):
+        return True, e.value
+    if isinstance(e, ast.UnOp) and e.op == "neg" \
+            and isinstance(e.arg, ast.Lit) \
+            and isinstance(e.arg.value, (int, float)):
+        return True, -e.arg.value
+    return False, None
+
+
+def _eq_col_lit(conj, scope: Scope):
+    """`col = literal` (either side) -> (col_index, value), else None."""
+    if not (isinstance(conj, ast.BinOp) and conj.op == "equal"):
+        return None
+    for a, b in ((conj.left, conj.right), (conj.right, conj.left)):
+        if isinstance(a, ast.ColRef):
+            ok, v = _lit_value(b)
+            if ok:
+                try:
+                    idx, _t = scope.resolve(a)
+                except BindError:
+                    return None
+                return idx, v
+    return None
+
+
+def _try_point_lookup(sel, pins) -> Optional[_Rel]:
+    """If the WHERE clause binds EVERY pk column of a single pinned MV to
+    a literal, probe the index and return the <=1-row relation; the full
+    pipeline (including the original WHERE) then runs over it, so extra
+    conjuncts and expressions behave exactly as on the scan path."""
+    rel = sel.rel
+    if not isinstance(rel, ast.TableRel) or rel.name not in pins:
+        return None
+    snap = pins[rel.name]
+    if sel.where is None or not snap.pk_indices:
+        return None
+    scope = Scope.of(snap.schema, rel.alias or rel.name)
+    need = {i: _UNSET for i in snap.pk_indices}
+    for conj in split_conjuncts(sel.where):
+        m = _eq_col_lit(conj, scope)
+        if m is not None and m[0] in need and need[m[0]] is _UNSET:
+            need[m[0]] = m[1]
+    if any(v is _UNSET for v in need.values()):
+        return None
+    pk = []
+    for i in snap.pk_indices:
+        v = need[i]
+        if v is None:
+            # `pk = NULL` is SQL-NULL, never true: empty result
+            return _Rel(*snap.point_rel(None), scope)
+        dt = snap.schema[i].data_type
+        if dt is DataType.VARCHAR:
+            if not isinstance(v, str):
+                return None
+            pk.append(int(GLOBAL_DICT.get_or_insert(v)))
+            continue
+        if isinstance(v, str):
+            return None
+        try:
+            c = np.asarray(v, dtype=dt.np_dtype).item()
+        except (OverflowError, ValueError):
+            return None
+        if c != v:
+            # lossy coercion (e.g. float literal vs int column): the
+            # equality can only be decided by the generic evaluator
+            return None
+        pk.append(c)
+    pos = snap.lookup(tuple(pk))
+    return _Rel(*snap.point_rel(pos), scope)

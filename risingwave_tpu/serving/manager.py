@@ -1,0 +1,180 @@
+"""ServingManager — the per-coordinator serving authority.
+
+Owned by the BarrierCoordinator exactly like the MemoryManager: MVs
+register at CREATE (Session wires the Materialize executor's
+`serving_hook`), and `on_barrier` runs at every collected barrier — the
+one moment the epoch is complete and every actor idle — to advance each
+MV's SnapshotCache to the sealed epoch. Because all caches advance in
+the same synchronous hook, any set of snapshots pinned between barriers
+shares one epoch: multi-MV queries (joins) are consistent by
+construction and never race a commit or compaction.
+
+Cache lifecycle: registration alone costs nothing (the changelog hook
+drops its buffer at each barrier while inactive). The first query that
+misses marks the MV `wanted`; the next collected barrier performs the
+ONE full store scan (epoch-bounded, staged epochs included) and from
+then on the cache advances incrementally. Recovery tears the manager
+down with its coordinator, so caches invalidate and rebuild from the
+recovered epoch automatically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..utils.metrics import (
+    GLOBAL_METRICS, SERVING_CACHE_HITS, SERVING_CACHE_MISSES,
+)
+from .cache import MvChangelogHook, Snapshot, SnapshotCache
+from .pool import ServingPool
+
+
+@dataclass
+class _MvEntry:
+    name: str
+    table: object                  # the MV's StateTable (key layout + scan)
+    schema: object
+    pk_indices: tuple
+    hook: MvChangelogHook
+    cache: Optional[SnapshotCache] = None
+    wanted: bool = False
+    hits: int = 0
+    misses: int = 0
+    point_lookups: int = 0
+
+
+class ServingManager:
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.pool = ServingPool()
+        self._mvs: dict[str, _MvEntry] = {}
+        self.collected_epoch = 0
+
+    def configure(self, enabled: Optional[bool] = None,
+                  max_concurrency: Optional[int] = None,
+                  timeout_ms: Optional[int] = None) -> None:
+        """SET serving_cache / serving_max_concurrency /
+        serving_query_timeout_ms (re-applied after recovery)."""
+        if enabled is not None:
+            self.enabled = bool(enabled)
+        self.pool.configure(max_concurrency=max_concurrency,
+                            timeout_ms=timeout_ms)
+
+    # ------------------------------------------------------ registration
+    def register_mv(self, name: str, table, schema,
+                    pk_indices) -> MvChangelogHook:
+        """Register an MV's serving entry; returns the changelog hook to
+        attach to its Materialize executor. Re-registration (rescale,
+        recovery replay) starts a fresh entry — the cache rebuilds."""
+        hook = MvChangelogHook(name)
+        self._mvs[name] = _MvEntry(name, table, schema, tuple(pk_indices),
+                                   hook)
+        return hook
+
+    def unregister_mv(self, name: str) -> None:
+        if self._mvs.pop(name, None) is not None:
+            GLOBAL_METRICS.gauge("serving_cache_rows", mv=name).set(0.0)
+
+    # ----------------------------------------------------------- barrier
+    def on_barrier(self, barrier) -> None:
+        """Collected-barrier hook: advance every cache to the epoch this
+        barrier sealed; build newly-wanted caches with one epoch-bounded
+        store scan (staged epochs <= the sealed epoch are visible, so the
+        build agrees exactly with the changelog the hook buffers next)."""
+        epoch = barrier.epoch.prev
+        self.collected_epoch = epoch
+        for ent in self._mvs.values():
+            if ent.cache is not None:
+                ent.cache.advance(ent.hook.drain(epoch), epoch)
+            elif ent.wanted:
+                self._build(ent, epoch)
+            if ent.cache is not None:
+                GLOBAL_METRICS.gauge("serving_cache_rows",
+                                     mv=ent.name).set(
+                    float(ent.cache.snapshot.row_count))
+
+    def _build(self, ent: _MvEntry, epoch: int) -> None:
+        from ..state.storage_table import StorageTable
+        storage = StorageTable.for_state_table(ent.table)
+        rows, keys = storage.snapshot_with_keys(max_epoch=epoch)
+        cache = SnapshotCache(ent.name, ent.schema, ent.pk_indices,
+                              storage._layout)
+        cache.build(rows, keys, epoch)
+        ent.cache = cache
+        ent.hook.activate()
+
+    # ----------------------------------------------------------- pinning
+    def pin(self, names) -> Optional[dict]:
+        """Pin one consistent snapshot per MV (all at the same collected
+        epoch) or None if ANY is uncached — all-or-nothing keeps a
+        multi-MV query on a single epoch. A miss marks the MV wanted so
+        the next barrier builds it."""
+        if not self.enabled or not names:
+            return None
+        names = list(dict.fromkeys(names))   # self-joins pin ONCE per MV
+        miss = False
+        for n in names:
+            ent = self._mvs.get(n)
+            if ent is None:
+                return None            # not a cacheable MV at all
+            if ent.cache is None or ent.cache.snapshot is None:
+                ent.wanted = True
+                ent.misses += 1
+                SERVING_CACHE_MISSES.inc()
+                miss = True
+        if miss:
+            return None
+        out: dict[str, Snapshot] = {}
+        for n in names:
+            ent = self._mvs[n]
+            snap = ent.cache.snapshot
+            snap.pins += 1
+            ent.hits += 1
+            SERVING_CACHE_HITS.inc()
+            out[n] = snap
+        return out
+
+    def unpin(self, pins: dict) -> None:
+        for snap in pins.values():
+            snap.pins -= 1
+
+    def note_point_lookup(self, name: str) -> None:
+        ent = self._mvs.get(name)
+        if ent is not None:
+            ent.point_lookups += 1
+
+    # --------------------------------------------------------- reporting
+    def report(self) -> list[dict]:
+        rows = []
+        for name in sorted(self._mvs):
+            ent = self._mvs[name]
+            cache = ent.cache
+            rows.append({
+                "mv": name,
+                "epoch": cache.snapshot.epoch if cache else 0,
+                "rows": cache.snapshot.row_count if cache else 0,
+                "hits": ent.hits,
+                "misses": ent.misses,
+                "point_lookups": ent.point_lookups,
+                "applied_rows": cache.applied_rows if cache else 0,
+                "rebuilds": cache.rebuilds if cache else 0,
+            })
+        return rows
+
+    def render(self) -> list[str]:
+        from ..utils.metrics import SERVING_LATENCY
+        lines = [f"serving: {'on' if self.enabled else 'off'} "
+                 f"epoch={self.collected_epoch} "
+                 f"inflight={self.pool.active} "
+                 f"max_concurrency={self.pool.max_concurrency} "
+                 f"qps={self.pool.qps():.1f} "
+                 f"p50={SERVING_LATENCY.percentile(0.5) * 1e3:.2f}ms "
+                 f"p99={SERVING_LATENCY.percentile(0.99) * 1e3:.2f}ms"]
+        for r in self.report():
+            lines.append(
+                f"  {r['mv']}: epoch={r['epoch']} rows={r['rows']} "
+                f"hits={r['hits']} misses={r['misses']} "
+                f"point_lookups={r['point_lookups']} "
+                f"applied={r['applied_rows']} rebuilds={r['rebuilds']}")
+        return lines

@@ -1,0 +1,105 @@
+"""ServingPool — bounded concurrent execution for serving queries.
+
+Queries over pinned snapshots are pure numpy + host dicts, so they run
+on worker threads (`asyncio.to_thread`) without touching jax — the same
+pure-wait discipline the staged-flush protocol enforces for the
+checkpoint uploader (state/store.py `defer_flush`): only the event loop
+ever dispatches device work. The pool adds:
+
+  * admission control: at most `max_concurrency` queries execute at
+    once (SET serving_max_concurrency); excess callers queue, with the
+    wait accounted in `serving_admission_wait_seconds_total`;
+  * per-query timeouts (SET serving_query_timeout_ms): the awaiting
+    client gets a timeout error immediately; the worker thread cannot
+    be interrupted mid-numpy, so it is ABANDONED — it finishes in the
+    background and only then releases its admission slot and snapshot
+    pins (cleanup runs on the loop via the done callback);
+  * the serving health series: QPS, latency percentiles, inflight.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from ..utils.metrics import (
+    SERVING_ADMISSION_WAIT, SERVING_INFLIGHT, SERVING_LATENCY,
+    SERVING_QUERIES, SERVING_TIMEOUTS,
+)
+
+
+class ServingTimeout(Exception):
+    """Raised to the caller when a query exceeds the serving timeout."""
+
+
+class ServingPool:
+    def __init__(self, max_concurrency: int = 4, timeout_ms: int = 0):
+        self.max_concurrency = max(1, int(max_concurrency))
+        self.timeout_ms = int(timeout_ms)
+        self._active = 0
+        self._slot_free = asyncio.Event()
+        self._slot_free.set()
+        self._done_times: deque = deque(maxlen=2048)
+
+    def configure(self, max_concurrency: Optional[int] = None,
+                  timeout_ms: Optional[int] = None) -> None:
+        if max_concurrency is not None:
+            self.max_concurrency = max(1, int(max_concurrency))
+            self._slot_free.set()      # re-evaluate queued waiters
+        if timeout_ms is not None:
+            self.timeout_ms = int(timeout_ms)
+
+    @property
+    def active(self) -> int:
+        return self._active
+
+    def qps(self, window_s: float = 5.0) -> float:
+        """Completions per second over the trailing window."""
+        now = time.monotonic()
+        n = sum(1 for t in self._done_times if now - t <= window_s)
+        return n / window_s
+
+    async def run(self, fn: Callable, cleanup: Optional[Callable] = None):
+        """Execute `fn()` on a worker thread under admission control.
+        `cleanup` runs on the event loop once the thread ACTUALLY
+        finishes (even if the awaiting client timed out or vanished) —
+        snapshot unpinning rides here so the loop never mutates arrays a
+        live thread is reading."""
+        t0 = time.monotonic()
+        while self._active >= self.max_concurrency:
+            self._slot_free.clear()
+            await self._slot_free.wait()
+        waited = time.monotonic() - t0
+        if waited > 0:
+            SERVING_ADMISSION_WAIT.inc(waited)
+        self._active += 1
+        SERVING_INFLIGHT.set(float(self._active))
+        fut = asyncio.ensure_future(asyncio.to_thread(fn))
+
+        def _done(_f):
+            self._active -= 1
+            SERVING_INFLIGHT.set(float(self._active))
+            self._slot_free.set()
+            self._done_times.append(time.monotonic())
+            SERVING_QUERIES.inc()
+            SERVING_LATENCY.observe(time.monotonic() - t0)
+            if cleanup is not None:
+                cleanup()
+
+        fut.add_done_callback(_done)
+        timeout_s = (self.timeout_ms / 1000.0) if self.timeout_ms else None
+        try:
+            if timeout_s is None:
+                return await asyncio.shield(fut)
+            return await asyncio.wait_for(asyncio.shield(fut), timeout_s)
+        except asyncio.TimeoutError:
+            SERVING_TIMEOUTS.inc()
+            raise ServingTimeout(
+                f"serving query exceeded {self.timeout_ms}ms "
+                f"(SET serving_query_timeout_ms)") from None
+        except asyncio.CancelledError:
+            # the client vanished; the thread finishes in the background
+            # and the done callback releases its slot/pins
+            raise

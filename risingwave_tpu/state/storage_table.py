@@ -108,6 +108,26 @@ class StorageTable:
         for vn in vnodes:
             yield from self.batch_iter_vnode(int(vn))
 
+    def snapshot_with_keys(self, max_epoch: Optional[int] = None
+                           ) -> tuple[list[tuple], list[bytes]]:
+        """(rows, store keys) of the whole table in key order, with
+        staged (shared-buffer) epochs <= `max_epoch` visible on top of
+        the committed base — the serving cache's build scan: at barrier
+        collection this sees EXACTLY the epochs the barrier sealed,
+        whether or not the background uploader has committed them yet,
+        so the cache and the changelog hook agree on where incremental
+        maintenance takes over."""
+        rows: list[tuple] = []
+        keys: list[bytes] = []
+        for vn in range(VNODE_COUNT):
+            start, end = self._layout.vnode_key_range(vn)
+            for k, v in self.store.iter_range(start, end,
+                                              committed_only=False,
+                                              max_epoch=max_epoch):
+                keys.append(k)
+                rows.append(self._serde.decode(v))
+        return rows, keys
+
     def to_numpy(self, vnode_bitmap: Optional[np.ndarray] = None
                  ) -> list[np.ndarray]:
         """Whole committed table as one numpy column set (RowSeqScan's

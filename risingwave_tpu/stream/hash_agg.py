@@ -182,6 +182,9 @@ class HashAggExecutor(Executor):
         self._spill = HostSpill()
         self.mem_evicted_bytes = 0
         self.mem_reload_count = 0
+        # keys the reload-LFU guard kept resident through an eviction
+        # round (memory/manager.py ReloadGuard, set as self.mem_guard)
+        self.mem_guard_protected = 0
         self._lru_stamp = jit_state(self._lru_stamp_impl,
                                     donate_argnums=(1,),
                                     name="hash_agg_lru_stamp")
@@ -560,15 +563,23 @@ class HashAggExecutor(Executor):
         Returns bytes freed (0 for a same-capacity cold purge — the win
         there is distance from the overflow cliff, not bytes)."""
         from ..utils.d2h import fetch_prefix_groups
+        guard = getattr(self, "mem_guard", None)
         cols_dev, n_dev = self._mem_pack(self.state, self._slot_epoch,
                                          jnp.int64(thresh))
         n = int(np.asarray(n_dev))
+        protected: list = []
         if n:
             host = fetch_prefix_groups([(list(cols_dev), n)])[0]
             nk = len(self.group_key_indices)
             for r in range(n):
                 row = tuple(c[r].item() for c in host)
-                self._spill.set(row[:nk], row)
+                if guard is not None \
+                        and guard.is_protected(id(self), row[:nk]):
+                    # reload-LFU guard: reloaded >= 2x within the window
+                    # -> exempt from this round, re-insert below
+                    protected.append(row)
+                else:
+                    self._spill.set(row[:nk], row)
         before = self.state_bytes()
         self.state = self._mem_rehash(self.state, self._slot_epoch,
                                       jnp.int64(thresh),
@@ -576,6 +587,10 @@ class HashAggExecutor(Executor):
         self.capacity = new_cap
         self._slot_epoch = jnp.full(new_cap, epoch, dtype=jnp.int64)
         self._occ_known = max(0, survivors)
+        if protected:
+            self._mem_reload_rows(protected)
+            self.mem_guard_protected += len(protected)
+            guard.note_protected(len(protected))
         freed = max(0, before - self.state_bytes())
         self.mem_evicted_bytes += freed
         return freed
@@ -664,6 +679,9 @@ class HashAggExecutor(Executor):
                     touched.append(k)
         if not touched:
             return
+        guard = getattr(self, "mem_guard", None)
+        if guard is not None:
+            guard.note(id(self), touched)
         rows = [row for k in touched for row in self._spill.pop(k)]
         self._mem_reload_rows(rows)
         self.mem_reload_count += len(touched)

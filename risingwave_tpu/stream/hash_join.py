@@ -263,6 +263,9 @@ class HashJoinExecutor(Executor):
         self._spill = [HostSpill(), HostSpill()]
         self.mem_evicted_bytes = 0
         self.mem_reload_count = 0
+        # keys the reload-LFU guard kept resident through an eviction
+        # round (memory/manager.py ReloadGuard, set as self.mem_guard)
+        self.mem_guard_protected = 0
         self._lru_stamp = jit_state(self._lru_stamp_impl,
                                     donate_argnums=(1,),
                                     name="hash_join_lru_stamp")
@@ -651,18 +654,26 @@ class HashJoinExecutor(Executor):
         """Pack + spill side `s` rows stamped <= thresh, tombstone them,
         rehash the row store at new_cr. Returns bytes freed."""
         from ..utils.d2h import fetch_prefix_groups
+        guard = getattr(self, "mem_guard", None)
         t_dev = jnp.int64(thresh)
         cols_dev, n_dev = self._mem_pack(self.sides[s],
                                          self._slot_epoch[s], t_dev)
         n = int(np.asarray(n_dev))
         nc = len(self._col_dtypes[s])
+        protected: list = []
         if n:
             host = fetch_prefix_groups([(list(cols_dev), n)])[0]
             for r in range(n):
                 vals = tuple(host[c][r].item() for c in range(nc))
                 valids = tuple(bool(host[nc + c][r]) for c in range(nc))
                 key = tuple(vals[i] for i in self.key_indices[s])
-                self._spill[s].add(key, (vals, valids))
+                if guard is not None \
+                        and guard.is_protected((id(self), s), key):
+                    # reload-LFU guard: probe-hot key — keep it
+                    # device-resident, re-insert after the rehash
+                    protected.append((vals, valids))
+                else:
+                    self._spill[s].add(key, (vals, valids))
         before = pytree_bytes(self.sides[s])
         self.sides[s] = self._mem_evict_apply(
             self.sides[s], self._slot_epoch[s], t_dev)
@@ -674,6 +685,10 @@ class HashJoinExecutor(Executor):
         self.rebuilds += 1
         occ2, _, top2 = self._stats(self.sides[s])
         self._occ_known[s], self._top_known[s] = int(occ2), int(top2)
+        if protected:
+            self._mem_reload_rows(s, protected)
+            self.mem_guard_protected += len(protected)
+            guard.note_protected(len(protected))
         freed = max(0, before - pytree_bytes(self.sides[s]))
         self.mem_evicted_bytes += freed
         return freed
@@ -770,9 +785,12 @@ class HashJoinExecutor(Executor):
                 if k not in seen:
                     seen.add(k)
                     keys.append(k)
+        guard = getattr(self, "mem_guard", None)
         for t in (side, 1 - side):
             touched = self._spill[t].take_touched(keys)
             if touched:
+                if guard is not None:
+                    guard.note((id(self), t), list(touched))
                 self._mem_reload_rows(
                     t, [rw for rows in touched.values() for rw in rows])
                 self.mem_reload_count += len(touched)

@@ -4,6 +4,13 @@ Reference: src/stream/src/executor/mview/materialize.rs (:52,65,141-183):
 applies the changelog to the MV table with a ConflictBehavior, commits at
 barriers. The MV table *is* the queryable result (batch side reads it at a
 committed snapshot).
+
+Serving hook: when the session registers the MV with the serving layer
+(serving/manager.py), `serving_hook` carries the EFFECTIVE changelog —
+the post-conflict-resolution upserts/deletes actually applied to the
+table — so the per-MV SnapshotCache replays exactly what the storage
+sees, and stamps each interval's rows with the sealed epoch at the
+barrier.
 """
 
 from __future__ import annotations
@@ -34,6 +41,9 @@ class MaterializeExecutor(Executor):
         self.table = table
         self.conflict = conflict
         self.identity = f"Materialize(table={table.table_id})"
+        # serving changelog tap (serving/cache.py MvChangelogHook); set by
+        # the session when the MV registers with the serving layer
+        self.serving_hook = None
 
     async def execute(self):
         first = True
@@ -50,15 +60,28 @@ class MaterializeExecutor(Executor):
                     self.table.init_epoch(msg.epoch.curr)
                 else:
                     self.table.commit(msg.epoch.curr)
+                if self.serving_hook is not None:
+                    # the interval just committed belongs to the epoch
+                    # this barrier seals
+                    self.serving_hook.on_barrier(msg.epoch.prev)
                 yield msg
             else:
                 yield msg
 
     def _apply(self, chunk: StreamChunk) -> None:
+        from ..serving.cache import OP_DEL, OP_PUT
         rows = chunk.to_rows()
+        hook = self.serving_hook
         if self.conflict is ConflictBehavior.NO_CHECK:
             self.table.write_chunk_rows(rows)
+            if hook is not None:
+                # NO_CHECK inserts land last-write-wins in the mem-table,
+                # i.e. upserts at the storage level — mirror that exactly
+                hook.on_rows([
+                    (OP_PUT if op in (OP_INSERT, OP_UPDATE_INSERT)
+                     else OP_DEL, row) for op, row in rows])
             return
+        eff = []
         for op, row in rows:
             if op in (OP_INSERT, OP_UPDATE_INSERT):
                 pk = tuple(row[i] for i in self.table.pk_indices)
@@ -70,5 +93,9 @@ class MaterializeExecutor(Executor):
                     self.table.update(existing, row)
                 else:
                     self.table.insert(row)
+                eff.append((OP_PUT, row))
             else:
                 self.table.delete(row)
+                eff.append((OP_DEL, row))
+        if hook is not None and eff:
+            hook.on_rows(eff)

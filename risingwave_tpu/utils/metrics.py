@@ -236,3 +236,26 @@ HBM_EVICTED_BYTES = GLOBAL_METRICS.counter("hbm_evicted_bytes_total")
 HBM_EVICTIONS = GLOBAL_METRICS.counter("hbm_evictions_total")
 HBM_RELOADS = GLOBAL_METRICS.counter("hbm_reloads_total")
 HBM_SPILLED_ROWS = GLOBAL_METRICS.gauge("hbm_spilled_rows")
+# keys the reload-LFU guard kept device-resident through an eviction
+# round (memory/manager.py ReloadGuard: reloaded >= 2x within the
+# barrier window -> exempt from the next eviction)
+HBM_GUARD_PROTECTED = GLOBAL_METRICS.counter("hbm_guard_protected_total")
+
+# Serving layer (serving/): the read path's health series. Queries are
+# host-side numpy over pinned snapshots, so latency buckets reach well
+# below the default 1ms floor — point lookups are tens of microseconds.
+SERVING_LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                           0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                           1.0, 2.5, 5.0)
+SERVING_QUERIES = GLOBAL_METRICS.counter("serving_queries_total")
+SERVING_LATENCY = GLOBAL_METRICS.histogram(
+    "serving_latency_seconds", buckets=SERVING_LATENCY_BUCKETS)
+SERVING_CACHE_HITS = GLOBAL_METRICS.counter("serving_cache_hits_total")
+SERVING_CACHE_MISSES = GLOBAL_METRICS.counter(
+    "serving_cache_misses_total")
+SERVING_POINT_LOOKUPS = GLOBAL_METRICS.counter(
+    "serving_point_lookups_total")
+SERVING_INFLIGHT = GLOBAL_METRICS.gauge("serving_inflight_queries")
+SERVING_ADMISSION_WAIT = GLOBAL_METRICS.counter(
+    "serving_admission_wait_seconds_total")
+SERVING_TIMEOUTS = GLOBAL_METRICS.counter("serving_timeouts_total")

@@ -1,0 +1,193 @@
+"""Serving-layer gate — mixed workload, no TPU needed.
+
+One in-memory session runs streaming ingest (CREATE TABLE + INSERT per
+barrier round) under an agg MV, then measures the serving read path
+three ways:
+
+  scan        SET serving_cache = 0 — every point SELECT re-scans and
+              re-decodes the whole MV from the LSM (the pre-serving
+              behavior); its p50 is the O(table) reference point
+  cached      SET serving_cache = 1 — the same point SELECTs hit the
+              snapshot cache's pk index (O(result));
+  concurrent  barrier rounds with identical ingest run idle, then again
+              under continuous concurrent SELECT load through the
+              serving pool; barrier p50 must not degrade materially
+
+Exit status is 0 iff:
+  * cached/indexed results are IDENTICAL to the scan path (point
+    lookups AND order/limit scan queries),
+  * cached point-lookup p50 is >= 10x below the full-scan p50,
+  * barrier p50 under concurrent SELECT load stays within 1.5x of the
+    idle-serving baseline (concurrent queries must not stall barrier
+    injection).
+
+    JAX_PLATFORMS=cpu python scripts/serving_profile.py
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_KEYS = 10_000            # distinct pk values in the MV
+ROWS_PER_KEY = 2
+INGEST_BATCHES = 10        # initial load, one INSERT+tick per batch
+POINT_QUERIES = 40
+BARRIER_ROUNDS = 12        # per idle/loaded phase
+ROWS_PER_ROUND = 800       # streaming ingest during the barrier phases
+LOAD_WORKERS = 4
+
+
+def _p50(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2] if xs else 0.0
+
+
+async def main() -> int:
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.frontend import sql as ast
+
+    s = Session()
+    await s.execute("CREATE TABLE items (k int64, v int64)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW magg AS SELECT k, count(*) AS n, "
+        "sum(v) AS sv FROM items GROUP BY k")
+
+    total = N_KEYS * ROWS_PER_KEY
+    per_batch = total // INGEST_BATCHES
+    row = 0
+    for _ in range(INGEST_BATCHES):
+        vals = ", ".join(
+            f"({(row + i) % N_KEYS}, {(row + i) * 7 % 1000})"
+            for i in range(per_batch))
+        await s.execute(f"INSERT INTO items VALUES {vals}")
+        row += per_batch
+        await s.tick(1)
+
+    async def drain_ingest(expected):
+        """The jsonl table source tails its file a bounded number of
+        rows per barrier; tick until everything inserted is
+        materialized, so the equivalence phases compare STABLE data."""
+        from risingwave_tpu.frontend.batch import run_batch_select_full
+        for _ in range(400):
+            n = run_batch_select_full(
+                s.catalog,
+                ast.parse("SELECT count(*) AS c FROM items"))[2][0][0]
+            if n >= expected:
+                return
+            await s.tick(1)
+        raise RuntimeError(f"ingest never drained ({n} < {expected})")
+
+    await drain_ingest(total)
+
+    point_sqls = [f"SELECT k, n, sv FROM magg WHERE k = {(i * 97) % N_KEYS}"
+                  for i in range(POINT_QUERIES)]
+    scan_sqls = [
+        "SELECT k, n, sv FROM magg ORDER BY sv DESC, k LIMIT 10",
+        "SELECT k, n FROM magg WHERE n > 1 ORDER BY k LIMIT 20 OFFSET 5",
+        "SELECT count(*) AS groups, sum(n) AS rows FROM magg",
+    ]
+
+    async def run_queries(sqls):
+        out, lats = [], []
+        for q in sqls:
+            sel = ast.parse(q)
+            t0 = time.monotonic()
+            rows = (await s.run_serving_select(sel))[2]
+            lats.append(time.monotonic() - t0)
+            out.append(rows)
+        return out, lats
+
+    # ---- scan baseline (cache off) --------------------------------------
+    await s.execute("SET serving_cache = 0")
+    await run_queries(point_sqls[:4])                 # warmup
+    scan_point, scan_lats = await run_queries(point_sqls)
+    scan_scan_rows, _ = await run_queries(scan_sqls)
+
+    # ---- cached (cache on) ----------------------------------------------
+    await s.execute("SET serving_cache = 1")
+    s.query(point_sqls[0])                            # first touch -> wanted
+    await s.tick(1)                                   # cache builds here
+    await run_queries(point_sqls[:4])                 # warmup
+    cached_point, cached_lats = await run_queries(point_sqls)
+    cached_scan_rows, _ = await run_queries(scan_sqls)
+
+    from risingwave_tpu.utils.metrics import SERVING_POINT_LOOKUPS
+    point_lookups = SERVING_POINT_LOOKUPS.value
+
+    scan_p50 = _p50(scan_lats)
+    cached_p50 = _p50(cached_lats)
+    speedup = scan_p50 / cached_p50 if cached_p50 else float("inf")
+    identical = (scan_point == cached_point
+                 and scan_scan_rows == cached_scan_rows)
+
+    # ---- barrier latency: idle vs under concurrent SELECT load ----------
+    async def ingest_rounds(n):
+        nonlocal row
+        for _ in range(n):
+            vals = ", ".join(
+                f"({(row + i) % N_KEYS}, {(row + i) * 7 % 1000})"
+                for i in range(ROWS_PER_ROUND))
+            await s.execute(f"INSERT INTO items VALUES {vals}")
+            row += ROWS_PER_ROUND
+            await s.tick(1)
+
+    mark = len(s.coord.latencies_ns)
+    await ingest_rounds(BARRIER_ROUNDS)
+    idle_lat = [x / 1e9 for x in s.coord.latencies_ns[mark:]]
+
+    stop = asyncio.Event()
+
+    async def load_worker(i):
+        sels = [ast.parse(point_sqls[(i * 5 + j) % len(point_sqls)])
+                for j in range(5)] + [ast.parse(scan_sqls[i % 2])]
+        served = 0
+        while not stop.is_set():
+            for sel in sels:
+                await s.run_serving_select(sel)
+                served += 1
+            await asyncio.sleep(0)
+        return served
+
+    workers = [asyncio.create_task(load_worker(i))
+               for i in range(LOAD_WORKERS)]
+    await asyncio.sleep(0.05)                 # load is flowing
+    mark = len(s.coord.latencies_ns)
+    await ingest_rounds(BARRIER_ROUNDS)
+    loaded_lat = [x / 1e9 for x in s.coord.latencies_ns[mark:]]
+    stop.set()
+    served = sum(await asyncio.gather(*workers))
+
+    idle_p50 = _p50(idle_lat)
+    loaded_p50 = _p50(loaded_lat)
+    barrier_ratio = loaded_p50 / idle_p50 if idle_p50 else float("inf")
+
+    verdict = {
+        "mv_rows": N_KEYS,
+        "scan_point_p50_ms": round(scan_p50 * 1e3, 3),
+        "cached_point_p50_ms": round(cached_p50 * 1e3, 3),
+        "point_speedup": round(speedup, 1),
+        "point_lookups_indexed": point_lookups,
+        "results_identical": identical,
+        "idle_barrier_p50_ms": round(idle_p50 * 1e3, 3),
+        "loaded_barrier_p50_ms": round(loaded_p50 * 1e3, 3),
+        "barrier_ratio": round(barrier_ratio, 2),
+        "concurrent_queries_served": served,
+        "serving_report": s.coord.serving.report(),
+    }
+    print(json.dumps({"verdict": verdict}, default=str))
+    ok = (identical
+          and speedup >= 10.0
+          and point_lookups >= POINT_QUERIES
+          and barrier_ratio <= 1.5
+          and served > 0)
+    await s.drop_all()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))
