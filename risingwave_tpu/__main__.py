@@ -6,12 +6,17 @@ all-in-one single-process deployment with an interactive SQL shell.
 
 DDL and queries run immediately; materialized views advance continuously
 on the barrier interval in the background. With --data, state lives in a
-durable Hummock store under DIR and survives restarts. Meta commands:
+durable Hummock store under DIR and survives restarts. With
+--monitor-port, an HTTP observability endpoint serves /metrics (full
+Prometheus exposition — point a real Prometheus at it), /healthz,
+/debug/traces and /debug/await_tree (also `SET monitor_port = N` at
+runtime). Meta commands:
     \\tick [n]    advance n barrier rounds now
     \\mvs         list materialized views
     \\metrics     dump the metrics registry (+ per-MV HBM accounting)
     \\metrics prom   full Prometheus text exposition (# TYPE metadata)
-    \\trace       recent per-epoch barrier spans
+    \\trace       recent per-epoch barrier spans (with per-actor
+                 apply/persist/align phase splits at metric_level>=info)
     \\stacks      await-tree dump of every live task
     \\q           quit
 """
@@ -58,6 +63,10 @@ async def repl(args) -> None:
                 return
 
     tick_task = asyncio.create_task(ticker())
+    if args.monitor_port:
+        mon = await session.start_monitor(args.monitor_port)
+        print(f"monitor endpoint on http://127.0.0.1:{mon.port} "
+              f"(/metrics /healthz /debug/traces /debug/await_tree)")
     pg = None
     if args.pgwire:
         from .frontend.pgwire import PgServer
@@ -143,6 +152,11 @@ def main() -> None:
     p.add_argument("--pgwire", type=int, default=None, metavar="PORT",
                    help="serve the PostgreSQL wire protocol on PORT "
                         "(reference default: 4566)")
+    p.add_argument("--monitor-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve the HTTP observability endpoint on PORT "
+                        "(/metrics Prometheus exposition, /healthz, "
+                        "/debug/traces, /debug/await_tree)")
     asyncio.run(repl(p.parse_args()))
 
 

@@ -64,6 +64,17 @@ class StreamingConfig:
     # changelog (queries pin an epoch); 0 = every SELECT re-scans the
     # committed LSM snapshot (the pre-serving behavior)
     serving_cache: int = 1
+    # observability (stream/monitor.py): 'off' = no per-actor
+    # instrumentation, 'info' = trace phase splits only (default),
+    # 'debug' = full per-actor/per-channel labelled series (the
+    # reference MetricLevel knob)
+    metric_level: str = "info"
+    # monitor HTTP endpoint (meta/monitor_service.py): /metrics,
+    # /healthz, /debug/traces, /debug/await_tree; 0 = disabled
+    monitor_port: int = 0
+    # stuck-barrier watchdog: an in-flight epoch older than this logs
+    # one diagnosis and bumps barrier_stalls_total; 0 disables
+    barrier_stall_threshold_ms: int = 60000
 
 
 @dataclass
@@ -125,7 +136,9 @@ class SystemParams:
     MUTABLE = {"barrier_interval_ms", "checkpoint_frequency",
                "checkpoint_max_inflight", "hbm_budget_bytes",
                "memory_eviction_policy", "serving_max_concurrency",
-               "serving_query_timeout_ms", "serving_cache"}
+               "serving_query_timeout_ms", "serving_cache",
+               "metric_level", "monitor_port",
+               "barrier_stall_threshold_ms"}
 
     def __init__(self, config: Optional[RwConfig] = None):
         cfg = config or RwConfig()
@@ -142,6 +155,10 @@ class SystemParams:
             "serving_query_timeout_ms":
                 cfg.streaming.serving_query_timeout_ms,
             "serving_cache": cfg.streaming.serving_cache,
+            "metric_level": cfg.streaming.metric_level,
+            "monitor_port": cfg.streaming.monitor_port,
+            "barrier_stall_threshold_ms":
+                cfg.streaming.barrier_stall_threshold_ms,
         }
         self._observers = []
 

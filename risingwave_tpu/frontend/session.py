@@ -90,6 +90,13 @@ class Catalog:
 CATALOG_PATH = "CATALOG"
 
 
+def _parse_metric_level(v) -> str:
+    """SET metric_level validator: canonical lowercase name, rejects
+    unknown levels at SET time (not at the next barrier)."""
+    from ..stream.monitor import MetricLevel
+    return MetricLevel.parse(v).name.lower()
+
+
 class Session:
     """One coordinator drives EVERY dataflow of the session (the reference
     has one GlobalBarrierManager for all streaming jobs): MV-on-MV needs
@@ -153,6 +160,19 @@ class Session:
         # changelog (epoch-pinned reads, pk point-lookup index); 0 =
         # every SELECT re-scans the committed LSM snapshot
         "serving_cache": (1, int),
+        # observability plane (stream/monitor.py): off = no per-actor
+        # instrumentation at all; info (default) = epoch-trace phase
+        # splits only; debug = full per-actor/per-channel labelled
+        # series (stream_actor_row_count{actor,executor}, queue depth,
+        # blocked-put seconds, hash occupancy, ...)
+        "metric_level": ("info", lambda v: _parse_metric_level(v)),
+        # monitor HTTP endpoint (meta/monitor_service.py): /metrics,
+        # /healthz, /debug/traces, /debug/await_tree. 0 = off (default)
+        "monitor_port": (0, int),
+        # stuck-barrier watchdog threshold: an in-flight epoch older
+        # than this logs format_stuck_barrier_report once and bumps
+        # barrier_stalls_total; 0 disables the watchdog
+        "barrier_stall_threshold_ms": (60000, int),
     }
 
     def __init__(self, store=None):
@@ -183,8 +203,11 @@ class Session:
         if blob:
             self._ddl_log = list(json.loads(blob)["ddl"])
         self.recoveries = 0
+        # monitor HTTP endpoint (SET monitor_port / start_monitor)
+        self.monitor = None
         self._apply_memory_config()
         self._apply_serving_config()
+        self._apply_obs_config()
 
     def _apply_memory_config(self) -> None:
         """Plumb the memory session vars to the live coordinator's
@@ -200,6 +223,29 @@ class Session:
             enabled=bool(self.config["serving_cache"]),
             max_concurrency=self.config["serving_max_concurrency"],
             timeout_ms=self.config["serving_query_timeout_ms"])
+
+    def _apply_obs_config(self) -> None:
+        """Plumb the observability session vars to the live coordinator:
+        metric level re-instruments deployed actors in place, the stall
+        threshold feeds the stuck-barrier watchdog (re-applied after
+        auto-recovery rebuilds the coordinator)."""
+        self.coord.stats.configure(self.config["metric_level"])
+        thr = self.config["barrier_stall_threshold_ms"]
+        self.coord.stall_threshold_ms = float(thr) if thr > 0 else None
+
+    async def start_monitor(self, port: int = 0):
+        """Start (or move) the monitor HTTP endpoint; port 0 binds an
+        ephemeral port (the chosen one lands in `self.monitor.port`)."""
+        from ..meta.monitor_service import MonitorService
+        if self.monitor is not None:
+            await self.monitor.stop()
+        self.monitor = await MonitorService(self, port=port).start()
+        return self.monitor
+
+    async def stop_monitor(self) -> None:
+        if self.monitor is not None:
+            await self.monitor.stop()
+            self.monitor = None
 
     # ------------------------------------------------------ durable catalog
     def _persist_catalog(self) -> None:
@@ -386,6 +432,18 @@ class Session:
                                "serving_cache"):
                 # runtime-mutable on the live ServingManager/pool
                 self._apply_serving_config()
+            elif stmt.name in ("metric_level",
+                               "barrier_stall_threshold_ms"):
+                # runtime-mutable: re-instruments live actors / adjusts
+                # the stuck-barrier watchdog
+                self._apply_obs_config()
+            elif stmt.name == "monitor_port":
+                # 0 stops the endpoint; a port starts/moves it
+                port = self.config[stmt.name]
+                if port > 0:
+                    await self.start_monitor(port)
+                else:
+                    await self.stop_monitor()
             return self.config[stmt.name]
         if isinstance(stmt, ast.Select):
             return self.query_select(stmt)
@@ -875,6 +933,10 @@ class Session:
         # invalidated and rebuilds from the recovered epoch on its next
         # touch (the recovery-consistency contract)
         self._apply_serving_config()
+        # fresh StreamingStats/watchdog ride the new coordinator; the
+        # monitor endpoint (if any) reads `self.coord` live, so it keeps
+        # serving across the swap
+        self._apply_obs_config()
         self.catalog.mvs.clear()
         self.catalog.sinks.clear()
         log = list(self._ddl_log)
@@ -948,6 +1010,7 @@ class Session:
         durable catalog and state stay for the next incarnation (the
         playground's exit path under --data; drop_all would erase the
         DDL log)."""
+        await self.stop_monitor()
         for name in reversed(list(self.catalog.sinks)):
             sink = self.catalog.sinks.pop(name)
             await sink.deployment.stop()

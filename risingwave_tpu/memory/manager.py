@@ -163,7 +163,9 @@ class MemoryManager:
     def unregister(self, name: str) -> None:
         p = self._participants.pop(name, None)
         if p is not None:
-            GLOBAL_METRICS.gauge("hbm_state_bytes", executor=name).set(0.0)
+            # drop the labelled series entirely — a dead executor must
+            # not linger in every future scrape
+            GLOBAL_METRICS.remove("hbm_state_bytes", executor=name)
 
     # --------------------------------------------------------- reporting
     def total_bytes(self) -> int:

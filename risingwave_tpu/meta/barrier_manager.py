@@ -91,9 +91,21 @@ class BarrierCoordinator:
         # TracingContext + grafana trace panel analogue)
         from ..utils.trace import EpochTracer
         self.tracer = EpochTracer()
-        # print ONE stuck-barrier diagnosis (spans + await tree) when a
-        # collection exceeds this many seconds; None disables
-        self.stuck_report_s: float | None = 60.0
+        # stuck-barrier watchdog (the MonitorService/risectl-trace
+        # analogue): a background task fires once per stalled epoch when
+        # an in-flight barrier exceeds this threshold — logs the full
+        # format_stuck_barrier_report and bumps barrier_stalls_total.
+        # None/0 disables. SET barrier_stall_threshold_ms plumbs here.
+        self.stall_threshold_ms: float | None = 60_000.0
+        self._watchdog_task: Optional[asyncio.Task] = None
+        self._stalls_reported: set[int] = set()
+        from ..utils.metrics import BARRIER_STALLS
+        self._m_stalls = BARRIER_STALLS
+        # actor-level streaming metrics registrar (stream/monitor.py):
+        # build_graph registers every actor chain, SET metric_level
+        # re-instruments live actors through Session._apply_obs_config
+        from ..stream.monitor import StreamingStats
+        self.stats = StreamingStats()
         # HBM budget authority (memory/manager.py): executors register at
         # build time, accounting gauges refresh at every collected
         # barrier, and eviction runs here — between epochs, when every
@@ -162,6 +174,13 @@ class BarrierCoordinator:
         if not st.remaining:
             st.done.set()
 
+    def collect_phases(self, actor_id: int, barrier: Barrier,
+                       phases: dict) -> None:
+        """Actors report their interval phase split (apply / persist /
+        align ns, stream/actor.py) just before collecting — it lands on
+        the open epoch span so `\\trace` shows who did what."""
+        self.tracer.collect_phases(barrier.epoch.curr, actor_id, phases)
+
     def actor_failed(self, actor_id: int, exc: BaseException) -> None:
         """Failure detection (reference: barrier-collection failure on meta
         triggers global recovery, barrier/recovery.rs:332): a dead actor
@@ -170,6 +189,10 @@ class BarrierCoordinator:
         self._failure = (actor_id, exc)
         for st in self._epochs.values():
             st.done.set()
+        # the failure path has its own diagnosis; a stall report on a
+        # dead coordinator would be noise (and the task would otherwise
+        # poll the never-deleted failed epoch forever)
+        self._stop_watchdog()
 
     # ------------------------------------------------------------ injection
     async def inject_barrier(self, mutation: Optional[Mutation] = None,
@@ -197,33 +220,61 @@ class BarrierCoordinator:
         self._epochs[curr] = EpochState(barrier, set(self.actor_ids))
         self._prev_epoch = curr
         self.tracer.begin(curr)
+        self._ensure_watchdog()
         for q in self.source_queues:
             await q.put(barrier)
         return barrier
 
+    # --------------------------------------------------- stuck-barrier watchdog
+    def _ensure_watchdog(self) -> None:
+        """Spawn the watchdog while epochs are in flight (it exits when
+        the coordinator drains, so an idle session holds no timer)."""
+        if not self.stall_threshold_ms:
+            return
+        if self._watchdog_task is None or self._watchdog_task.done():
+            self._watchdog_task = asyncio.get_running_loop().create_task(
+                self._watchdog(), name="barrier-watchdog")
+
+    async def _watchdog(self) -> None:
+        """Fire ONCE per stalled epoch: when an in-flight barrier's age
+        exceeds `stall_threshold_ms`, log the full diagnosis (partial
+        span: who already collected; await tree: where the rest are
+        parked) and bump `barrier_stalls_total`. The reference gets this
+        from risectl's await-tree dump via the MonitorService; here it is
+        automatic."""
+        from ..utils.trace import format_stuck_barrier_report
+        while True:
+            if not self._epochs:
+                return        # respawned by the next inject
+            thr = self.stall_threshold_ms
+            if thr:
+                now = time.monotonic_ns()
+                for epoch, st in list(self._epochs.items()):
+                    tr = self.tracer._open.get(epoch)
+                    if tr is None or epoch in self._stalls_reported:
+                        continue
+                    age_ms = (now - tr.inject_ns) / 1e6
+                    if age_ms >= thr:
+                        self._stalls_reported.add(epoch)
+                        self._m_stalls.inc()
+                        print(
+                            f"[stuck barrier] epoch {epoch} in flight "
+                            f"{age_ms:.0f}ms (threshold {thr:.0f}ms); "
+                            f"remaining actors {sorted(st.remaining)}\n"
+                            + format_stuck_barrier_report(self),
+                            flush=True)
+            poll_s = max(0.02, min(1.0, (thr or 1000.0) / 1e3 / 8))
+            await asyncio.sleep(poll_s)
+
+    def _stop_watchdog(self) -> None:
+        t = self._watchdog_task
+        self._watchdog_task = None
+        if t is not None and not t.done():
+            t.cancel()
+
     async def wait_collected(self, barrier: Barrier) -> None:
         st = self._epochs[barrier.epoch.curr]
-        if self.stuck_report_s is None:
-            await st.done.wait()
-        else:
-            # one wait task serves both phases: no shield/wait_for
-            # (which would orphan a pending task on timeout or ^C)
-            waiter = asyncio.ensure_future(st.done.wait())
-            try:
-                done, _ = await asyncio.wait(
-                    {waiter}, timeout=self.stuck_report_s)
-                if not done:
-                    # stuck-barrier diagnosis ONCE (reference: risectl
-                    # await-tree dump for hung barriers), keep waiting
-                    from ..utils.trace import format_stuck_barrier_report
-                    print(f"[stuck barrier] epoch {barrier.epoch.curr} "
-                          f"not collected after {self.stuck_report_s}s; "
-                          f"remaining actors {sorted(st.remaining)}\n"
-                          + format_stuck_barrier_report(self), flush=True)
-                await waiter
-            finally:
-                if not waiter.done():
-                    waiter.cancel()
+        await st.done.wait()
         if self._failure is not None:
             # close the span before raising — the FAILED epoch's trace
             # is exactly what a post-mortem \trace wants to show
@@ -263,6 +314,9 @@ class BarrierCoordinator:
         self.latencies_ns.append(lat_ns)
         self._metrics_latency.observe(lat_ns / 1e9)
         del self._epochs[barrier.epoch.curr]
+        self._stalls_reported.discard(barrier.epoch.curr)
+        if not self._epochs:
+            self._stop_watchdog()
         # budget check at barrier collection: the epoch is complete and
         # every executor idle, so eviction device work cannot race an
         # in-flight apply; runs synchronously (no awaits) so no actor
@@ -398,6 +452,7 @@ class BarrierCoordinator:
         leave an orphan SST no manifest references; the commit point
         (manifest swap) never runs for aborted epochs, so the caller's
         `reset_uncommitted` + replay from `committed_epoch` stays exact."""
+        self._stop_watchdog()
         t = self._uploader_task
         self._uploader_task = None
         if t is not None and not t.done():

@@ -1,0 +1,117 @@
+"""MonitorService — the dependency-free HTTP observability endpoint.
+
+Reference: the compute node's MonitorService (src/compute/src/rpc/
+service/monitor_service.rs serves await-tree stack traces + profiling
+over gRPC) and the Prometheus exporter every node embeds. Collapsed to
+one tiny asyncio HTTP/1.0 listener (stdlib only — no aiohttp, no
+prometheus_client) so a REAL Prometheus can scrape a running session
+and an operator can curl the stuck-barrier evidence:
+
+    /metrics          full text exposition (render_prometheus)
+    /healthz          JSON liveness: committed epoch, barrier p50,
+                      in-flight epochs, actor count
+    /debug/traces     recent + in-flight epoch spans (the \\trace verb)
+    /debug/await_tree every task's await stack (the \\stacks verb)
+
+Off by default; `SET monitor_port = <port>` starts it (0 stops it).
+Handlers run on the event loop and only READ host state — a scrape can
+never dispatch device work or block a barrier.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+
+class MonitorService:
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 0):
+        self._session = session          # live handle: coord may be
+        self._host = host                # swapped by auto-recovery
+        self._requested_port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.port: Optional[int] = None
+
+    async def start(self) -> "MonitorService":
+        self._server = await asyncio.start_server(
+            self._handle, self._host, self._requested_port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+            self.port = None
+
+    # ------------------------------------------------------------ routing
+    def _route(self, path: str) -> tuple[int, str, str]:
+        """-> (status, content_type, body). Pure host reads."""
+        from ..utils.metrics import GLOBAL_METRICS
+        coord = self._session.coord
+        if path == "/metrics":
+            return (200, "text/plain; version=0.0.4; charset=utf-8",
+                    GLOBAL_METRICS.render_prometheus())
+        if path == "/healthz":
+            body = json.dumps({
+                "status": "ok",
+                "committed_epoch": self._session.store.committed_epoch(),
+                "barrier_latency_p50_s":
+                    coord.barrier_latency_percentile(0.5),
+                "inflight_epochs": len(coord._epochs),
+                "actors": len(coord.actor_ids),
+                "recoveries": self._session.recoveries,
+            })
+            return 200, "application/json", body + "\n"
+        if path == "/debug/traces":
+            lines = []
+            stuck = coord.tracer.open_traces()
+            if stuck:
+                lines.append("== in-flight epochs ==")
+                lines.extend(t.render() for t in stuck)
+            lines.append("== recent epochs ==")
+            lines.extend(t.render() for t in coord.tracer.recent())
+            return 200, "text/plain; charset=utf-8", "\n".join(lines) + "\n"
+        if path == "/debug/await_tree":
+            from ..utils.trace import dump_task_tree
+            return (200, "text/plain; charset=utf-8",
+                    dump_task_tree() + "\n")
+        return 404, "text/plain; charset=utf-8", "not found\n"
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            request = await asyncio.wait_for(reader.readline(), timeout=5)
+            parts = request.decode("latin-1", "replace").split()
+            path = parts[1] if len(parts) >= 2 else "/"
+            path = path.split("?", 1)[0]
+            # drain headers (we never need them; HTTP/1.0, close after)
+            while True:
+                line = await asyncio.wait_for(reader.readline(), timeout=5)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            try:
+                status, ctype, body = self._route(path)
+            except Exception as e:        # a scrape must never kill us
+                status, ctype, body = (500, "text/plain",
+                                       f"internal error: {e}\n")
+            reason = {200: "OK", 404: "Not Found",
+                      500: "Internal Server Error"}.get(status, "OK")
+            payload = body.encode("utf-8", "replace")
+            writer.write(
+                f"HTTP/1.0 {status} {reason}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                f"Connection: close\r\n\r\n".encode("latin-1"))
+            writer.write(payload)
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass

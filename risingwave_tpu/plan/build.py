@@ -145,6 +145,9 @@ class Deployment:
                     pass
             for a in self.actors:
                 self.coord.actor_ids.discard(a.actor_id)
+                # per-actor streaming series die with the actor (their
+                # labels would otherwise linger in every future scrape)
+                self.coord.stats.unregister(a.actor_id)
             for q in self.source_queues:
                 if q in self.coord.source_queues:
                     self.coord.source_queues.remove(q)
@@ -242,8 +245,10 @@ def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
             dispatcher = _dispatcher_for(graph, f, consumers[fid],
                                          channels, 0)
             env.coord.register_actor(actor_id)
-            dep.actors.append(Actor(actor_id, root, dispatcher,
-                                    env.coord))
+            actor = Actor(actor_id, root, dispatcher, env.coord)
+            dep.actors.append(actor)
+            env.coord.stats.register(env.memory_scope or "flow",
+                                     actor, root)
             continue
         bitmaps = (shard_vnode_bitmaps(f.parallelism)
                    if f.parallelism > 1 else [None])
@@ -292,7 +297,13 @@ def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
             dispatcher = _dispatcher_for(graph, f, consumers[fid],
                                          channels, idx)
             env.coord.register_actor(actor_id)
-            dep.actors.append(Actor(actor_id, root, dispatcher, env.coord))
+            actor = Actor(actor_id, root, dispatcher, env.coord)
+            dep.actors.append(actor)
+            # streaming-stats registration rides the same walk as the
+            # memory manager's: per-actor series (metric_level=debug)
+            # appear labelled by the owning flow
+            env.coord.stats.register(env.memory_scope or "flow",
+                                     actor, root)
     dep.source_queues = list(env.pending_source_queues)
     return dep
 

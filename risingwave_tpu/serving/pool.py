@@ -75,12 +75,15 @@ class ServingPool:
         if waited > 0:
             SERVING_ADMISSION_WAIT.inc(waited)
         self._active += 1
-        SERVING_INFLIGHT.set(float(self._active))
+        # inc/dec (not set): the done-callback of an ABANDONED query can
+        # race a fresh admission; set() from both sides loses updates,
+        # the locked inc/dec pair cannot
+        SERVING_INFLIGHT.inc()
         fut = asyncio.ensure_future(asyncio.to_thread(fn))
 
         def _done(_f):
             self._active -= 1
-            SERVING_INFLIGHT.set(float(self._active))
+            SERVING_INFLIGHT.dec()
             self._slot_free.set()
             self._done_times.append(time.monotonic())
             SERVING_QUERIES.inc()

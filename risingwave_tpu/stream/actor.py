@@ -5,17 +5,28 @@ the chain's final stream, fanning out through the dispatcher, reporting every
 barrier to the local barrier manager (`collect`), exiting on a Stop mutation.
 Here actors are asyncio tasks; device work inside executors runs async to the
 host loop (XLA dispatch is non-blocking until results are fetched).
+
+Observability (stream/monitor.py): when the coordinator's StreamingStats
+attaches an `ActorObs` (metric_level >= info), the loop times every poll
+of the chain and splits each barrier interval into apply (chunk compute +
+dispatch), persist (the barrier-yielding poll — the chain's flush/commit
+work), and align (input-channel waits reported by the exchange inputs +
+the epoch fence). The split rides to the EpochTracer at collect time, so
+`\trace` answers "who held epoch N and doing what". At metric_level=off
+`self.obs` is None and the loop is the uninstrumented one.
 """
 
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Optional, Protocol
 
 from ..common.chunk import StreamChunk
 from .exchange import Dispatcher
 from .executor import Executor
 from .message import Barrier
+from .monitor import dispatcher_fanout
 
 
 class BarrierCollector(Protocol):
@@ -31,6 +42,9 @@ class Actor:
         self.dispatcher = dispatcher
         self.collector = collector
         self.rows_processed = 0
+        # per-actor instrument bundle (stream/monitor.py ActorObs);
+        # attached/removed by the coordinator's StreamingStats
+        self.obs = None
 
     async def run(self) -> None:
         try:
@@ -45,18 +59,48 @@ class Actor:
             raise
 
     async def _run_inner(self) -> None:
-        import asyncio as _asyncio
         last_token = None
-        async for msg in self.consumer.execute():
+        it = self.consumer.execute().__aiter__()
+        mono = time.monotonic_ns
+        while True:
+            obs = self.obs
+            if obs is not None:
+                t_poll = mono()
+                w0 = obs.input_wait_ns
+            try:
+                msg = await it.__anext__()
+            except StopAsyncIteration:
+                return
+            if obs is not self.obs:
+                # re-instrumented while parked in the poll (SET
+                # metric_level): restart the span at the switch point so
+                # this very message already reports under the new level
+                obs = self.obs
+                if obs is not None:
+                    t_poll = mono()
+                    w0 = obs.input_wait_ns
             if isinstance(msg, StreamChunk):
                 if msg.columns:
                     last_token = msg.columns[0].data
                 if self.dispatcher is not None:
                     await self.dispatcher.dispatch(msg)
+                if obs is not None:
+                    # poll span minus the channel-recv wait accrued inside
+                    # it = actual chunk compute + dispatch time
+                    waited = obs.input_wait_ns - w0
+                    obs.apply_ns += max(0, mono() - t_poll - waited)
+                    obs.note_chunk_out(msg,
+                                       dispatcher_fanout(self.dispatcher))
             elif isinstance(msg, Barrier):
                 barrier = msg.with_passed(self.actor_id)
                 if self.dispatcher is not None:
                     await self.dispatcher.dispatch(barrier)
+                if obs is not None:
+                    # the barrier-yielding poll is the chain's barrier
+                    # work: every executor's flush/persist/commit runs
+                    # inside it before the barrier emerges
+                    waited = obs.input_wait_ns - w0
+                    obs.persist_ns += max(0, mono() - t_poll - waited)
                 # Epoch fence: the barrier is only reported collected once
                 # every device program of the epoch has actually executed
                 # (the chain dispatches asynchronously) — the last chunk
@@ -70,10 +114,17 @@ class Actor:
                 from .executor import gather_fence_tokens
                 tokens = [last_token] if last_token is not None else []
                 tokens.extend(gather_fence_tokens(self.consumer))
+                t_fence = mono() if obs is not None else 0
                 for tok in tokens:
                     if hasattr(tok, "block_until_ready"):
-                        await _asyncio.to_thread(tok.block_until_ready)
+                        await asyncio.to_thread(tok.block_until_ready)
                 last_token = None
+                if obs is not None:
+                    obs.fence_ns += mono() - t_fence
+                    phases = obs.on_barrier()
+                    ph = getattr(self.collector, "collect_phases", None)
+                    if ph is not None:
+                        ph(self.actor_id, barrier, phases)
                 if self.collector is not None:
                     self.collector.collect(self.actor_id, barrier)
                 if barrier.is_stop(self.actor_id):
@@ -81,6 +132,9 @@ class Actor:
             else:
                 if self.dispatcher is not None:
                     await self.dispatcher.dispatch(msg)
+                if obs is not None:
+                    waited = obs.input_wait_ns - w0
+                    obs.apply_ns += max(0, mono() - t_poll - waited)
 
     def spawn(self) -> asyncio.Task:
         return asyncio.create_task(self.run(), name=f"actor-{self.actor_id}")

@@ -12,6 +12,7 @@ gRPC exchange stood (between fragments, and host<->host over DCN).
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -29,16 +30,36 @@ from ..ops.jit_state import jit_state
 
 
 class Channel:
-    """Bounded mpsc channel (permit.rs analogue)."""
+    """Bounded mpsc channel (permit.rs analogue).
+
+    `obs` (stream/monitor.py ChannelObs, attached at metric_level=debug)
+    adds queue-depth and blocked-put (backpressure) accounting: a full
+    queue means the RECEIVING actor is the bottleneck, and the seconds a
+    sender spends parked here are exactly the backpressure an operator
+    is hunting when an epoch runs long."""
 
     def __init__(self, capacity: int = 16):
         self.queue: asyncio.Queue[Message] = asyncio.Queue(maxsize=capacity)
+        self.obs = None
 
     async def send(self, msg: Message) -> None:
-        await self.queue.put(msg)
+        obs = self.obs
+        if obs is None:
+            await self.queue.put(msg)
+            return
+        if self.queue.full():
+            t0 = time.monotonic()
+            await self.queue.put(msg)
+            obs.blocked_put.inc(time.monotonic() - t0)
+        else:
+            self.queue.put_nowait(msg)
+        obs.depth.set(float(self.queue.qsize()))
 
     async def recv(self) -> Message:
-        return await self.queue.get()
+        msg = await self.queue.get()
+        if self.obs is not None:
+            self.obs.depth.set(float(self.queue.qsize()))
+        return msg
 
 
 # ------------------------------------------------------------- dispatchers
@@ -177,12 +198,23 @@ class ChannelInput(Executor):
         self.coalescer = (ChunkCoalescer(coalesce_max) if coalesce_max
                           else None)
         self.identity = "ChannelInput"
+        # owning actor's ActorObs (stream/monitor.py): recv waits are the
+        # align component of the interval phase split
+        self.obs = None
 
     async def execute(self):
         from .message import StopMutation
         co = self.coalescer
         while True:
-            msg = await self.channel.recv()
+            obs = self.obs
+            if obs is None:
+                msg = await self.channel.recv()
+            else:
+                t0 = time.monotonic_ns()
+                msg = await self.channel.recv()
+                obs.add_input_wait(time.monotonic_ns() - t0)
+                if isinstance(msg, StreamChunk):
+                    obs.note_chunk_in()
             if co is not None:
                 if isinstance(msg, StreamChunk):
                     for out in co.push(msg):
@@ -212,6 +244,10 @@ class MergeExecutor(Executor):
         self.coalescer = (ChunkCoalescer(coalesce_max) if coalesce_max
                           else None)
         self.identity = f"Merge({len(self.channels)})"
+        # owning actor's ActorObs: time parked in asyncio.wait covers
+        # both upstream starvation AND barrier alignment (channels that
+        # already delivered their barrier are held out of the wait set)
+        self.obs = None
 
     async def execute(self):
         n = len(self.channels)
@@ -240,10 +276,20 @@ class MergeExecutor(Executor):
                     for i, c in enumerate(self.channels):
                         getters[i] = asyncio.create_task(c.recv())
                     continue
-                done, _ = await asyncio.wait(waiting, return_when=asyncio.FIRST_COMPLETED)
+                obs = self.obs
+                if obs is None:
+                    done, _ = await asyncio.wait(
+                        waiting, return_when=asyncio.FIRST_COMPLETED)
+                else:
+                    t0 = time.monotonic_ns()
+                    done, _ = await asyncio.wait(
+                        waiting, return_when=asyncio.FIRST_COMPLETED)
+                    obs.add_input_wait(time.monotonic_ns() - t0)
                 for t in done:
                     i = next(k for k, v in getters.items() if v is t)
                     msg = t.result()
+                    if obs is not None and isinstance(msg, StreamChunk):
+                        obs.note_chunk_in()
                     if isinstance(msg, Barrier):
                         pending_barrier[i] = msg
                     elif isinstance(msg, Watermark):

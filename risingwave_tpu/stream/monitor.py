@@ -1,0 +1,333 @@
+"""Streaming stats — the per-actor observability plane.
+
+Reference: `StreamingMetrics` (src/stream/src/executor/monitor/
+streaming_stats.rs, ~150 labelled Prometheus series) gated by a
+`MetricLevel` knob (common/src/config.rs `MetricLevel`): per-actor and
+per-executor series are Debug-level so production clusters can turn the
+label cardinality (and collection cost) off without losing the headline
+totals. This module is that subsystem for the TPU port:
+
+  * `MetricLevel` — off | info | debug (SET metric_level ...);
+  * `ActorObs` — one bundle of instruments per actor: row/chunk counts,
+    busy vs. align-wait seconds, dispatch fanout, plus the interval
+    phase split (apply / persist / align) the EpochTrace shows;
+  * `ChannelObs` — queue depth + blocked-put (backpressure) seconds on
+    every exchange channel feeding an actor;
+  * `StreamingStats` — the per-coordinator registrar: `build_graph`
+    registers every actor chain through it (the same walk the
+    MemoryManager uses), `Deployment.stop` unregisters, and
+    `SET metric_level` re-instruments live actors in place.
+
+Cost discipline (tunneled-TPU rules): per-chunk row counts accumulate
+as LAZY device scalars (`chunk.cardinality()` sums the visibility mask
+on device) and are fetched ONCE per actor-barrier, right after the
+epoch fence already blocked on the interval's programs — never a
+per-chunk d2h. At `off`, actors carry no obs object at all and the hot
+loop is the pre-observability one.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+import numpy as np
+
+from ..utils.metrics import GLOBAL_METRICS, MetricsRegistry
+
+
+class MetricLevel(enum.IntEnum):
+    """Collection verbosity (reference common/src/config.rs MetricLevel,
+    collapsed to the three tiers the engine distinguishes)."""
+
+    OFF = 0       # no per-actor instrumentation, no phase tracking
+    INFO = 1      # phase splits for \trace; no per-actor series (default)
+    DEBUG = 2     # full per-actor/per-channel labelled series
+
+    @classmethod
+    def parse(cls, v) -> "MetricLevel":
+        if isinstance(v, cls):
+            return v
+        if isinstance(v, int):
+            return cls(v)
+        s = str(v).strip().lower()
+        try:
+            return {"off": cls.OFF, "disabled": cls.OFF,
+                    "info": cls.INFO, "debug": cls.DEBUG}[s]
+        except KeyError:
+            raise ValueError(
+                f"unknown metric_level {v!r} (expected off|info|debug)")
+
+
+def dispatcher_fanout(d) -> int:
+    """Number of output channels a dispatcher feeds right now (Tap
+    fanout is runtime-extendable, so this re-reads on every call)."""
+    if d is None:
+        return 0
+    outs = getattr(d, "outputs", None)
+    if outs is not None:
+        return len(outs)
+    if getattr(d, "output", None) is not None:
+        return 1
+    chans = getattr(d, "channels", None)   # TapDispatcher: (ch, ids) pairs
+    if chans is not None:
+        return len(chans)
+    subs = getattr(d, "dispatchers", None)  # FanoutDispatcher
+    if subs is not None:
+        return sum(dispatcher_fanout(x) for x in subs)
+    return 1
+
+
+class ChannelObs:
+    """Queue depth + blocked-put accounting for one exchange channel,
+    labelled by the RECEIVING actor (backpressure blames the slow
+    consumer, which is what an operator wants to see)."""
+
+    __slots__ = ("depth", "blocked_put", "keys")
+
+    def __init__(self, registry: MetricsRegistry, actor_label: str,
+                 executor_label: str, input_idx: int):
+        labels = dict(actor=actor_label, executor=executor_label,
+                      input=str(input_idx))
+        self.depth = registry.gauge("stream_exchange_queue_depth", **labels)
+        self.blocked_put = registry.counter(
+            "stream_exchange_blocked_put_seconds_total", **labels)
+        self.keys = [("stream_exchange_queue_depth", labels),
+                     ("stream_exchange_blocked_put_seconds_total", labels)]
+
+
+class ActorObs:
+    """Per-actor instrument bundle. Interval cells reset at each
+    barrier; the phase split they produce rides into the EpochTrace."""
+
+    __slots__ = (
+        "actor_id", "debug", "apply_ns", "persist_ns", "input_wait_ns",
+        "fence_ns", "_row_acc", "row_count", "chunks_in", "chunks_out",
+        "dispatch", "busy_seconds", "align_seconds", "keys",
+        "_occupancy", "registry",
+    )
+
+    def __init__(self, registry: MetricsRegistry, actor_id: int,
+                 executor_label: str, debug: bool):
+        self.registry = registry
+        self.actor_id = actor_id
+        self.debug = debug
+        # interval phase cells (ns), reset at every barrier
+        self.apply_ns = 0
+        self.persist_ns = 0
+        self.input_wait_ns = 0
+        self.fence_ns = 0
+        self._row_acc = None          # lazy device scalar (sum of chunk
+        #                               cardinalities this interval)
+        self._occupancy = []          # (executor_label, part, gauge, fn)
+        self.keys = []
+        if debug:
+            labels = dict(actor=str(actor_id), executor=executor_label)
+            self.row_count = registry.counter(
+                "stream_actor_row_count", **labels)
+            self.chunks_in = registry.counter(
+                "stream_actor_in_chunk_count", **labels)
+            self.chunks_out = registry.counter(
+                "stream_actor_out_chunk_count", **labels)
+            self.dispatch = registry.counter(
+                "stream_actor_dispatch_total", **labels)
+            self.busy_seconds = registry.counter(
+                "stream_actor_busy_seconds_total", **labels)
+            self.align_seconds = registry.counter(
+                "stream_actor_barrier_align_seconds_total", **labels)
+            self.keys = [
+                (n, labels) for n in (
+                    "stream_actor_row_count", "stream_actor_in_chunk_count",
+                    "stream_actor_out_chunk_count",
+                    "stream_actor_dispatch_total",
+                    "stream_actor_busy_seconds_total",
+                    "stream_actor_barrier_align_seconds_total")]
+        else:
+            self.row_count = self.chunks_in = self.chunks_out = None
+            self.dispatch = self.busy_seconds = self.align_seconds = None
+
+    # ------------------------------------------------------ hot-path notes
+    def add_input_wait(self, ns: int) -> None:
+        """Exchange inputs (ChannelInput/Merge) report channel recv
+        waits here — the align component of the phase split."""
+        self.input_wait_ns += ns
+
+    def note_chunk_in(self) -> None:
+        if self.chunks_in is not None:
+            self.chunks_in.inc()
+
+    def note_chunk_out(self, chunk, fanout: int) -> None:
+        if self.chunks_out is not None:
+            self.chunks_out.inc()
+            self.dispatch.inc(fanout)
+            # lazy device scalar: no transfer until the barrier flush
+            card = chunk.cardinality()
+            self._row_acc = (card if self._row_acc is None
+                             else self._row_acc + card)
+
+    # --------------------------------------------------------- barrier flush
+    def on_barrier(self) -> dict:
+        """Close the interval: fetch the accumulated row count (the
+        epoch fence already blocked on this interval's programs, so the
+        8-byte readback is transfer-only), flush the busy/align
+        counters, refresh occupancy gauges, and return the phase split
+        for the epoch trace."""
+        align_ns = self.input_wait_ns + self.fence_ns
+        phases = {"apply_ns": self.apply_ns,
+                  "persist_ns": self.persist_ns,
+                  "align_ns": align_ns}
+        if self.debug:
+            if self._row_acc is not None:
+                self.row_count.inc(int(np.asarray(self._row_acc)))
+            self.busy_seconds.inc((self.apply_ns + self.persist_ns) / 1e9)
+            self.align_seconds.inc(align_ns / 1e9)
+            for _label, _part, gauge, fn in self._occupancy:
+                try:
+                    gauge.set(float(fn()))
+                except Exception:
+                    pass
+        self.apply_ns = self.persist_ns = 0
+        self.input_wait_ns = self.fence_ns = 0
+        self._row_acc = None
+        return phases
+
+    def add_occupancy_gauge(self, executor_label: str, part: str,
+                            fn) -> None:
+        labels = dict(actor=str(self.actor_id), executor=executor_label,
+                      part=part)
+        gauge = self.registry.gauge("stream_executor_hash_occupancy",
+                                    **labels)
+        self._occupancy.append((executor_label, part, gauge, fn))
+        self.keys.append(("stream_executor_hash_occupancy", labels))
+
+
+def _iter_chain(root):
+    """Every executor reachable from a fragment root through input(s) —
+    the same walk plan/build.py uses for memory registration."""
+    seen: set[int] = set()
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if node is None or id(node) in seen:
+            continue
+        seen.add(id(node))
+        yield node
+        inp = getattr(node, "input", None)
+        if inp is not None:
+            stack.append(inp)
+        for i in getattr(node, "inputs", ()) or ():
+            stack.append(i)
+
+
+def _occupancy_parts(ex):
+    """(part, fn) occupancy fractions for hash-table executors — duck
+    typed on the host-known occupancy the growth logic already tracks
+    (`_occ_known`), so reading it costs nothing on device."""
+    occ = getattr(ex, "_occ_known", None)
+    if occ is None:
+        return []
+    if isinstance(occ, (list, tuple)):
+        caps = getattr(ex, "key_capacity", None)
+        if not isinstance(caps, (list, tuple)) or len(caps) != len(occ):
+            return []
+        names = ("left", "right") if len(occ) == 2 else tuple(
+            str(i) for i in range(len(occ)))
+        return [(names[i],
+                 (lambda e=ex, i=i: (e._occ_known[i] /
+                                     max(1, e.key_capacity[i]))))
+                for i in range(len(occ))]
+    cap = getattr(ex, "capacity", None)
+    if not isinstance(cap, int) or cap <= 0:
+        return []
+    return [("all", lambda e=ex: e._occ_known / max(1, e.capacity))]
+
+
+class StreamingStats:
+    """Per-coordinator registrar for actor-level streaming metrics.
+
+    `build_graph` registers every (actor, chain root) pair here right
+    where it registers with the MemoryManager; `Deployment.stop`
+    unregisters, which REMOVES the actor's series from the registry so
+    dead actors don't linger in scrapes. `configure()` re-instruments
+    live actors in place, so `SET metric_level` takes effect without a
+    redeploy."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry if registry is not None else GLOBAL_METRICS
+        self.level = MetricLevel.INFO
+        # actor_id -> (actor, root, scope)
+        self._regs: dict[int, tuple] = {}
+
+    # ------------------------------------------------------------- config
+    def configure(self, level) -> None:
+        lv = MetricLevel.parse(level)
+        if lv == self.level:
+            return
+        self.level = lv
+        for actor_id in list(self._regs):
+            actor, root, scope = self._regs[actor_id]
+            self._uninstrument(actor, root)
+            self._instrument(actor, root, scope)
+
+    # ------------------------------------------------------- registration
+    def register(self, scope: str, actor, root) -> None:
+        self._regs[actor.actor_id] = (actor, root, scope)
+        self._instrument(actor, root, scope)
+
+    def unregister(self, actor_id: int) -> None:
+        reg = self._regs.pop(actor_id, None)
+        if reg is not None:
+            self._uninstrument(reg[0], reg[1])
+
+    def actor_series_count(self) -> int:
+        """Per-actor series currently registered (tests / REPL)."""
+        return sum(len(a.obs.keys) for a, _r, _s in self._regs.values()
+                   if getattr(a, "obs", None) is not None)
+
+    # ----------------------------------------------------- instrumentation
+    def _instrument(self, actor, root, scope: str) -> None:
+        from .exchange import ChannelInput, MergeExecutor
+        if self.level <= MetricLevel.OFF:
+            actor.obs = None
+            return
+        debug = self.level >= MetricLevel.DEBUG
+        executor_label = f"{scope}/{getattr(root, 'identity', 'Executor')}"
+        obs = ActorObs(self.registry, actor.actor_id, executor_label,
+                       debug)
+        chan_idx = 0
+        for ex in _iter_chain(root):
+            if hasattr(ex, "barrier_queue") and hasattr(ex, "obs"):
+                # sources: barrier-queue wait is align (idle) time
+                ex.obs = obs
+            if isinstance(ex, (ChannelInput, MergeExecutor)):
+                ex.obs = obs
+                if debug:
+                    chans = ([ex.channel] if isinstance(ex, ChannelInput)
+                             else list(ex.channels))
+                    for ch in chans:
+                        ch.obs = ChannelObs(self.registry,
+                                            str(actor.actor_id),
+                                            ex.identity, chan_idx)
+                        obs.keys.extend(ch.obs.keys)
+                        chan_idx += 1
+            elif debug:
+                for part, fn in _occupancy_parts(ex):
+                    obs.add_occupancy_gauge(ex.identity, part, fn)
+        actor.obs = obs
+
+    def _uninstrument(self, actor, root) -> None:
+        from .exchange import ChannelInput, MergeExecutor
+        obs = getattr(actor, "obs", None)
+        if obs is not None:
+            for name, labels in obs.keys:
+                self.registry.remove(name, **labels)
+        actor.obs = None
+        for ex in _iter_chain(root):
+            if hasattr(ex, "barrier_queue") and hasattr(ex, "obs"):
+                ex.obs = None
+            if isinstance(ex, (ChannelInput, MergeExecutor)):
+                ex.obs = None
+                chans = ([ex.channel] if isinstance(ex, ChannelInput)
+                         else list(ex.channels))
+                for ch in chans:
+                    ch.obs = None

@@ -10,6 +10,7 @@ offsets are committed to a state table at each checkpoint barrier
 from __future__ import annotations
 
 import asyncio
+import time
 from collections import deque
 from typing import Optional, Protocol
 
@@ -84,6 +85,20 @@ class SourceExecutor(Executor):
         from ..utils.metrics import GLOBAL_METRICS
         self._rows_metric = GLOBAL_METRICS.counter(
             "stream_source_output_rows_counts", source_id=str(source_id))
+        # owning actor's ActorObs (stream/monitor.py): time parked on the
+        # barrier queue is ALIGN wait (idle between intervals), not
+        # barrier-processing work — without this the whole inter-barrier
+        # idle time would be misattributed to the persist phase
+        self.obs = None
+
+    async def _get_barrier(self):
+        obs = self.obs
+        if obs is None:
+            return await self.barrier_queue.get()
+        t0 = time.monotonic_ns()
+        b = await self.barrier_queue.get()
+        obs.add_input_wait(time.monotonic_ns() - t0)
+        return b
 
     async def _acquire_credit(self) -> None:
         # Block (in a worker thread, keeping the event loop live) rather
@@ -120,7 +135,7 @@ class SourceExecutor(Executor):
     async def execute(self):
         # First message is always the Initial barrier (reference: actors are
         # built, then the Add/Initial barrier arrives before any data).
-        barrier = await self.barrier_queue.get()
+        barrier = await self._get_barrier()
         if self.state_table is not None:
             self.state_table.init_epoch(barrier.epoch.curr)
         # recover on the FIRST observed barrier whatever its kind: a
@@ -133,7 +148,7 @@ class SourceExecutor(Executor):
         sent_this_interval = 0
         while True:
             if self.paused:
-                barrier = await self.barrier_queue.get()
+                barrier = await self._get_barrier()
             else:
                 try:
                     barrier = self.barrier_queue.get_nowait()
@@ -149,7 +164,7 @@ class SourceExecutor(Executor):
                 continue
             if self.rate_limit is not None and sent_this_interval >= self.rate_limit:
                 # throttled: wait for the next barrier
-                barrier = await self.barrier_queue.get()
+                barrier = await self._get_barrier()
                 self._apply_mutation(barrier)
                 self._commit_offset(barrier)
                 sent_this_interval = 0
@@ -162,7 +177,7 @@ class SourceExecutor(Executor):
                 # finite connectors (ArrowSource): nothing to read until
                 # something external appends — block on barriers instead
                 # of busy-spinning empty chunks through the dataflow
-                barrier = await self.barrier_queue.get()
+                barrier = await self._get_barrier()
                 self._apply_mutation(barrier)
                 self._commit_offset(barrier)
                 sent_this_interval = 0

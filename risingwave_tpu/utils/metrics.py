@@ -32,11 +32,30 @@ class Counter:
 
 
 class Gauge:
+    """Thread-safe gauge with set/inc/dec. Worker threads mutate gauges
+    too (serving/pool.py admission accounting runs from done-callbacks
+    racing the loop), so the read-modify-write of inc/dec must hold a
+    lock — a bare `self.value += x` from two threads loses updates."""
+
     def __init__(self):
-        self.value = 0.0
+        self._v = 0.0
+        self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
-        self.value = v
+        with self._lock:
+            self._v = float(v)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._v += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._v -= amount
+
+    @property
+    def value(self) -> float:
+        return self._v
 
 
 class Histogram:
@@ -50,6 +69,10 @@ class Histogram:
         self.counts = [0] * (len(self.buckets) + 1)
         self.sum = 0.0
         self.n = 0
+        # largest observation ever seen: quantiles that land in the
+        # +Inf overflow bucket report this instead of silently clamping
+        # to buckets[-1] (which under-reported every outlier)
+        self.max = 0.0
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
@@ -58,9 +81,12 @@ class Histogram:
             self.counts[i] += 1
             self.sum += v
             self.n += 1
+            if v > self.max:
+                self.max = v
 
     def percentile(self, p: float) -> float:
-        """Approximate percentile from bucket boundaries."""
+        """Approximate percentile from bucket boundaries; quantiles that
+        fall in the overflow (+Inf) bucket return the observed max."""
         if self.n == 0:
             return 0.0
         target = p * self.n
@@ -69,8 +95,41 @@ class Histogram:
             acc += c
             if acc >= target:
                 return (self.buckets[i] if i < len(self.buckets)
-                        else self.buckets[-1])
-        return self.buckets[-1]
+                        else self.max)
+        return self.max
+
+
+def escape_label_value(v) -> str:
+    """Prometheus exposition label-value escaping: backslash, double
+    quote and newline must be escaped or the line is unparseable."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_labels(labels) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{escape_label_value(v)}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+def _histogram_lines(name: str, labels, h: "Histogram") -> list[str]:
+    """Cumulative bucket/sum/count lines for one histogram series — the
+    ONE place the exposition bucket format lives (render and
+    render_prometheus both consume it)."""
+    lines = []
+    acc = 0
+    for b, cnt in zip(h.buckets, h.counts):
+        acc += cnt
+        lab = dict(labels)
+        lab["le"] = b
+        lines.append(f"{name}_bucket{_fmt_labels(sorted(lab.items()))} {acc}")
+    lab = dict(labels)
+    lab["le"] = "+Inf"   # required by histogram_quantile
+    lines.append(f"{name}_bucket{_fmt_labels(sorted(lab.items()))} {h.n}")
+    lines.append(f"{name}_sum{_fmt_labels(labels)} {h.sum}")
+    lines.append(f"{name}_count{_fmt_labels(labels)} {h.n}")
+    return lines
 
 
 @dataclass
@@ -99,6 +158,14 @@ class MetricsRegistry:
             self.histograms[key] = Histogram(buckets)
         return self.histograms[key]
 
+    def remove(self, name: str, **labels) -> None:
+        """Drop one series (all kinds) — dead actors must not linger in
+        scrapes forever (stream/monitor.py unregisters through here)."""
+        key = (name, tuple(sorted(labels.items())))
+        self.counters.pop(key, None)
+        self.gauges.pop(key, None)
+        self.histograms.pop(key, None)
+
     # ------------------------------------------------------------ export
     def snapshot(self) -> dict:
         out = {}
@@ -117,31 +184,12 @@ class MetricsRegistry:
     def render(self) -> str:
         """Prometheus text exposition (scraper-compatible)."""
         lines = []
-
-        def fmt_labels(labels):
-            if not labels:
-                return ""
-            inner = ",".join(f'{k}="{v}"' for k, v in labels)
-            return "{" + inner + "}"
-
         for (name, labels), c in sorted(self.counters.items()):
-            lines.append(f"{name}{fmt_labels(labels)} {c.value}")
+            lines.append(f"{name}{_fmt_labels(labels)} {c.value}")
         for (name, labels), g in sorted(self.gauges.items()):
-            lines.append(f"{name}{fmt_labels(labels)} {g.value}")
+            lines.append(f"{name}{_fmt_labels(labels)} {g.value}")
         for (name, labels), h in sorted(self.histograms.items()):
-            acc = 0
-            for b, cnt in zip(h.buckets, h.counts):
-                acc += cnt
-                lab = dict(labels)
-                lab["le"] = b
-                lines.append(
-                    f"{name}_bucket{fmt_labels(sorted(lab.items()))} {acc}")
-            lab = dict(labels)
-            lab["le"] = "+Inf"   # required by histogram_quantile
-            lines.append(
-                f"{name}_bucket{fmt_labels(sorted(lab.items()))} {h.n}")
-            lines.append(f"{name}_sum{fmt_labels(labels)} {h.sum}")
-            lines.append(f"{name}_count{fmt_labels(labels)} {h.n}")
+            lines.extend(_histogram_lines(name, labels, h))
         return "\n".join(lines) + "\n"
 
     def render_prometheus(self) -> str:
@@ -149,13 +197,7 @@ class MetricsRegistry:
         block per metric name — the exposition a real scrape endpoint (or
         `curl | promtool check metrics`) expects. `render()` stays the
         terse label-value dump for the REPL; this is the export surface
-        (the `\\metrics prom` verb and any future HTTP listener)."""
-        def fmt_labels(labels):
-            if not labels:
-                return ""
-            inner = ",".join(f'{k}="{v}"' for k, v in labels)
-            return "{" + inner + "}"
-
+        (the `\\metrics prom` verb and the monitor HTTP `/metrics`)."""
         by_family: dict[str, tuple[str, list[str]]] = {}
 
         def family(name: str, typ: str) -> list[str]:
@@ -165,25 +207,13 @@ class MetricsRegistry:
 
         for (name, labels), c in sorted(self.counters.items()):
             family(name, "counter").append(
-                f"{name}{fmt_labels(labels)} {c.value}")
+                f"{name}{_fmt_labels(labels)} {c.value}")
         for (name, labels), g in sorted(self.gauges.items()):
             family(name, "gauge").append(
-                f"{name}{fmt_labels(labels)} {g.value}")
+                f"{name}{_fmt_labels(labels)} {g.value}")
         for (name, labels), h in sorted(self.histograms.items()):
-            rows = family(name, "histogram")
-            acc = 0
-            for b, cnt in zip(h.buckets, h.counts):
-                acc += cnt
-                lab = dict(labels)
-                lab["le"] = b
-                rows.append(
-                    f"{name}_bucket{fmt_labels(sorted(lab.items()))} {acc}")
-            lab = dict(labels)
-            lab["le"] = "+Inf"
-            rows.append(
-                f"{name}_bucket{fmt_labels(sorted(lab.items()))} {h.n}")
-            rows.append(f"{name}_sum{fmt_labels(labels)} {h.sum}")
-            rows.append(f"{name}_count{fmt_labels(labels)} {h.n}")
+            family(name, "histogram").extend(
+                _histogram_lines(name, labels, h))
         lines = []
         for name, (typ, rows) in sorted(by_family.items()):
             lines.append(f"# TYPE {name} {typ}")
@@ -259,3 +289,8 @@ SERVING_INFLIGHT = GLOBAL_METRICS.gauge("serving_inflight_queries")
 SERVING_ADMISSION_WAIT = GLOBAL_METRICS.counter(
     "serving_admission_wait_seconds_total")
 SERVING_TIMEOUTS = GLOBAL_METRICS.counter("serving_timeouts_total")
+
+# Stuck-barrier watchdog (meta/barrier_manager.py): incremented once per
+# stalled epoch when an in-flight barrier exceeds
+# barrier_stall_threshold_ms; the one-shot report rides stdout/logs.
+BARRIER_STALLS = GLOBAL_METRICS.counter("barrier_stalls_total")

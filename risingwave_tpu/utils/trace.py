@@ -29,6 +29,12 @@ class EpochTrace:
     epoch: int
     inject_ns: int
     collects: list = field(default_factory=list)   # (actor_id, ns_after)
+    # actor_id -> {"apply_ns", "persist_ns", "align_ns"} — the interval's
+    # phase split reported by the actor at its collect (stream/actor.py):
+    # apply = chunk compute+dispatch, persist = barrier-time flush/commit
+    # work in the chain, align = input-channel + fence waiting. A slow
+    # epoch's trace shows WHO held the barrier and DOING WHAT.
+    phases: dict = field(default_factory=dict)
     sync_ns: int = 0        # inline store sync duration (pipelining off)
     # checkpoint-pipeline phases (annotated AFTER the span closes — the
     # uploader commits in the background, off the barrier critical path)
@@ -46,8 +52,13 @@ class EpochTrace:
                      f"commit {self.commit_ns / 1e6:.1f}ms]")
         lines = [head]
         for actor_id, dt in sorted(self.collects, key=lambda x: x[1]):
-            lines.append(f"  actor {actor_id} collected at "
-                         f"+{dt / 1e6:.1f}ms")
+            line = f"  actor {actor_id} collected at +{dt / 1e6:.1f}ms"
+            ph = self.phases.get(actor_id)
+            if ph:
+                line += (f" (apply {ph.get('apply_ns', 0) / 1e6:.1f}ms, "
+                         f"persist {ph.get('persist_ns', 0) / 1e6:.1f}ms, "
+                         f"align {ph.get('align_ns', 0) / 1e6:.1f}ms)")
+            lines.append(line)
         return "\n".join(lines)
 
 
@@ -66,6 +77,15 @@ class EpochTracer:
         if t is not None:
             t.collects.append(
                 (actor_id, time.monotonic_ns() - t.inject_ns))
+
+    def collect_phases(self, epoch: int, actor_id: int,
+                       phases: dict) -> None:
+        """Attach an actor's interval phase split (apply / persist /
+        align, in ns) to the open epoch span (reported by the actor just
+        before it collects the barrier)."""
+        t = self._open.get(epoch)
+        if t is not None:
+            t.phases[actor_id] = phases
 
     def end(self, epoch: int, sync_ns: int = 0) -> None:
         t = self._open.pop(epoch, None)

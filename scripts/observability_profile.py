@@ -1,0 +1,307 @@
+"""Observability-plane gate — canned q7 shape, no TPU needed.
+
+Three checks, rc=0 iff all pass:
+
+  1. OVERHEAD — the q7-shaped pipeline (broadcast source -> window-max
+     agg -> join back) runs under real actors + a real coordinator at
+     `metric_level=off` and `metric_level=debug`; the debug barrier p50
+     must stay within 10% of off (per-actor series must be cheap enough
+     to leave on in production). Each mode runs several passes and takes
+     the best per-mode median to damp scheduler noise.
+  2. EXPOSITION — the monitor endpoint's /metrics body (served over a
+     real socket) must parse as valid Prometheus text exposition:
+     families grouped under one `# TYPE`, histogram `le` ascending with
+     a trailing +Inf, labels quoted/escaped.
+  3. WATCHDOG — a synthetically parked actor (registered, never
+     collects) must trip the stuck-barrier watchdog within the
+     threshold: barrier_stalls_total increments and the report names the
+     remaining actor.
+
+    JAX_PLATFORMS=cpu python scripts/observability_profile.py
+"""
+
+import asyncio
+import contextlib
+import io
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+N_INTERVALS = 30
+WARMUP_INTERVALS = 8
+PASSES = 3
+CHUNKS_PER_INTERVAL = 4
+CHUNK_CAP = 256
+WINDOW = 1 << 10
+OVERHEAD_LIMIT = 1.10
+
+
+def _bid_schema():
+    from risingwave_tpu.common import DataType, schema
+    return schema(("auction", DataType.INT64), ("price", DataType.INT64),
+                  ("ts", DataType.INT64))
+
+
+class IntervalSource:
+    """Barrier-driven scripted source: emits a fixed batch of canned
+    chunks per interval, then parks on the coordinator's barrier queue
+    (the same shape a rate-limited connector source has)."""
+
+    def __init__(self, sch, barrier_q, all_chunks):
+        self.schema = sch
+        self.pk_indices = ()
+        self.identity = "IntervalSource"
+        self.barrier_q = barrier_q
+        self.chunks = all_chunks          # list of per-interval lists
+        self.obs = None
+
+    def fence_tokens(self):
+        return []
+
+    async def execute(self):
+        barrier = await self.barrier_q.get()       # INITIAL
+        yield barrier
+        i = 0
+        while True:
+            for ch in self.chunks[i % len(self.chunks)]:
+                yield ch
+            i += 1
+            barrier = await self.barrier_q.get()
+            yield barrier
+            if barrier.is_stop(0):
+                return
+
+
+def _canned_chunks(seed: int):
+    from risingwave_tpu.common.chunk import StreamChunk
+    sch = _bid_schema()
+    rng = np.random.RandomState(seed)
+    intervals = []
+    for e in range(N_INTERVALS):
+        batch = []
+        base_ts = e * WINDOW * 4
+        for _ in range(CHUNKS_PER_INTERVAL):
+            n = int(rng.randint(CHUNK_CAP // 4, CHUNK_CAP))
+            auction = rng.randint(0, 50, size=n).astype(np.int64)
+            price = rng.randint(1, 2_000, size=n).astype(np.int64)
+            ts = (base_ts
+                  + rng.randint(0, WINDOW * 4, size=n)).astype(np.int64)
+            batch.append(StreamChunk.from_numpy(
+                sch, [auction, price, ts], capacity=CHUNK_CAP))
+        intervals.append(batch)
+    return intervals
+
+
+async def _run_q7(metric_level: str) -> dict:
+    """q7 shape under real actors: one source actor broadcasting to a
+    join actor whose right side is project -> window-max agg."""
+    from risingwave_tpu.expr import call, col, lit
+    from risingwave_tpu.expr.agg import AggCall, AggKind
+    from risingwave_tpu.meta.barrier_manager import BarrierCoordinator
+    from risingwave_tpu.state import MemoryStateStore
+    from risingwave_tpu.stream import (
+        Actor, BroadcastDispatcher, Channel, ChannelInput,
+        HashAggExecutor, StopMutation)
+    from risingwave_tpu.stream.hash_join import HashJoinExecutor
+    from risingwave_tpu.stream.project import ProjectExecutor
+
+    sch = _bid_schema()
+    coord = BarrierCoordinator(MemoryStateStore(),
+                               checkpoint_max_inflight=0)
+    coord.stats.configure(metric_level)
+    barrier_q: asyncio.Queue = asyncio.Queue()
+    coord.register_source(barrier_q)
+
+    src = IntervalSource(sch, barrier_q, _canned_chunks(seed=7))
+    ch_l, ch_r = Channel(64), Channel(64)
+    src_actor = Actor(1, src, BroadcastDispatcher([ch_l, ch_r]), coord)
+
+    win = call("add", call("subtract", col(2),
+                           call("modulus", col(2), lit(WINDOW))),
+               lit(WINDOW))
+    proj = ProjectExecutor(ChannelInput(ch_r, sch), [col(0), col(1), win])
+    agg = HashAggExecutor(
+        proj, [2], [AggCall(AggKind.MAX, 1, sch[1].data_type,
+                            append_only=True)],
+        capacity=1 << 12)
+    join = HashJoinExecutor(
+        ChannelInput(ch_l, sch), agg,
+        left_key_indices=[1], right_key_indices=[1],
+        left_pk_indices=[0, 2], right_pk_indices=[0],
+        key_capacity=1 << 12, row_capacity=1 << 14, match_factor=64)
+    join_actor = Actor(2, join, None, coord)
+
+    for actor, root in ((src_actor, src), (join_actor, join)):
+        coord.register_actor(actor.actor_id)
+        coord.stats.register("q7", actor, root)
+    tasks = [src_actor.spawn(), join_actor.spawn()]
+
+    from risingwave_tpu.stream.message import BarrierKind
+    b = await coord.inject_barrier(kind=BarrierKind.INITIAL)
+    await coord.wait_collected(b)
+    lat = []
+    for i in range(N_INTERVALS - 1):
+        b = await coord.inject_barrier()
+        await coord.wait_collected(b)
+        if i >= WARMUP_INTERVALS:
+            lat.append(coord.latencies_ns[-1] / 1e6)
+    b = await coord.inject_barrier(mutation=StopMutation(frozenset({1, 2})))
+    await coord.wait_collected(b)
+    for t in tasks:
+        await t
+    lat.sort()
+    return {"metric_level": metric_level,
+            "p50_ms": round(lat[len(lat) // 2], 3),
+            "p90_ms": round(lat[int(len(lat) * 0.9)], 3),
+            "intervals": len(lat)}
+
+
+# ---------------------------------------------------------- exposition check
+
+def parse_exposition(text: str) -> dict:
+    """Minimal Prometheus text-format validator: returns
+    family -> [(labels_str, value)], raising on malformed lines,
+    ungrouped families, or mis-ordered histogram `le` buckets."""
+    line_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? ([0-9eE.+-]+|NaN|[+-]Inf)$")
+    families: dict = {}
+    seen_types: dict = {}
+    current = None
+    for ln in text.splitlines():
+        if not ln.strip():
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, name, typ = ln.split(" ", 3)
+            if name in seen_types:
+                raise ValueError(f"family {name} declared twice")
+            seen_types[name] = typ
+            current = name
+            continue
+        if ln.startswith("#"):
+            continue
+        m = line_re.match(ln)
+        if m is None:
+            raise ValueError(f"malformed exposition line: {ln!r}")
+        name = m.group(1)
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        fam = name if name in seen_types else base
+        if fam != current:
+            raise ValueError(
+                f"series {name} outside its family block ({current})")
+        families.setdefault(fam, []).append(
+            (m.group(2) or "", float(m.group(3))
+             if m.group(3) not in ("+Inf", "-Inf", "NaN") else m.group(3)))
+    # histogram le ordering per labelset
+    for fam, typ in seen_types.items():
+        if typ != "histogram":
+            continue
+        by_rest: dict = {}
+        for labels, _v in families.get(fam, []):
+            if '_le_sentinel' in labels:
+                continue
+            mle = re.search(r'le="([^"]+)"', labels)
+            if mle is None:
+                continue
+            rest = re.sub(r'le="[^"]+",?', "", labels)
+            by_rest.setdefault(rest, []).append(mle.group(1))
+        for rest, les in by_rest.items():
+            vals = [float("inf") if x == "+Inf" else float(x) for x in les]
+            if vals != sorted(vals) or vals[-1] != float("inf"):
+                raise ValueError(
+                    f"histogram {fam}{rest}: le not ascending to +Inf: "
+                    f"{les}")
+    return families
+
+
+async def _check_exposition() -> dict:
+    """Serve /metrics from a LIVE session over a real socket and parse."""
+    from risingwave_tpu.frontend import Session
+    s = Session()
+    await s.execute("SET metric_level = debug")
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=128, rate_limit=128)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW obs_gate AS SELECT auction, price "
+        "FROM bid")
+    await s.tick(3)
+    mon = await s.start_monitor(0)
+    reader, writer = await asyncio.open_connection("127.0.0.1", mon.port)
+    writer.write(b"GET /metrics HTTP/1.0\r\n\r\n")
+    raw = await reader.read()
+    writer.close()
+    head, _, body = raw.decode().partition("\r\n\r\n")
+    assert head.startswith("HTTP/1.0 200"), head
+    families = parse_exposition(body)
+    per_actor = [f for f in families if f.startswith("stream_actor_")]
+    await s.stop_monitor()
+    await s.drop_all()
+    return {"families": len(families),
+            "per_actor_families": sorted(per_actor),
+            "row_series": len(families.get("stream_actor_row_count", []))}
+
+
+# ------------------------------------------------------------ watchdog check
+
+async def _check_watchdog() -> dict:
+    """A registered actor that never collects must trip the watchdog."""
+    from risingwave_tpu.meta.barrier_manager import BarrierCoordinator
+    from risingwave_tpu.state import MemoryStateStore
+    from risingwave_tpu.utils.metrics import GLOBAL_METRICS
+
+    coord = BarrierCoordinator(MemoryStateStore())
+    coord.stall_threshold_ms = 150.0
+    coord.register_actor(999)                 # parked forever
+    q: asyncio.Queue = asyncio.Queue()
+    coord.register_source(q)
+    stalls0 = GLOBAL_METRICS.counter("barrier_stalls_total").value
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        b = await coord.inject_barrier()
+        waiter = asyncio.ensure_future(coord.wait_collected(b))
+        await asyncio.sleep(0.6)
+        coord.collect(999, b)                 # un-park; epoch completes
+        await waiter
+    report = buf.getvalue()
+    stalls = GLOBAL_METRICS.counter("barrier_stalls_total").value - stalls0
+    return {"stalls_fired": stalls,
+            "report_names_actor": "999" in report,
+            "report_has_await_tree": "await tree" in report}
+
+
+async def main() -> int:
+    # overhead: alternate modes, best median per mode
+    p50 = {"off": [], "debug": []}
+    for _ in range(PASSES):
+        for mode in ("off", "debug"):
+            r = await _run_q7(mode)
+            p50[mode].append(r["p50_ms"])
+    off_p50, dbg_p50 = min(p50["off"]), min(p50["debug"])
+    overhead = {"off_p50_ms": off_p50, "debug_p50_ms": dbg_p50,
+                "ratio": round(dbg_p50 / max(off_p50, 1e-9), 3),
+                "passes": p50}
+    expo = await _check_exposition()
+    wd = await _check_watchdog()
+    verdict = {
+        "overhead_within_10pct": dbg_p50 <= off_p50 * OVERHEAD_LIMIT,
+        "exposition_valid": expo["row_series"] > 0,
+        "watchdog_fired": (wd["stalls_fired"] >= 1
+                           and wd["report_names_actor"]
+                           and wd["report_has_await_tree"]),
+    }
+    print(json.dumps({"overhead": overhead}))
+    print(json.dumps({"exposition": expo}))
+    print(json.dumps({"watchdog": wd}))
+    print(json.dumps({"verdict": verdict}))
+    return 0 if all(verdict.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

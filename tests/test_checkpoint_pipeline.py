@@ -189,7 +189,10 @@ async def _run_measured(max_inflight: int, delay_s: float = 0.05):
     await coord.run_rounds(3)          # Initial + warmup (compile)
     n_warm = len(coord.latencies_ns)
     saw_inflight = 0
-    for _ in range(6):
+    # enough measured rounds that the p50 shrugs off the ~1s jit
+    # re-trace spikes of capacity-growth rounds (6 rounds flaked: three
+    # spiky rounds in the window flipped the median to the spike level)
+    for _ in range(14):
         b = await coord.inject_barrier()
         await coord.wait_collected(b)
         saw_inflight = max(saw_inflight, coord._inflight)
@@ -205,6 +208,12 @@ async def test_pipelined_run_commits_in_order_and_converges():
     must beat inline sync (the upload left the critical path), manifest
     swaps land strictly in epoch order, and the drained result matches
     the exactly-once oracle."""
+    # throwaway pipelined run first: the deferred-flush path has its own
+    # jit programs (count-dependent prefix packing) that the inline run
+    # never compiles — measuring a process-cold pipelined run spreads
+    # those one-time compile stalls across the measured rounds and flips
+    # the median (observed: cold p50 120ms+, warm p50 ~15ms)
+    await _run_measured(2)
     _, _, _, _, p50_inline, _ = await _run_measured(0)
     coord, store, mv, gen, p50_pipe, saw_inflight = await _run_measured(2)
     # inline pays the >= 50ms SST upload inside every checkpoint barrier;

@@ -1,0 +1,530 @@
+"""Actor-level observability plane (ISSUE 5): per-actor streaming
+metrics + metric_level gating, exposition-format validity, the monitor
+HTTP endpoint, epoch-trace phase splits, and the stuck-barrier
+watchdog."""
+
+import asyncio
+import contextlib
+import io
+import json
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.frontend import Session
+from risingwave_tpu.utils.metrics import (GLOBAL_METRICS, Gauge, Histogram,
+                                          MetricsRegistry,
+                                          escape_label_value)
+
+
+# ------------------------------------------------------------ metrics units
+
+def test_histogram_overflow_percentile_reports_observed_max():
+    h = Histogram(buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 7.5):
+        h.observe(v)
+    # p99 lands in the +Inf overflow bucket: must report the observed
+    # max, not silently clamp to buckets[-1] (the old behavior)
+    assert h.percentile(0.99) == 7.5
+    assert h.max == 7.5
+    # quantiles inside real buckets keep bucket-boundary semantics
+    assert h.percentile(0.3) == 0.1
+
+
+def test_histogram_all_overflow():
+    h = Histogram(buckets=(0.001,))
+    h.observe(42.0)
+    assert h.percentile(0.5) == 42.0
+
+
+def test_gauge_inc_dec_thread_safe():
+    g = Gauge()
+    N = 2000
+
+    def work(sign):
+        for _ in range(N):
+            (g.inc if sign else g.dec)(1.0)
+
+    ts = [threading.Thread(target=work, args=(i % 2,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert g.value == 0.0
+    g.set(5.0)
+    assert g.value == 5.0
+
+
+def test_label_value_escaping_roundtrip():
+    reg = MetricsRegistry()
+    nasty = 'quo"te\\slash\nline'
+    reg.counter("esc_total", tag=nasty).inc(3)
+    text = reg.render_prometheus()
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("esc_total{"))
+    # escaped forms present, raw newline absent (one line per series)
+    assert '\\"' in line and "\\\\" in line and "\\n" in line
+    assert "\n" not in line
+    # round-trip: unescape recovers the original value
+    m = re.match(r'esc_total\{tag="(.*)"\} 3\.0$', line)
+    assert m is not None, line
+    unescaped = (m.group(1).replace("\\n", "\n").replace('\\"', '"')
+                 .replace("\\\\", "\\"))
+    assert unescaped == nasty
+    assert escape_label_value(nasty) == m.group(1)
+
+
+def _validate_exposition(text: str) -> dict:
+    """Family grouping + histogram le-ordering checks (the gate script
+    carries the fuller parser; this is the structural core)."""
+    seen_types: dict = {}
+    current = None
+    le_by_series: dict = {}
+    line_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (\S+)$")
+    for ln in text.splitlines():
+        if not ln.strip():
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, name, typ = ln.split(" ", 3)
+            assert name not in seen_types, f"family {name} declared twice"
+            seen_types[name] = typ
+            current = name
+            continue
+        m = line_re.match(ln)
+        assert m, f"malformed line {ln!r}"
+        base = re.sub(r"_(bucket|sum|count)$", "", m.group(1))
+        fam = m.group(1) if m.group(1) in seen_types else base
+        assert fam == current, f"{m.group(1)} outside family {current}"
+        mle = re.search(r'le="([^"]+)"', m.group(2) or "")
+        if mle and m.group(1).endswith("_bucket"):
+            rest = re.sub(r'le="[^"]+",?', "", m.group(2))
+            le_by_series.setdefault((fam, rest), []).append(mle.group(1))
+    for (fam, rest), les in le_by_series.items():
+        vals = [float("inf") if x == "+Inf" else float(x) for x in les]
+        assert vals == sorted(vals) and vals[-1] == float("inf"), \
+            f"{fam}{rest}: le not ascending to +Inf: {les}"
+    return seen_types
+
+
+def test_exposition_structurally_valid():
+    reg = MetricsRegistry()
+    reg.counter("a_total", x="1").inc()
+    reg.counter("a_total", x="2").inc(2)
+    reg.gauge("b").set(1.5)
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0), job="q")
+    for v in (0.05, 0.5, 3.0):
+        h.observe(v)
+    types = _validate_exposition(reg.render_prometheus())
+    assert types == {"a_total": "counter", "b": "gauge",
+                     "lat_seconds": "histogram"}
+
+
+def test_registry_remove_series():
+    reg = MetricsRegistry()
+    reg.counter("x_total", actor="1").inc()
+    reg.gauge("y", actor="1").set(2)
+    reg.remove("x_total", actor="1")
+    reg.remove("y", actor="1")
+    assert not reg.counters and not reg.gauges
+
+
+# ------------------------------------------------- per-actor series (SQL)
+
+def _actor_series(name: str) -> dict:
+    """label-dict -> value for one per-actor counter family."""
+    return {tuple(sorted(dict(labels).items())): c.value
+            for (n, labels), c in GLOBAL_METRICS.counters.items()
+            if n == name}
+
+
+async def test_per_actor_rows_match_oracle():
+    """Acceptance shape: per-actor stream_actor_row_count sums to the
+    oracle row counts (committed source offsets == MV table rows for a
+    pass-through MV)."""
+    from tests.oracle import committed_offsets
+    s = Session()
+    await s.execute("SET metric_level = debug")
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=128, rate_limit=128)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW obs_m AS SELECT auction, price "
+        "FROM bid")
+    await s.tick(4)
+    oracle_rows = sum(committed_offsets(s, "obs_m").values())
+    assert oracle_rows > 0
+    mv_rows = s.query("SELECT count(*) FROM obs_m")[0][0]
+    assert mv_rows == oracle_rows
+    rows = _actor_series("stream_actor_row_count")
+    by_actor = {}
+    for labels, v in rows.items():
+        d = dict(labels)
+        if d["executor"].startswith("obs_m/"):
+            by_actor[d["executor"]] = v
+    # source, row-id-gen and materialize actors each saw every row once
+    assert len(by_actor) == 3, by_actor
+    for ex, v in by_actor.items():
+        assert v == oracle_rows, (ex, v, oracle_rows)
+    await s.drop_all()
+    # unregistration drops the per-actor series from future scrapes
+    assert not any(d["executor"].startswith("obs_m/") for d in (
+        dict(k) for k in _actor_series("stream_actor_row_count")))
+
+
+async def test_metric_level_off_registers_no_per_actor_series():
+    s = Session()
+    await s.execute("SET metric_level = off")
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=128, rate_limit=128)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW off_m AS SELECT auction FROM bid")
+    await s.tick(2)
+    for (name, labels) in list(GLOBAL_METRICS.counters) \
+            + list(GLOBAL_METRICS.gauges):
+        d = dict(labels)
+        assert not (name.startswith("stream_actor_")
+                    and d.get("executor", "").startswith("off_m/")), \
+            (name, d)
+        assert not (name.startswith("stream_exchange_")
+                    and d.get("executor", "").startswith("off_m/"))
+    assert s.coord.stats.actor_series_count() == 0
+    # trace phases are also off
+    assert s.coord.tracer.recent()[-1].phases == {}
+    await s.drop_all()
+
+
+async def test_set_metric_level_runtime_switch():
+    s = Session()
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=128, rate_limit=128)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW sw_m AS SELECT auction FROM bid")
+    await s.tick(1)
+    # info (default): phases recorded, no per-actor series
+    assert s.coord.tracer.recent()[-1].phases
+    assert not _actor_series("stream_actor_row_count")
+    await s.execute("SET metric_level = debug")
+    await s.tick(2)
+    series = _actor_series("stream_actor_row_count")
+    assert series and all(v > 0 for v in series.values())
+    await s.execute("SET metric_level = off")
+    assert not _actor_series("stream_actor_row_count")
+    await s.tick(1)
+    assert s.coord.tracer.recent()[-1].phases == {}
+    with pytest.raises(Exception):
+        await s.execute("SET metric_level = verbose")
+    await s.drop_all()
+
+
+async def test_trace_phases_rendered():
+    s = Session()
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=128, rate_limit=128)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW ph_m AS SELECT auction FROM bid")
+    await s.tick(2)
+    t = s.coord.tracer.recent()[-1]
+    assert t.phases, "info level must record phase splits"
+    for ph in t.phases.values():
+        assert set(ph) == {"apply_ns", "persist_ns", "align_ns"}
+    txt = t.render()
+    assert "apply" in txt and "persist" in txt and "align" in txt
+    await s.drop_all()
+
+
+# ------------------------------------------------------ exchange backpressure
+
+async def test_channel_backpressure_and_depth():
+    from risingwave_tpu.stream.exchange import Channel
+    from risingwave_tpu.stream.monitor import ChannelObs
+    reg = MetricsRegistry()
+    ch = Channel(capacity=2)
+    ch.obs = ChannelObs(reg, "7", "ChannelInput", 0)
+    for i in range(2):
+        await ch.send(i)
+    assert ch.obs.depth.value == 2.0
+
+    async def drain_later():
+        await asyncio.sleep(0.1)
+        await ch.recv()
+
+    t = asyncio.ensure_future(drain_later())
+    await ch.send(99)            # blocks ~0.1s on the full queue
+    await t
+    assert ch.obs.blocked_put.value >= 0.05
+    await ch.recv()
+    await ch.recv()
+    assert ch.obs.depth.value == 0.0
+
+
+# --------------------------------------------------------------- watchdog
+
+async def test_watchdog_fires_and_names_parked_actor():
+    from risingwave_tpu.meta.barrier_manager import BarrierCoordinator
+    from risingwave_tpu.state import MemoryStateStore
+    coord = BarrierCoordinator(MemoryStateStore())
+    coord.stall_threshold_ms = 120.0
+    coord.register_actor(41)
+    coord.register_actor(42)
+    q: asyncio.Queue = asyncio.Queue()
+    coord.register_source(q)
+    stalls0 = GLOBAL_METRICS.counter("barrier_stalls_total").value
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        b = await coord.inject_barrier()
+        coord.collect(41, b)                # 42 stays parked
+        waiter = asyncio.ensure_future(coord.wait_collected(b))
+        await asyncio.sleep(0.5)
+        report = buf.getvalue()
+        coord.collect(42, b)
+        await waiter
+    assert GLOBAL_METRICS.counter("barrier_stalls_total").value \
+        == stalls0 + 1
+    assert "[stuck barrier]" in report
+    assert "remaining actors [42]" in report, report[:300]
+    assert "await tree" in report
+    # fired ONCE for the stall, and the watchdog wound down with the
+    # epoch (no timer on an idle coordinator)
+    await asyncio.sleep(0.1)
+    assert GLOBAL_METRICS.counter("barrier_stalls_total").value \
+        == stalls0 + 1
+    assert (coord._watchdog_task is None or coord._watchdog_task.done())
+
+
+async def test_watchdog_quiet_below_threshold():
+    from risingwave_tpu.meta.barrier_manager import BarrierCoordinator
+    from risingwave_tpu.state import MemoryStateStore
+    coord = BarrierCoordinator(MemoryStateStore())
+    coord.stall_threshold_ms = 10_000.0
+    coord.register_actor(1)
+    q: asyncio.Queue = asyncio.Queue()
+    coord.register_source(q)
+    stalls0 = GLOBAL_METRICS.counter("barrier_stalls_total").value
+    b = await coord.inject_barrier()
+    await asyncio.sleep(0.1)
+    coord.collect(1, b)
+    await coord.wait_collected(b)
+    assert GLOBAL_METRICS.counter("barrier_stalls_total").value == stalls0
+
+
+# --------------------------------------------------------- monitor endpoint
+
+async def _http_get(port: int, path: str) -> tuple[str, str]:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+    raw = await reader.read()
+    writer.close()
+    head, _, body = raw.decode().partition("\r\n\r\n")
+    return head.splitlines()[0], body
+
+
+async def test_monitor_endpoint_serves_all_routes():
+    s = Session()
+    await s.execute("SET metric_level = debug")
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=128, rate_limit=128)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW mon_m AS SELECT auction FROM bid")
+    await s.tick(2)
+    mon = await s.start_monitor(0)
+    try:
+        status, body = await _http_get(mon.port, "/metrics")
+        assert status.endswith("200 OK")
+        _validate_exposition(body)
+        assert "stream_actor_row_count" in body
+        assert "meta_barrier_latency_seconds" in body
+
+        status, body = await _http_get(mon.port, "/healthz")
+        assert status.endswith("200 OK")
+        health = json.loads(body)
+        assert health["status"] == "ok" and health["actors"] == 3
+
+        status, body = await _http_get(mon.port, "/debug/traces")
+        assert status.endswith("200 OK") and "epoch" in body
+
+        status, body = await _http_get(mon.port, "/debug/await_tree")
+        assert status.endswith("200 OK") and "task " in body
+
+        status, _ = await _http_get(mon.port, "/nope")
+        assert "404" in status
+    finally:
+        await s.stop_monitor()
+        await s.drop_all()
+
+
+async def test_monitor_set_var_lifecycle():
+    s = Session()
+    await s.execute("SET monitor_port = 0")          # off: no-op
+    assert s.monitor is None
+    # pick a free ephemeral port first, then SET it explicitly
+    mon = await s.start_monitor(0)
+    port = mon.port
+    status, _ = await _http_get(port, "/healthz")
+    assert status.endswith("200 OK")
+    await s.execute("SET monitor_port = 0")
+    assert s.monitor is None
+    with pytest.raises(OSError):
+        await asyncio.open_connection("127.0.0.1", port)
+
+
+# ------------------------------------------------------- canned q7 agreement
+
+async def test_q7_actor_row_counters_agree_with_direct_run():
+    """The canned q7 pipeline runs twice with identical inputs: once
+    driven directly (counting emitted rows by hand = the oracle), once
+    under instrumented actors — the per-actor counters must agree."""
+    from risingwave_tpu.common import DataType, schema
+    from risingwave_tpu.common.chunk import StreamChunk
+    from risingwave_tpu.common.epoch import EpochPair
+    from risingwave_tpu.expr import call, col, lit
+    from risingwave_tpu.expr.agg import agg_max
+    from risingwave_tpu.meta.barrier_manager import BarrierCoordinator
+    from risingwave_tpu.state import MemoryStateStore
+    from risingwave_tpu.stream import (
+        Actor, Barrier, BarrierKind, BroadcastDispatcher, Channel,
+        ChannelInput, HashAggExecutor, HashJoinExecutor, ProjectExecutor,
+        StopMutation)
+    from risingwave_tpu.stream.executor import Executor
+
+    BID = schema(("auction", DataType.INT64), ("bidder", DataType.INT64),
+                 ("price", DataType.INT64),
+                 ("date_time", DataType.TIMESTAMP))
+    W = 10
+
+    rng = np.random.default_rng(3)
+    intervals = []
+    total_in = 0
+    for _ in range(5):
+        rows = [(int(rng.integers(0, 5)), int(rng.integers(100, 120)),
+                 int(rng.integers(1, 30)), int(rng.integers(0, 40)))
+                for _ in range(12)]
+        total_in += len(rows)
+        cols = [np.asarray([r[i] for r in rows], dtype=np.int64)
+                for i in range(4)]
+        intervals.append(StreamChunk.from_numpy(BID, cols, capacity=16))
+
+    def build(source):
+        ch_l, ch_r = Channel(), Channel()
+        disp = BroadcastDispatcher([ch_l, ch_r])
+        proj = ProjectExecutor(
+            ChannelInput(ch_r, BID),
+            [call("tumble_end", col(3, DataType.TIMESTAMP), lit(W)),
+             col(2)],
+            names=["window_end", "price"])
+        agg = HashAggExecutor(proj, [0], [agg_max(1, append_only=True)],
+                              capacity=64, group_key_names=["window_end"])
+        cond = call("and",
+                    call("greater_than", col(3, DataType.TIMESTAMP),
+                         call("subtract", col(4, DataType.TIMESTAMP),
+                              lit(W))),
+                    call("less_than_or_equal",
+                         col(3, DataType.TIMESTAMP),
+                         col(4, DataType.TIMESTAMP)))
+        join = HashJoinExecutor(
+            ChannelInput(ch_l, BID), agg,
+            left_key_indices=[2], right_key_indices=[1],
+            left_pk_indices=[0, 1, 2, 3], right_pk_indices=[0],
+            key_capacity=256, row_capacity=256, match_factor=8,
+            condition=cond, output_indices=[0, 2, 1, 3])
+        return join, disp
+
+    class Script(Executor):
+        def __init__(self, msgs):
+            self.schema = BID
+            self.identity = "Script"
+            self.msgs = msgs
+
+        async def execute(self):
+            for m in self.msgs:
+                yield m
+                await asyncio.sleep(0)
+
+    def msgs():
+        out = [Barrier(EpochPair(1, 0), BarrierKind.INITIAL)]
+        for e, ch in enumerate(intervals):
+            out.append(ch)
+            out.append(Barrier(EpochPair(e + 2, e + 1)))
+        out.append(Barrier(EpochPair(len(intervals) + 2,
+                                     len(intervals) + 1),
+                           mutation=StopMutation(frozenset())))
+        return out
+
+    # oracle pass: direct drive, count emitted join rows by hand
+    join, disp = build(None)
+    src = Script(msgs())
+
+    async def pump():
+        async for m in src.execute():
+            await disp.dispatch(m)
+
+    pt = asyncio.ensure_future(pump())
+    oracle_out = 0
+    async for m in join.execute():
+        if isinstance(m, StreamChunk):
+            oracle_out += int(np.asarray(m.vis).sum())
+    await pt
+
+    # instrumented pass: same wiring under actors + coordinator
+    coord = BarrierCoordinator(MemoryStateStore(),
+                               checkpoint_max_inflight=0)
+    coord.stats.configure("debug")
+    q: asyncio.Queue = asyncio.Queue()
+    coord.register_source(q)
+    join2, disp2 = build(None)
+
+    class QueueSource(Executor):
+        """Same chunks, barriers from the coordinator's queue."""
+
+        def __init__(self):
+            self.schema = BID
+            self.identity = "QueueSource"
+            self.i = 0
+
+        def fence_tokens(self):
+            return []
+
+        async def execute(self):
+            b = await q.get()
+            yield b
+            while True:
+                if self.i < len(intervals):
+                    yield intervals[self.i]
+                    self.i += 1
+                b = await q.get()
+                yield b
+                if b.is_stop(1):
+                    return
+
+    src_actor = Actor(1, QueueSource(), disp2, coord)
+    join_actor = Actor(2, join2, None, coord)
+    for actor, root in ((src_actor, src_actor.consumer),
+                        (join_actor, join2)):
+        coord.register_actor(actor.actor_id)
+        coord.stats.register("q7", actor, root)
+    tasks = [src_actor.spawn(), join_actor.spawn()]
+    b = await coord.inject_barrier(kind=BarrierKind.INITIAL)
+    await coord.wait_collected(b)
+    for _ in range(len(intervals)):
+        b = await coord.inject_barrier()
+        await coord.wait_collected(b)
+    b = await coord.inject_barrier(
+        mutation=StopMutation(frozenset({1, 2})))
+    await coord.wait_collected(b)
+    for t in tasks:
+        await t
+
+    rows = {dict(labels)["actor"]: c.value
+            for (n, labels), c in GLOBAL_METRICS.counters.items()
+            if n == "stream_actor_row_count"
+            and dict(labels)["executor"].startswith("q7/")}
+    assert rows["1"] == total_in, (rows, total_in)
+    assert rows["2"] == oracle_out, (rows, oracle_out)
+    coord.stats.unregister(1)
+    coord.stats.unregister(2)
