@@ -35,8 +35,13 @@ import time
 # Persistent XLA compilation cache (client-side AOT): the q5/q7/q8
 # programs take 60-120s to compile cold; with the cache warm (primed by
 # any prior bench run on this machine) the whole 4-query bench fits the
-# global budget with minutes to spare. Must be set before jax imports;
-# query/baseline subprocesses inherit it.
+# global budget with minutes to spare. Set via env BEFORE any jax import
+# so the query/baseline subprocesses inherit it; the children also call
+# utils/compile_cache.enable_persistent_cache() (jax.config.update wins
+# over sitecustomize overrides), which shares this cache with the
+# scripts/*_profile.py CI gates and the cluster workers. The orchestrator
+# itself never imports jax — device init belongs in deadline-bounded
+# children only.
 os.environ.setdefault(
     "JAX_COMPILATION_CACHE_DIR",
     os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
@@ -63,6 +68,22 @@ BASELINE_CHUNKS = {"q1": (16, 131072), "q5": (8, 131072),
                    "q17": (64, 8192)}
 # Target duration of the timed measurement region per query.
 MEASURE_S = 8.0
+# Per-PHASE deadlines (fractions of the query budget): a stalled setup
+# or warmup aborts with ITS name on the note instead of silently burning
+# the whole budget and reporting a generic "teardown abandoned"
+# (BENCH_r05 post-mortem: all four queries recorded 0.0 with zero
+# attribution of WHERE they hung).
+PHASE_FRACTION = {"setup_ddl": 0.35, "warmup_compile": 0.75,
+                  "measure": 0.95, "quiesce": 0.5, "teardown": 0.4}
+
+
+def _phase(progress: dict, name: str) -> None:
+    """Enter a named phase; the watcher enforces the per-phase deadline
+    and any abort note names the phase + how long it ran."""
+    progress["phase"] = name
+    progress["phase_t0"] = time.perf_counter()
+    hist = progress.setdefault("phase_history", [])
+    hist.append(name)
 
 
 # ---------------------------------------------------------------- numpy CPU
@@ -269,6 +290,7 @@ async def _measure(coord, gen, sink, progress: dict, measure_s: float,
     lands in `progress` after every round so a deadline abort still
     reports a number."""
     from risingwave_tpu.utils.metrics import D2H_BYTES
+    _phase(progress, "warmup_compile")
     t_c0 = time.perf_counter()
     await coord.run_rounds(warmup_rounds)
     progress["compile_s"] = round(time.perf_counter() - t_c0, 1)
@@ -279,6 +301,7 @@ async def _measure(coord, gen, sink, progress: dict, measure_s: float,
         await asyncio.to_thread(sink.last.block_until_ready)
     start_offset = gen.offset
     d2h_bytes0 = D2H_BYTES.value
+    _phase(progress, "measure")
     t0 = time.perf_counter()
     rounds = 0
     while True:
@@ -363,7 +386,12 @@ async def _bench_sql(progress: dict, ddl: list, interval_s: float,
     from risingwave_tpu.stream.sorted_join import SortedJoinExecutor
     from risingwave_tpu.stream.source import SourceExecutor
 
+    _phase(progress, "setup_ddl")
     s = Session(store=store)
+    # arm the stuck-barrier watchdog WELL below the phase deadline: a
+    # stall self-diagnoses (remaining actors + await tree, on stderr)
+    # before the deadline kills the process with only a phase name
+    await s.execute("SET barrier_stall_threshold_ms = 15000")
     for stmt in ddl:
         await s.execute(stmt)
     gens, sink, join = [], None, None
@@ -388,9 +416,11 @@ async def _bench_sql(progress: dict, ddl: list, interval_s: float,
                    interval_s=interval_s)
     # quiesce: stop the sources producing (the stop barrier would
     # otherwise ride behind a growing backlog)
+    _phase(progress, "quiesce")
     from risingwave_tpu.stream.message import PauseMutation
     b = await s.coord.inject_barrier(mutation=PauseMutation())
     await s.coord.wait_collected(b)
+    _phase(progress, "teardown")
     if join is not None:
         # Post-run d2h of even 3 ints can stall for MINUTES on the
         # tunneled TPU (measured this round: the fetch after a drained
@@ -570,7 +600,9 @@ async def bench_q17(progress: dict) -> None:
 
     QUOTA_CHUNKS = 64
     CS = 8192
+    _phase(progress, "setup_ddl")
     s = Session()
+    await s.execute("SET barrier_stall_threshold_ms = 15000")
     for stmt in [
         "SET streaming_durability = 0",
         "SET streaming_watchdog = 0",
@@ -606,10 +638,12 @@ async def bench_q17(progress: dict) -> None:
                     node = getattr(node, "input", None)
     assert fused, "q17 did not lower to the fused snapshot executor"
     li = next(g for g in gens if g.table == "lineitem")
+    _phase(progress, "warmup_compile")
     t_c0 = time.perf_counter()
     await s.coord.run_rounds(1)
     progress["compile_s"] = round(time.perf_counter() - t_c0, 1)
     base_off = li.offset      # warmup rows are excluded from the metric
+    _phase(progress, "measure")
     t0 = time.perf_counter()
     rounds = 0
     while li.offset - base_off < QUOTA_CHUNKS * CS:
@@ -622,6 +656,7 @@ async def bench_q17(progress: dict) -> None:
         progress["rounds"] = rounds
         progress["barrier_p50_s"] = s.coord.barrier_latency_percentile(0.5)
     progress["seconds"] = time.perf_counter() - t0
+    _phase(progress, "teardown")
     try:
         errs = await asyncio.wait_for(
             asyncio.to_thread(lambda: [
@@ -706,13 +741,25 @@ def _one_query_main(query: str) -> None:
                               **_query_result(query, progress, note_)}),
                   flush=True)
 
-    def _bail():
+    def _phase_note() -> str:
+        """WHERE the run is stuck, for the abort note: the active phase
+        and how long it has been in it (the r05 post-mortem's missing
+        attribution)."""
+        ph = progress.get("phase")
+        if not ph:
+            return "before setup (import/jax init)"
+        dt = time.perf_counter() - progress.get("phase_t0", 0.0)
+        hist = ">".join(progress.get("phase_history", []))
+        return f"stuck in phase {ph!r} for {dt:.1f}s (path: {hist})"
+
+    def _bail(reason: str = ""):
         # no-op once the clean final line is out (ADVICE r3 #5: a late
         # timer must not relabel a successful run as abandoned)
         if finals["done"]:
             return
         progress["clean_exit"] = False
-        _emit(f"hard deadline {budget}s; teardown abandoned", final=True)
+        _emit((reason or f"hard deadline {budget}s") + "; "
+              + _phase_note(), final=True)
         os._exit(0)
 
     killer = threading.Timer(budget, _bail)
@@ -723,6 +770,15 @@ def _one_query_main(query: str) -> None:
     def _watcher():
         provisional = False
         while not done.wait(0.5):
+            # per-phase deadline: a stalled phase fails LOUDLY with its
+            # name, long before the global budget burns down
+            ph = progress.get("phase")
+            if ph in PHASE_FRACTION and not progress.get("pipeline_done"):
+                limit = PHASE_FRACTION[ph] * budget
+                if time.perf_counter() - progress.get("phase_t0",
+                                                      0.0) > limit:
+                    _bail(f"phase {ph!r} exceeded its "
+                          f"{limit:.0f}s deadline")
             if progress.get("pipeline_done"):
                 # the pipeline finished and parked: emit the final line
                 # and exit without unwinding the asyncio loop (actor
@@ -745,6 +801,10 @@ def _one_query_main(query: str) -> None:
     w = threading.Thread(target=_watcher, daemon=True)
     w.start()
     try:
+        # jax.config.update beats sitecustomize overrides in this child
+        from risingwave_tpu.utils.compile_cache import \
+            enable_persistent_cache
+        enable_persistent_cache()
         asyncio.run(QUERIES[query](progress))
         progress.setdefault("clean_exit", True)
     except Exception as e:  # noqa: BLE001 — a number beats a stack trace
@@ -758,7 +818,7 @@ def _one_query_main(query: str) -> None:
 
 
 def _probe_device_init(timeout_s: float = DEVICE_PROBE_TIMEOUT_S):
-    """Deadline-bounded device-init probe in a SUBPROCESS.
+    """Deadline-bounded device-init AND dispatch probe in a SUBPROCESS.
 
     `jax.devices()` on a sick tunneled TPU can hang indefinitely; probing
     in-process would hang the orchestrator itself. The probe child
@@ -766,9 +826,18 @@ def _probe_device_init(timeout_s: float = DEVICE_PROBE_TIMEOUT_S):
     Returns (ok, detail) — on stall/failure the caller emits
     `device_init_stall: true` loudly instead of letting the first query
     burn its whole budget on init and record 0.0 rows/s.
+
+    BENCH_r05 post-mortem: enumeration alone is NOT health — every query
+    hung after `jax.devices()` succeeded. The probe now exercises the
+    full round trip the queries depend on: compile a trivial jitted
+    program, dispatch it, and fetch the scalar back (d2h). A tunnel that
+    enumerates but cannot dispatch or read back fails HERE, attributed,
+    before any query is charged for it.
     """
-    src = ("import jax; ds = jax.devices(); "
-           "print('DEVICES', len(ds), ds[0].platform)")
+    src = ("import jax, jax.numpy as jnp; ds = jax.devices(); "
+           "y = jax.jit(lambda x: (x * 2).sum())(jnp.arange(64)); "
+           "v = int(y); assert v == 4032, v; "
+           "print('DEVICES', len(ds), ds[0].platform, 'dispatch-ok')")
     try:
         p = subprocess.run([sys.executable, "-c", src],
                            capture_output=True, text=True,

@@ -1,0 +1,34 @@
+"""Cluster control plane — meta service + first-class compute nodes.
+
+Reference: the meta node (src/meta/) driving N compute nodes
+(src/compute/) over vnode-partitioned fragments: `GlobalBarrierManager`
+injects barriers per worker and collects per-worker completion,
+`LocalStreamManager::build_actors` builds each node's assigned actors
+locally, and the Hummock version manifest commits only after every
+worker's SSTs for the epoch are uploaded.
+
+This package is that split for the TPU engine:
+
+  * `rpc.py`          — the control-plane wire (length-prefixed pickle
+                        frames between trusted processes, multiplexed
+                        request/response + unsolicited pushes);
+  * `meta_service.py` — `ClusterManager` (worker registry with
+                        heartbeats/leases, vnode-range fragment
+                        placement, two-phase cross-worker deploy,
+                        metrics scrape aggregation) + `WorkerHandle`;
+  * `compute_node.py` — the promoted worker (risingwave_tpu.worker
+                        serves both protocols on one port): builds and
+                        OWNS its assigned actors via plan/build.py's
+                        partial build, runs its own BarrierCoordinator
+                        as the LocalBarrierManager, seals + uploads its
+                        own state, and exposes its own /metrics.
+
+Barriers are injected over RPC into every worker's source queues and
+collected per worker; a checkpoint commits at meta only after ALL
+workers report their sealed SSTs (state/hummock.py `commit_remote`).
+"""
+
+from .meta_service import ClusterManager, WorkerInfo
+from .rpc import RpcConn
+
+__all__ = ["ClusterManager", "RpcConn", "WorkerInfo"]

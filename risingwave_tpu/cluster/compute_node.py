@@ -1,0 +1,341 @@
+"""Compute node — the first-class worker of the cluster control plane.
+
+Reference: src/compute/src/server.rs — a compute node registers with
+meta, receives its assigned plan fragments, builds the actors LOCALLY
+(`LocalStreamManager::build_actors`), exchanges data with peers, runs a
+local barrier manager that collects its own actors and reports
+per-worker completion, and syncs its shared buffer into SSTs that META
+commits.
+
+Here the node is an asyncio process (served by `risingwave_tpu.worker`
+on the same port as the legacy fragment protocol — the connection's
+first frame selects the protocol):
+
+  * owns a `HummockStateStore` handle over the SHARED object store,
+    with `manifest_owner = False` and a disjoint SST-id block: it
+    seals + uploads its own epochs, installs them into its local L0 for
+    read-through, and reports the SST ids to meta — the manifest swap
+    (commit point) happens only on meta, after ALL workers reported;
+  * builds its assigned actors with `plan/build.py build_partial_graph`
+    over ids every process derives identically (`assign_graph_ids`);
+  * runs its own `BarrierCoordinator` as the LocalBarrierManager:
+    meta's `inject` push fans the barrier into local source queues,
+    collection of all local actors triggers the `collected` report;
+  * carries its own HBM budget (partitioned from the cluster budget by
+    meta) and its own monitor HTTP endpoint (`--monitor-port`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+from typing import Optional
+
+from .rpc import RpcConn
+
+
+class _MonitorShim:
+    """What meta/monitor_service.py needs from a 'session': the live
+    coordinator, the store, and a recovery counter."""
+
+    def __init__(self, node: "ComputeNode"):
+        self._node = node
+        self.recoveries = 0
+
+    @property
+    def coord(self):
+        return self._node.coord
+
+    @property
+    def store(self):
+        return self._node.store
+
+    cluster = None
+
+
+class ComputeNode:
+    """One control connection's worth of compute-node state. The meta
+    connection is the node's life line: when it drops, every deployment
+    dies with it (meta re-places the fragments over the survivors)."""
+
+    def __init__(self, conn: RpcConn, host: str = "127.0.0.1"):
+        self.conn = conn
+        self.host = host
+        self.worker_id: Optional[int] = None
+        self.store = None
+        self.coord = None
+        self.config: dict = {}
+        # deploy_id -> {dep, remote_ins, remote_outs}
+        self.deployments: dict[int, dict] = {}
+        self._pending: dict[int, dict] = {}
+        self.monitor = None
+        self._monitor_port = 0
+
+    # --------------------------------------------------------- RPC surface
+    async def handle(self, method: str, args: dict):
+        fn = getattr(self, f"rpc_{method}", None)
+        if fn is None:
+            raise ValueError(f"unknown compute-node method {method!r}")
+        return await fn(**args)
+
+    async def rpc_ping(self):
+        return {"worker_id": self.worker_id,
+                "actors": sum(len(d["dep"].actors)
+                              for d in self.deployments.values())}
+
+    async def rpc_hello(self, worker_id: int, store: dict,
+                        sst_id_base: int, config: dict,
+                        monitor_port: int = 0):
+        import jax
+        self.worker_id = worker_id
+        self._open_store(store, sst_id_base)
+        # the CLI's --monitor-port wins over meta's (operator-pinned)
+        monitor_port = self.config.pop("__monitor_port", 0) or monitor_port
+        self._fresh_coordinator(config)
+        if monitor_port:
+            from ..meta.monitor_service import MonitorService
+            self.monitor = await MonitorService(
+                _MonitorShim(self), port=monitor_port).start()
+            self._monitor_port = self.monitor.port
+        return {"worker_id": worker_id, "pid": os.getpid(),
+                "jax_platform": jax.default_backend(),
+                "monitor_port": self._monitor_port}
+
+    def _open_store(self, spec: dict, sst_id_base: int) -> None:
+        from ..state import HummockStateStore, LocalFsObjectStore
+        assert spec.get("kind", "hummock_fs") == "hummock_fs", spec
+        store = HummockStateStore(LocalFsObjectStore(spec["root"]))
+        store.manifest_owner = False
+        store.set_sst_id_block(sst_id_base)
+        self.store = store
+
+    def _fresh_coordinator(self, config: dict) -> None:
+        from ..meta.barrier_manager import BarrierCoordinator
+        self.config.update(config or {})
+        self.coord = BarrierCoordinator(
+            self.store,
+            checkpoint_max_inflight=self.config.get(
+                "checkpoint_max_inflight", 2))
+        self.coord.commit_listener = self._on_committed
+        self._apply_config()
+
+    def _apply_config(self) -> None:
+        cfg = self.config
+        self.coord.memory.configure(
+            budget_bytes=cfg.get("hbm_budget_bytes", 0),
+            policy=cfg.get("memory_eviction_policy", "lru"))
+        self.coord.stats.configure(cfg.get("metric_level", "info"))
+        thr = cfg.get("barrier_stall_threshold_ms", 60000)
+        self.coord.stall_threshold_ms = float(thr) if thr > 0 else None
+        if "checkpoint_max_inflight" in cfg:
+            self.coord.checkpoint_max_inflight = \
+                cfg["checkpoint_max_inflight"]
+
+    async def rpc_set_config(self, config: dict):
+        self.config.update(config)
+        self._apply_config()
+        return {"applied": sorted(config)}
+
+    def _on_committed(self, epoch: int, sst_ids: list) -> None:
+        """Local seal+upload+L0-install finished for `epoch`: report the
+        SSTs so meta can commit once every worker reported (runs on the
+        loop from the coordinator's uploader)."""
+        asyncio.get_running_loop().create_task(
+            self.conn.push("sealed", worker_id=self.worker_id,
+                           epoch=epoch, sst_ids=list(sst_ids)))
+
+    # ------------------------------------------------------------- deploy
+    async def rpc_deploy_prepare(self, deploy_id: int, graph,
+                                 placement: dict, actor_id_base: int,
+                                 table_id_base: int, ddl_config: dict,
+                                 scope: str):
+        """Phase 1: derive all ids locally, start a RemoteInput server
+        per inbound cross-worker edge leg, report the ports."""
+        from ..plan.build import (assign_graph_ids, cluster_remote_edges,
+                                  infer_fragment_schemas)
+        from ..stream.remote_exchange import RemoteInput
+        actors, tables, _, _ = assign_graph_ids(graph, actor_id_base,
+                                                table_id_base)
+        schemas = infer_fragment_schemas(graph)
+        remote_ins: dict = {}
+        for edge_key, _uw, dw in cluster_remote_edges(graph, placement):
+            if dw != self.worker_id:
+                continue
+            up_fid = edge_key[0]
+            rx = await RemoteInput(schemas[up_fid], host="0.0.0.0",
+                                   queue_depth=8).start()
+            remote_ins[edge_key] = rx
+        self._pending[deploy_id] = dict(
+            graph=graph, placement=placement, actors=actors,
+            tables=tables, schemas=schemas, remote_ins=remote_ins,
+            ddl_config=ddl_config, scope=scope)
+        return {k: rx.port for k, rx in remote_ins.items()}
+
+    async def rpc_deploy_start(self, deploy_id: int, ports: dict):
+        """Phase 2: connect RemoteOutputs to peer ports, build + spawn
+        this node's actors."""
+        from ..plan.build import (BuildEnv, build_partial_graph,
+                                  cluster_remote_edges)
+        from ..stream.remote_exchange import RemoteOutput
+        p = self._pending.pop(deploy_id)
+        remote_outs: dict = {}
+        for edge_key, uw, _dw in cluster_remote_edges(p["graph"],
+                                                      p["placement"]):
+            if uw != self.worker_id:
+                continue
+            host, port = ports[edge_key]
+            remote_outs[edge_key] = await RemoteOutput(host,
+                                                       port).connect()
+        env = BuildEnv(self.store, self.coord,
+                       chunk_coalesce_max=p["ddl_config"].get(
+                           "streaming_chunk_coalesce", 0))
+        env.memory_scope = p["scope"]
+        dep = build_partial_graph(
+            p["graph"], env, p["placement"], self.worker_id,
+            p["actors"], p["tables"], p["schemas"], p["remote_ins"],
+            remote_outs)
+        env.memory_scope = None
+        dep.spawn()
+        self.deployments[deploy_id] = dict(
+            dep=dep, remote_ins=p["remote_ins"], remote_outs=remote_outs)
+        return {"actors": sorted(a.actor_id for a in dep.actors)}
+
+    # ------------------------------------------------------------ barriers
+    async def rpc_inject(self, barrier):
+        """Meta's per-worker barrier injection (push): fan into local
+        source queues NOW (ordering with the next inject rides the
+        connection's frame order), collect + report in the background."""
+        b = await self.coord.inject_remote(barrier)
+        asyncio.get_running_loop().create_task(self._collect_one(b))
+
+    async def _collect_one(self, barrier) -> None:
+        try:
+            await self.coord.wait_collected(barrier)
+            await self.conn.push("collected", worker_id=self.worker_id,
+                                 epoch=barrier.epoch.curr)
+        except ConnectionResetError:
+            pass                      # meta gone; process will be reset
+        except Exception as e:  # noqa: BLE001 — local actor death
+            try:
+                await self.conn.push("failed", worker_id=self.worker_id,
+                                     error=f"{type(e).__name__}: {e}")
+            except ConnectionResetError:
+                pass
+
+    # ------------------------------------------------------------ teardown
+    async def rpc_stop_deployment(self, deploy_id: int):
+        """Clean up ONE deployment after meta drove its stop barrier
+        (actors have exited; deregister them and close the DCN legs)."""
+        d = self.deployments.pop(deploy_id, None)
+        if d is not None:
+            await self._teardown(d)
+        return {}
+
+    async def _teardown(self, d: dict) -> None:
+        dep = d["dep"]
+        for t in dep.tasks:
+            if not t.done():
+                t.cancel()
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        for a in dep.actors:
+            self.coord.actor_ids.discard(a.actor_id)
+            self.coord.stats.unregister(a.actor_id)
+        for q in dep.source_queues:
+            if q in self.coord.source_queues:
+                self.coord.source_queues.remove(q)
+        for n in dep.memory_names:
+            self.coord.memory.unregister(n)
+        for out in d["remote_outs"].values():
+            try:
+                await out.close()
+            except Exception:  # noqa: BLE001
+                pass
+        for rx in d["remote_ins"].values():
+            try:
+                await rx.stop()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def rpc_reset(self, store: Optional[dict] = None,
+                        sst_id_base: Optional[int] = None):
+        """Recovery entry (meta's re-place): abandon every deployment,
+        abort in-flight uploads, reopen the store at the CURRENT
+        committed manifest, fresh coordinator."""
+        for d in list(self.deployments.values()):
+            await self._teardown(d)
+        self.deployments.clear()
+        for p in self._pending.values():
+            for rx in p["remote_ins"].values():
+                try:
+                    await rx.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+        self._pending.clear()
+        if self.coord is not None:
+            await self.coord.abort_uploads()
+        if store is not None:
+            self._open_store(store, sst_id_base or 1)
+        self._fresh_coordinator({})
+        return {"committed_epoch": self.store.committed_epoch()}
+
+    # -------------------------------------------------------- observability
+    async def rpc_scrape(self):
+        """This node's full metrics exposition — meta's monitor merges it
+        into the cluster-wide /metrics with a worker label."""
+        from ..utils.metrics import GLOBAL_METRICS
+        return GLOBAL_METRICS.render_prometheus()
+
+    async def rpc_memory_report(self):
+        return self.coord.memory.report() if self.coord is not None else []
+
+    async def closed(self) -> None:
+        """Meta connection died: this node's actors are orphans — tear
+        everything down so the process is reusable by the next meta."""
+        for d in list(self.deployments.values()):
+            await self._teardown(d)
+        self.deployments.clear()
+        if self.coord is not None:
+            await self.coord.abort_uploads()
+        if self.monitor is not None:
+            await self.monitor.stop()
+            self.monitor = None
+
+
+async def serve_connection(reader, writer, first_msg: dict,
+                           monitor_port: int = 0) -> None:
+    """Entry from risingwave_tpu.worker: the connection's first frame was
+    a compute-node RPC request — serve the control protocol on it."""
+    node: Optional[ComputeNode] = None
+    done = asyncio.Event()
+
+    def on_closed(exc):
+        done.set()
+
+    async def handler(method, args):
+        return await node.handle(method, args)
+
+    conn = RpcConn(reader, writer, handler=handler, on_closed=on_closed)
+    host = writer.get_extra_info("sockname")[0]
+    node = ComputeNode(conn, host=host)
+    if monitor_port:
+        node.config["__monitor_port"] = monitor_port
+    conn.start(first_msg)
+    await done.wait()
+    await node.closed()
+
+
+def main(argv=None) -> None:
+    """Standalone launch: `python -m risingwave_tpu.cluster.compute_node
+    [port] [--monitor-port N]` — identical to `risingwave_tpu.worker`
+    (one listener serves both the legacy fragment protocol and the
+    cluster control plane)."""
+    from .. import worker
+    worker.main(argv)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,526 @@
+"""Meta service — worker registry, fragment placement, cluster deploys.
+
+Reference: src/meta/src/ — the meta node owns the cluster: compute nodes
+register and heartbeat (`ClusterManager`, lease-based liveness), the
+stream manager places fragments over parallel units by vnode range
+(`schedule.rs`), the `GlobalBarrierManager` injects barriers per worker
+and collects per-worker completion, and Hummock versions commit only
+after every worker's SSTs landed.
+
+`ClusterManager` here is owned by the Session once `SET cluster =
+'host:port,host:port'` runs:
+
+  * registry: one `WorkerHandle` per compute node, heartbeat pings on an
+    interval, lease expiry or connection loss fails the worker (which
+    fails in-flight barriers fast and hands the session's tick-path
+    auto-recovery a smaller live set to re-place onto);
+  * placement: fragment actor idx -> live worker (vnode bitmaps are
+    per-actor-idx, so a fragment's vnode ranges land spread across the
+    live set; after a worker death the SAME vnode-partitioned state
+    re-reads under the new placement — the rescale machinery's
+    contract);
+  * deploy: two-phase — every worker derives identical ids from the
+    pickled graph (plan/build.py `assign_graph_ids`), phase 1 opens the
+    inbound DCN receivers and reports ports, phase 2 connects senders
+    and spawns actors;
+  * checkpoint commit: the coordinator's background committer waits for
+    every worker's sealed report, then installs their SSTs into the
+    shared manifest (state/hummock.py `commit_remote`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .rpc import RpcConn
+
+# disjoint SST-id namespaces: meta allocates low ids; each worker gets a
+# 2^40 block per (generation, ordinal) so concurrent uploads over the
+# shared object store can never collide, across recoveries included
+SST_ID_BLOCK = 1 << 40
+MAX_WORKERS_PER_GEN = 64
+
+
+@dataclass
+class WorkerInfo:
+    worker_id: int
+    addr: str
+    alive: bool = True
+    pid: int = 0
+    jax_platform: str = ""
+    monitor_port: int = 0
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    lease_s: float = 10.0
+
+    @property
+    def lease_remaining_s(self) -> float:
+        return max(0.0, self.lease_s
+                   - (time.monotonic() - self.last_heartbeat))
+
+
+class WorkerHandle:
+    """Meta's live handle to one compute node."""
+
+    def __init__(self, manager: "ClusterManager", worker_id: int,
+                 addr: str):
+        self.manager = manager
+        self.worker_id = worker_id
+        self.addr = addr
+        self.info = WorkerInfo(worker_id, addr,
+                               lease_s=manager.lease_s)
+        self.conn: Optional[RpcConn] = None
+        self.failure: Optional[BaseException] = None
+        # epoch -> sealed sst ids (value) or Future (a waiter got there
+        # first); the background committer awaits these per checkpoint
+        self._sealed: dict[int, object] = {}
+
+    @property
+    def host(self) -> str:
+        return self.addr.rsplit(":", 1)[0]
+
+    async def connect(self) -> None:
+        host, _, port = self.addr.rpartition(":")
+        reader, writer = await asyncio.open_connection(host, int(port))
+        self.conn = RpcConn(
+            reader, writer,
+            handler=lambda m, a: self.manager._on_push(self, m, a),
+            on_closed=lambda exc: self.manager._on_worker_lost(self, exc))
+        self.conn.start()
+
+    async def call(self, method: str, timeout: Optional[float] = None,
+                   **args):
+        return await self.conn.call(method, timeout=timeout, **args)
+
+    async def inject(self, barrier) -> None:
+        await self.conn.push("inject", barrier=barrier)
+
+    # ------------------------------------------------------ sealed reports
+    def on_sealed(self, epoch: int, sst_ids: list) -> None:
+        cur = self._sealed.get(epoch)
+        if isinstance(cur, asyncio.Future):
+            if not cur.done():
+                cur.set_result(list(sst_ids))
+            self._sealed.pop(epoch, None)
+        else:
+            self._sealed[epoch] = list(sst_ids)
+
+    async def wait_sealed(self, epoch: int) -> list:
+        """The committer's wait for this worker's sealed report; fails
+        fast once the worker is gone (the parked error then rides the
+        coordinator's fail-stop into auto-recovery)."""
+        if self.failure is not None:
+            raise ConnectionResetError(
+                f"worker {self.worker_id} failed: {self.failure}")
+        cur = self._sealed.pop(epoch, None)
+        if cur is not None and not isinstance(cur, asyncio.Future):
+            return cur
+        fut = asyncio.get_running_loop().create_future()
+        self._sealed[epoch] = fut
+        try:
+            return await fut
+        finally:
+            self._sealed.pop(epoch, None)
+
+    def fail(self, exc: BaseException) -> None:
+        self.failure = exc
+        self.info.alive = False
+        for v in list(self._sealed.values()):
+            if isinstance(v, asyncio.Future) and not v.done():
+                v.set_exception(ConnectionResetError(
+                    f"worker {self.worker_id} failed: {exc}"))
+        self._sealed.clear()
+
+    async def close(self) -> None:
+        if self.conn is not None:
+            await self.conn.close()
+
+
+class ClusterDeployment:
+    """Meta-side record of one streaming job deployed over the cluster.
+    Duck-types the parts of plan/build.py `Deployment` the Session
+    touches (roots for the MV shadow table, stop, empty task/actor
+    lists — the real actors live in the workers)."""
+
+    def __init__(self, manager: "ClusterManager", deploy_id: int,
+                 coord, all_actor_ids: frozenset,
+                 roots: Optional[dict] = None):
+        self.manager = manager
+        self.deploy_id = deploy_id
+        self.coord = coord
+        self.all_actor_ids = all_actor_ids
+        self.roots = roots or {}
+        self.actors: list = []
+        self.tasks: list = []
+        self.source_queues: list = []
+        self.memory_names: list = []
+
+    def spawn(self) -> "ClusterDeployment":
+        return self
+
+    async def stop(self) -> None:
+        """Stop barrier over the workers' actors, then worker-side
+        cleanup. The stop checkpoint commits through the normal cluster
+        path (stop_all drains uploads), so dropped state is durable."""
+        try:
+            await self.coord.stop_all(self.all_actor_ids)
+        finally:
+            for h in self.manager.live_workers():
+                try:
+                    await h.call("stop_deployment", timeout=30,
+                                 deploy_id=self.deploy_id)
+                except Exception:  # noqa: BLE001 — dying worker: recovery owns it
+                    pass
+
+
+class _ShadowRoot:
+    """Stands in for a materialize executor at meta: carries the shared
+    vnode-partitioned MV table handle (batch SELECTs scan its COMMITTED
+    snapshot — exactly the state the cluster commit protocol makes
+    visible)."""
+
+    def __init__(self, table, schema):
+        self.table = table
+        self.schema = schema
+        self.identity = "ClusterMaterialize"
+
+
+class ClusterManager:
+    """The session's cluster authority (SET cluster = 'addr,addr')."""
+
+    def __init__(self, session, addrs: list[str],
+                 heartbeat_s: float = 2.0, lease_s: float = 45.0):
+        # lease default is generous: a compute node's event loop blocks
+        # for the duration of any single XLA compile (tens of seconds
+        # for the big join shapes on CPU), and a ping parked behind a
+        # compile is NOT a dead worker. Connection loss still detects a
+        # real death immediately — the lease only covers wedged-alive.
+        self.session = session
+        self.addrs = list(addrs)
+        self.heartbeat_s = heartbeat_s
+        self.lease_s = lease_s
+        self.workers: dict[int, WorkerHandle] = {}
+        self.generation = 0
+        self._next_deploy = 1
+        self._hb_task: Optional[asyncio.Task] = None
+
+    # ------------------------------------------------------------ registry
+    def live_workers(self) -> list[WorkerHandle]:
+        return [h for h in self.workers.values() if h.info.alive]
+
+    async def connect(self) -> None:
+        """Register every configured compute node: connect, hello (store
+        spec + SST block + config snapshot), start heartbeats, attach
+        the workers to the live coordinator."""
+        store_spec = self._store_spec()
+        self.generation += 1
+        for i, addr in enumerate(self.addrs):
+            wid = i + 1
+            h = WorkerHandle(self, wid, addr)
+            await h.connect()
+            info = await h.call(
+                "hello", timeout=60, worker_id=wid, store=store_spec,
+                sst_id_base=self._sst_base(i),
+                config=self._worker_config(len(self.addrs)))
+            h.info.pid = info.get("pid", 0)
+            h.info.jax_platform = info.get("jax_platform", "")
+            h.info.monitor_port = info.get("monitor_port", 0)
+            self.workers[wid] = h
+        self._register_with_coord()
+        if self._hb_task is None or self._hb_task.done():
+            self._hb_task = asyncio.get_running_loop().create_task(
+                self._heartbeat_loop(), name="cluster-heartbeat")
+
+    def _store_spec(self) -> dict:
+        objects = getattr(self.session.store, "objects", None)
+        root = getattr(objects, "root", None) if objects is not None \
+            else None
+        if root is None:
+            raise ValueError(
+                "cluster mode needs a durable Hummock store over a "
+                "filesystem object store shared with the workers "
+                "(Session(store=HummockStateStore(LocalFsObjectStore("
+                "path))))")
+        return {"kind": "hummock_fs", "root": root}
+
+    def _sst_base(self, ordinal: int) -> int:
+        return SST_ID_BLOCK * (
+            self.generation * MAX_WORKERS_PER_GEN + ordinal + 1)
+
+    def _worker_config(self, n_workers: int) -> dict:
+        """Session vars a compute node honors, with the cluster HBM
+        budget partitioned per worker (memory/manager.py
+        partition_budget)."""
+        from ..memory.manager import partition_budget
+        cfg = self.session.config
+        return {
+            "hbm_budget_bytes": partition_budget(
+                cfg.get("hbm_budget_bytes", 0), max(1, n_workers)),
+            "memory_eviction_policy": cfg.get("memory_eviction_policy",
+                                              "lru"),
+            "metric_level": cfg.get("metric_level", "info"),
+            "barrier_stall_threshold_ms": cfg.get(
+                "barrier_stall_threshold_ms", 60000),
+            "checkpoint_max_inflight": cfg.get("checkpoint_max_inflight",
+                                               2),
+            "streaming_chunk_coalesce": cfg.get(
+                "streaming_chunk_coalesce", 0),
+        }
+
+    def _register_with_coord(self) -> None:
+        coord = self.session.coord
+        for h in self.live_workers():
+            coord.register_worker(h)
+
+    async def push_config(self) -> None:
+        """Re-partition + forward the config-derived knobs to every live
+        worker (SET hbm_budget_bytes / metric_level / ... in cluster
+        mode applies cluster-wide)."""
+        live = self.live_workers()
+        cfg = self._worker_config(len(live))
+        for h in live:
+            try:
+                await h.call("set_config", timeout=30, config=cfg)
+            except Exception:  # noqa: BLE001 — dying worker: detector owns it
+                pass
+
+    # --------------------------------------------------- failure detection
+    def _on_worker_lost(self, handle: WorkerHandle, exc) -> None:
+        if not handle.info.alive:
+            return
+        handle.fail(exc)
+        self.session.coord.worker_failed(handle.worker_id, exc)
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.heartbeat_s)
+            for h in self.live_workers():
+                try:
+                    await h.call("ping", timeout=self.lease_s)
+                    h.info.last_heartbeat = time.monotonic()
+                except Exception as e:  # noqa: BLE001 — lease expiry
+                    self._on_worker_lost(h, e)
+
+    async def _on_push(self, handle: WorkerHandle, method: str,
+                       args: dict) -> None:
+        if method == "collected":
+            self.session.coord.collect_worker(args["worker_id"],
+                                              args["epoch"])
+        elif method == "sealed":
+            handle.on_sealed(args["epoch"], args["sst_ids"])
+        elif method == "failed":
+            # an ACTOR died on that node (often collateral: its DCN peer
+            # on a killed worker vanished) — fail the in-flight epochs so
+            # recovery runs, but the worker PROCESS is alive and will be
+            # reset + re-placed onto; only connection loss / lease expiry
+            # marks the handle itself dead. Stale reports racing an
+            # in-progress rebuild are dropped (their actors are already
+            # being torn down).
+            if not getattr(self.session, "_recovering", False):
+                self.session.coord.worker_failed(
+                    handle.worker_id,
+                    RuntimeError(args.get("error",
+                                          "worker actor failure")))
+
+    # -------------------------------------------------------------- deploy
+    def _check_supported(self, graph) -> None:
+        """Refuse plans cluster v1 cannot run correctly — loudly, at
+        deploy time, never silently wrong."""
+        from ..plan.build import (_state_table_keys,
+                                  infer_fragment_schemas)
+
+        def state_fields(n, ins):
+            """The input fields that actually LAND in the node's state
+            tables (aggs persist group keys + agg states, not their
+            whole input; joins/materialize/top-n persist full rows)."""
+            if n.kind in ("hash_agg", "simple_agg"):
+                idx = set(n.args.get("group_key_indices", ()))
+                for c in n.args.get("agg_calls", ()):
+                    a = getattr(c, "arg", None)
+                    if isinstance(a, int):
+                        idx.add(a)
+                return [ins[0][i] for i in sorted(idx)
+                        if i < len(ins[0])]
+            return [f for s in ins for f in s]
+
+        def on_node(n, ins):
+            if n.kind == "stream_scan":
+                raise ValueError(
+                    "cluster v1: MV-on-MV (stream_scan taps) is not "
+                    "supported — create the MV directly on sources")
+            if n.kind != "nexmark_source" and _state_table_keys(
+                    n.kind, n.args, None):
+                for f in state_fields(n, ins):
+                    if f.data_type.is_dict_encoded:
+                        raise ValueError(
+                            "cluster v1: dict-encoded column "
+                            f"{f.name!r} ({f.data_type.value}) in "
+                            f"{n.kind} state — per-worker string "
+                            "dictionaries are not yet reconciled "
+                            "across the shared store; project it "
+                            "away below the stateful operator")
+
+        infer_fragment_schemas(graph, on_node=on_node)
+
+    def placement(self, graph) -> dict:
+        """Fragment actor idx -> worker id, over the LIVE set: parallel
+        fragments spread contiguous vnode ranges across workers;
+        singletons round-robin by fragment id."""
+        live = sorted(h.worker_id for h in self.live_workers())
+        assert live, "no live workers"
+        out: dict = {}
+        rr = 0
+        for fid in graph.topo_order():
+            f = graph.fragments[fid]
+            if f.parallelism == 1:
+                out[fid] = [live[rr % len(live)]]
+                rr += 1
+            else:
+                out[fid] = [live[i % len(live)]
+                            for i in range(f.parallelism)]
+        return out
+
+    async def deploy(self, graph, scope: str, mv_fragment: int,
+                     want_table: bool):
+        """Two-phase cluster deploy of one planned graph. Returns the
+        ClusterDeployment (with the MV shadow root when `want_table`)."""
+        from ..plan.build import assign_graph_ids, fragment_node_order
+        from ..state.state_table import StateTable
+        self._check_supported(graph)
+        session = self.session
+        deploy_id = self._next_deploy
+        self._next_deploy += 1
+        placement = self.placement(graph)
+        actor_base = session.env._next_actor_id
+        table_base = session.env._next_table_id
+        actors, tables, next_actor, next_table = assign_graph_ids(
+            graph, actor_base, table_base)
+        # advance the session allocators past this deployment so the
+        # next job's ids stay globally unique (recovery re-floors via
+        # the DDL log exactly like the single-process path)
+        session.env._next_actor_id = next_actor
+        session.env._next_table_id = next_table
+        ddl_config = {k: session.config[k]
+                      for k in ("streaming_chunk_coalesce",)
+                      if k in session.config}
+        live = self.live_workers()
+        ports: dict = {}
+        for h in live:
+            r = await h.call("deploy_prepare", timeout=120,
+                             deploy_id=deploy_id, graph=graph,
+                             placement=placement,
+                             actor_id_base=actor_base,
+                             table_id_base=table_base,
+                             ddl_config=ddl_config, scope=scope)
+            for edge_key, port in r.items():
+                ports[edge_key] = (h.host, port)
+        for h in live:
+            await h.call("deploy_start", timeout=300,
+                         deploy_id=deploy_id, ports=ports)
+        all_ids = frozenset(a for ids in actors.values() for a in ids)
+        roots = {}
+        if want_table:
+            # shadow of the materialize state table: same deterministic
+            # table id every worker derived, read at meta over the
+            # committed manifest (vnode-complete — no bitmap)
+            frag = graph.fragments[mv_fragment]
+            mat = fragment_node_order(frag)[-1]
+            assert mat.kind == "materialize", mat.kind
+            from ..plan.build import infer_fragment_schemas
+            schemas = infer_fragment_schemas(graph)
+            sch = schemas[mv_fragment]
+            node_idx = len(fragment_node_order(frag)) - 1
+            tid = tables[mv_fragment][(mv_fragment, node_idx)]
+            table = StateTable(session.store, table_id=tid, schema=sch,
+                               pk_indices=tuple(mat.args["pk_indices"]))
+            roots[mv_fragment] = [_ShadowRoot(table, sch)]
+        return ClusterDeployment(self, deploy_id, session.coord,
+                                 all_ids, roots)
+
+    # ------------------------------------------------------------ recovery
+    async def reset_all(self) -> None:
+        """Crash path: abandon every worker's actors (stores keep their
+        uncommitted buffers until reopen)."""
+        for h in self.live_workers():
+            try:
+                await h.call("reset", timeout=60)
+            except Exception as e:  # noqa: BLE001
+                self._on_worker_lost(h, e)
+
+    async def on_recovery(self) -> None:
+        """Rebuild entry (the session swapped in a fresh coordinator):
+        prune dead workers, reset + reopen survivors' stores at the
+        committed manifest with fresh SST blocks, re-register."""
+        self.generation += 1
+        dead = [wid for wid, h in self.workers.items()
+                if not h.info.alive]
+        for wid in dead:
+            h = self.workers.pop(wid)
+            await h.close()
+        store_spec = self._store_spec()
+        for i, h in enumerate(sorted(self.live_workers(),
+                                     key=lambda x: x.worker_id)):
+            try:
+                await h.call("reset", timeout=60, store=store_spec,
+                             sst_id_base=self._sst_base(i))
+                await h.call("set_config", timeout=30,
+                             config=self._worker_config(
+                                 len(self.live_workers())))
+            except Exception as e:  # noqa: BLE001
+                self._on_worker_lost(h, e)
+        if not self.live_workers():
+            raise RuntimeError("cluster: no live workers to recover onto")
+        self._register_with_coord()
+
+    # -------------------------------------------------------- observability
+    async def scrape_all(self) -> dict[int, str]:
+        """worker_id -> that node's /metrics text (the meta monitor
+        merges them under a `worker` label — one Prometheus scrape sees
+        the whole cluster)."""
+        out = {}
+        for h in self.live_workers():
+            try:
+                out[h.worker_id] = await h.call("scrape", timeout=10)
+            except Exception:  # noqa: BLE001 — scrape never fails the plane
+                pass
+        return out
+
+    async def memory_report_all(self) -> list[dict]:
+        """Cluster-wide HBM accounting: every worker's MemoryManager
+        report with the executor labels prefixed by the owning worker."""
+        rows: list[dict] = []
+        for h in self.live_workers():
+            try:
+                for r in await h.call("memory_report", timeout=10):
+                    r = dict(r)
+                    r["executor"] = f"w{h.worker_id}/{r['executor']}"
+                    rows.append(r)
+            except Exception:  # noqa: BLE001
+                pass
+        return rows
+
+    def registry_rows(self) -> list[tuple]:
+        """SHOW cluster."""
+        rows = []
+        for wid in sorted(self.workers):
+            h = self.workers[wid]
+            rows.append((f"w{wid}", h.addr,
+                         "alive" if h.info.alive else "dead",
+                         h.info.jax_platform, str(h.info.pid),
+                         f"{h.info.lease_remaining_s:.1f}s",
+                         str(h.info.monitor_port or "")))
+        return rows
+
+    async def stop(self) -> None:
+        if self._hb_task is not None and not self._hb_task.done():
+            self._hb_task.cancel()
+            try:
+                await self._hb_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        for h in list(self.workers.values()):
+            self.session.coord.remove_worker(h.worker_id)
+            await h.close()
+        self.workers.clear()

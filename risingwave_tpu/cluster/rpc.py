@@ -1,0 +1,191 @@
+"""Control-plane RPC — the meta <-> compute-node wire.
+
+Reference: the meta/CN gRPC services (proto/stream_service.proto,
+proto/meta.proto — InjectBarrier, BarrierComplete, heartbeats). Between
+TRUSTED processes of one deployment the wire form is a length-prefixed
+pickle of plain dicts/dataclasses (the same v1 IR convention
+stream/remote_fragment.py established), multiplexed on one TCP
+connection:
+
+  {"id": n>0, "method": m, "args": {...}}   request (expects response)
+  {"id": -n,  "ok": bool, "result"/"error"} response to request n
+  {"id": 0,   "method": m, "args": {...}}   push (no response)
+
+Both sides run the same `RpcConn`: `call()` awaits a response,
+`push()` fires and forgets (barrier injection, collection reports),
+`serve()` drains inbound frames into a handler. A broken connection
+fails every pending call and fires `on_closed` — the caller's failure
+detector (worker lease expiry / meta loss), never a silent hang.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import struct
+import traceback
+from typing import Awaitable, Callable, Optional
+
+
+async def send_blob(writer: asyncio.StreamWriter, blob: bytes) -> None:
+    writer.write(struct.pack("!i", len(blob)) + blob)
+    await writer.drain()
+
+
+async def recv_blob(reader: asyncio.StreamReader) -> bytes:
+    ln = struct.unpack("!i", await reader.readexactly(4))[0]
+    return await reader.readexactly(ln)
+
+
+class RpcError(RuntimeError):
+    """Remote handler raised; message carries the remote traceback tail."""
+
+
+class RpcConn:
+    """One multiplexed control connection (either side)."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter,
+                 handler: Optional[Callable[[str, dict],
+                                            Awaitable]] = None,
+                 on_closed: Optional[Callable[[BaseException], None]] = None):
+        self._reader = reader
+        self._writer = writer
+        self._handler = handler
+        self._on_closed = on_closed
+        self._next_id = 1
+        self._pending: dict[int, asyncio.Future] = {}
+        self._wlock = asyncio.Lock()
+        self._serve_task: Optional[asyncio.Task] = None
+        self.closed = False
+
+    # ------------------------------------------------------------- sending
+    async def _send(self, msg: dict) -> None:
+        blob = pickle.dumps(msg)
+        async with self._wlock:
+            await send_blob(self._writer, blob)
+
+    async def call(self, method: str, timeout: Optional[float] = None,
+                   **args):
+        """Request/response; raises RpcError on remote failure,
+        ConnectionError if the peer goes away mid-call."""
+        if self.closed:
+            raise ConnectionResetError(f"rpc connection closed ({method})")
+        rid = self._next_id
+        self._next_id += 1
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            await self._send({"id": rid, "method": method, "args": args})
+            if timeout is not None:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        finally:
+            self._pending.pop(rid, None)
+
+    async def push(self, method: str, **args) -> None:
+        """One-way notification (barrier inject, collected/sealed
+        reports). Delivery order is TCP order."""
+        if self.closed:
+            raise ConnectionResetError(f"rpc connection closed ({method})")
+        await self._send({"id": 0, "method": method, "args": args})
+
+    # ----------------------------------------------------------- receiving
+    def start(self, first_msg: Optional[dict] = None) -> "RpcConn":
+        """Spawn the read loop. `first_msg` replays a frame the caller
+        already consumed while sniffing the protocol (worker.py serves
+        the legacy fragment protocol and this one on a single port)."""
+        self._serve_task = asyncio.create_task(
+            self._serve(first_msg), name="rpc-conn")
+        return self
+
+    async def _serve(self, first_msg: Optional[dict]) -> None:
+        exc: BaseException = ConnectionResetError("peer closed")
+        try:
+            if first_msg is not None:
+                await self._dispatch(first_msg)
+            while True:
+                msg = pickle.loads(await recv_blob(self._reader))
+                await self._dispatch(msg)
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                BrokenPipeError, OSError) as e:
+            exc = e
+        except asyncio.CancelledError:
+            exc = ConnectionResetError("rpc connection cancelled")
+        finally:
+            self.closed = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(
+                        ConnectionResetError(f"peer went away: {exc}"))
+            self._pending.clear()
+            if self._on_closed is not None:
+                try:
+                    self._on_closed(exc)
+                except Exception:  # noqa: BLE001 — detector must not kill IO
+                    pass
+            try:
+                self._writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _dispatch(self, msg: dict) -> None:
+        rid = msg.get("id", 0)
+        if rid < 0:                       # response to our call
+            fut = self._pending.get(-rid)
+            if fut is not None and not fut.done():
+                if msg.get("ok"):
+                    fut.set_result(msg.get("result"))
+                else:
+                    fut.set_exception(RpcError(msg.get("error", "remote error")))
+            return
+        method, args = msg.get("method", ""), msg.get("args", {})
+        if rid == 0:                      # push: handle inline, no reply
+            if self._handler is not None:
+                # pushes are ORDERED (inject N before inject N+1): await
+                # the handler rather than spawning, so a slow consumer
+                # backpressures through TCP instead of reordering. A
+                # push has no response channel, so a handler failure
+                # must NOT kill the read loop (e.g. an inject arriving
+                # on an already-failed local coordinator — the failure
+                # was already reported on its own path).
+                try:
+                    await self._handler(method, args)
+                except asyncio.CancelledError:
+                    raise
+                except BaseException as e:  # noqa: BLE001
+                    import sys as _s
+                    print(f"[rpc] push handler {method!r} failed: "
+                          f"{type(e).__name__}: {e}", file=_s.stderr)
+            return
+        # request: run as a task so a slow handler (graph build) never
+        # blocks barrier pushes behind it
+        asyncio.create_task(self._answer(rid, method, args),
+                            name=f"rpc-{method}")
+
+    async def _answer(self, rid: int, method: str, args: dict) -> None:
+        try:
+            result = (await self._handler(method, args)
+                      if self._handler is not None else None)
+            reply = {"id": -rid, "ok": True, "result": result}
+        except BaseException as e:  # noqa: BLE001 — ship it to the caller
+            tb = traceback.format_exc(limit=8)
+            reply = {"id": -rid, "ok": False,
+                     "error": f"{type(e).__name__}: {e}\n{tb}"}
+        try:
+            await self._send(reply)
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            pass
+
+    async def close(self) -> None:
+        self.closed = True
+        if self._serve_task is not None and not self._serve_task.done():
+            self._serve_task.cancel()
+            try:
+                await self._serve_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        try:
+            self._writer.close()
+        except Exception:  # noqa: BLE001
+            pass

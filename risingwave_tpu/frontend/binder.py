@@ -133,6 +133,25 @@ class BoundPlan:
     append_only: bool = True
 
 
+class TumbleStartTransform:
+    """Monotone watermark transform `v -> window_start(v)` as a
+    PICKLABLE callable: Node args ship to cluster compute nodes as the
+    wire IR, and a closure would refuse to pickle."""
+
+    __slots__ = ("size",)
+
+    def __init__(self, size: int):
+        self.size = size
+
+    def __call__(self, v):
+        return v - v % self.size
+
+
+class TumbleEndTransform(TumbleStartTransform):
+    def __call__(self, v):
+        return (v - v % self.size) + self.size
+
+
 class StreamPlanner:
     def __init__(self, catalog, parallelism: int = 1, config=None):
         self.catalog = catalog
@@ -224,9 +243,8 @@ class StreamPlanner:
                 node = Node("project", dict(
                     exprs=exprs, names=names,
                     watermark_transforms={
-                        i: [(len(names) - 2, lambda v, W=W: v - v % W),
-                            (len(names) - 1,
-                             lambda v, W=W: (v - v % W) + W)]}),
+                        i: [(len(names) - 2, TumbleStartTransform(W)),
+                            (len(names) - 1, TumbleEndTransform(W))]}),
                     inputs=(src_node,))
                 f = self.graph.add(Fragment(self.fid(), node,
                                             dispatch="broadcast"))

@@ -173,6 +173,15 @@ class Session:
         # than this logs format_stuck_barrier_report once and bumps
         # barrier_stalls_total; 0 disables the watchdog
         "barrier_stall_threshold_ms": (60000, int),
+        # cluster mode (cluster/): comma-separated compute-node
+        # addresses ("host:port,host:port"). Setting it attaches the
+        # session's coordinator to the workers as a meta service: every
+        # subsequent CREATE MV/SINK deploys vnode-partitioned fragments
+        # ACROSS the workers, barriers inject/collect per worker over
+        # RPC, and checkpoints commit only after all workers report
+        # sealed state. '' detaches. Requires a shared-filesystem
+        # Hummock store and streaming_durability = 1.
+        "cluster": ("", str),
     }
 
     def __init__(self, store=None):
@@ -205,6 +214,9 @@ class Session:
         self.recoveries = 0
         # monitor HTTP endpoint (SET monitor_port / start_monitor)
         self.monitor = None
+        # cluster manager (SET cluster = 'host:port,...'): when set, the
+        # session IS the meta node and deploys onto compute nodes
+        self.cluster = None
         self._apply_memory_config()
         self._apply_serving_config()
         self._apply_obs_config()
@@ -368,10 +380,15 @@ class Session:
                     e["kind"] == "mv" and e["name"] == stmt.name)]
                 # the session config the MV was planned under persists with
                 # it: recovery must rebuild the SAME capacities/tuning
-                self._ddl_log.append({"kind": "mv", "name": stmt.name,
-                                      "sql": sql_text,
-                                      "table_id_floor": floor,
-                                      "config": dict(self.config)})
+                entry = {"kind": "mv", "name": stmt.name,
+                         "sql": sql_text, "table_id_floor": floor,
+                         "config": dict(self.config)}
+                if self.cluster is not None:
+                    # cluster MVs MUST replay at their planned
+                    # parallelism: the vnode bitmaps the durable state
+                    # was partitioned under are per-actor-idx
+                    entry["parallelism"] = out.parallelism
+                self._ddl_log.append(entry)
                 self._persist_catalog()
             return out
         if isinstance(stmt, ast.AlterParallelism):
@@ -408,6 +425,9 @@ class Session:
         if isinstance(stmt, ast.ExplainMv):
             return self.explain_mv(stmt.name)
         if isinstance(stmt, ast.Show):
+            if self.cluster is not None and stmt.what in ("cluster",
+                                                          "memory"):
+                return await self._show_cluster(stmt.what)
             return self.show(stmt.what)
         if isinstance(stmt, ast.SetVar):
             if stmt.name not in self.CONFIG_VARS:
@@ -425,8 +445,12 @@ class Session:
             elif stmt.name in ("hbm_budget_bytes",
                                "memory_eviction_policy"):
                 # runtime-mutable on the live MemoryManager: enabling a
-                # budget starts LRU tracking on every deployed executor
+                # budget starts LRU tracking on every deployed executor;
+                # in cluster mode the budget is PARTITIONED across the
+                # live workers and forwarded to each
                 self._apply_memory_config()
+                if self.cluster is not None:
+                    await self.cluster.push_config()
             elif stmt.name in ("serving_max_concurrency",
                                "serving_query_timeout_ms",
                                "serving_cache"):
@@ -435,8 +459,12 @@ class Session:
             elif stmt.name in ("metric_level",
                                "barrier_stall_threshold_ms"):
                 # runtime-mutable: re-instruments live actors / adjusts
-                # the stuck-barrier watchdog
+                # the stuck-barrier watchdog (cluster-wide when attached)
                 self._apply_obs_config()
+                if self.cluster is not None:
+                    await self.cluster.push_config()
+            elif stmt.name == "cluster":
+                await self._configure_cluster(self.config[stmt.name])
             elif stmt.name == "monitor_port":
                 # 0 stops the endpoint; a port starts/moves it
                 port = self.config[stmt.name]
@@ -621,6 +649,51 @@ class Session:
                         lines.append(f"  {ex.identity}")
         return [(ln,) for ln in lines]
 
+    async def _configure_cluster(self, addrs: str) -> None:
+        """SET cluster = 'host:port,host:port' — attach this session's
+        coordinator to the compute nodes as the meta service ('' to
+        detach). Must precede any streaming DDL: a topology cannot be
+        half local, half clustered."""
+        from ..cluster.meta_service import ClusterManager
+        if self.cluster is not None:
+            await self.cluster.stop()
+            self.cluster = None
+        if not addrs.strip():
+            return
+        if self.catalog.mvs or self.catalog.sinks:
+            raise BindError(
+                "SET cluster must run before any MV/sink exists "
+                "(drop them first)")
+        if not self.config.get("streaming_durability", 1):
+            raise BindError(
+                "cluster mode requires streaming_durability = 1 "
+                "(workers flush vnode-partitioned state to the shared "
+                "store; recovery replays from the committed epoch)")
+        if self.config.get("checkpoint_max_inflight", 2) < 1:
+            # the cluster commit point is inherently asynchronous (all
+            # workers must report sealed); a zero window has no meaning
+            self.config["checkpoint_max_inflight"] = 1
+            self.coord.checkpoint_max_inflight = 1
+        mgr = ClusterManager(
+            self, [a.strip() for a in addrs.split(",") if a.strip()])
+        await mgr.connect()
+        self.cluster = mgr
+
+    async def _show_cluster(self, what: str) -> list:
+        if what == "cluster":
+            return self.cluster.registry_rows()
+        # SHOW memory, cluster-wide: the meta rows (usually none — the
+        # actors live in the workers) plus every worker's, labelled
+        rows = [(r["executor"], str(r["state_bytes"]),
+                 str(r["evicted_bytes"]), str(r["reload_count"]),
+                 str(r["spilled_rows"]))
+                for r in self.coord.memory.report()]
+        for r in await self.cluster.memory_report_all():
+            rows.append((r["executor"], str(r["state_bytes"]),
+                         str(r["evicted_bytes"]), str(r["reload_count"]),
+                         str(r["spilled_rows"])))
+        return rows
+
     def show(self, what: str) -> list:
         """SHOW <objects|variable> (reference: handler/show.rs +
         session_config reads)."""
@@ -737,6 +810,9 @@ class Session:
         from ..stream import TapDispatcher
         if table_id_floor is not None:
             self.env._next_table_id = table_id_floor
+        if self.cluster is not None:
+            return await self._create_mv_cluster(stmt, sql_text,
+                                                 parallelism)
         planner = StreamPlanner(self.catalog, parallelism=parallelism,
                                 config=self.config)
         plan = planner.plan_select(stmt.select)
@@ -787,8 +863,47 @@ class Session:
             await self.coord.run_rounds(0 if not self.coord._started else 1)
         return mv
 
+    async def _create_mv_cluster(self, stmt: ast.CreateMV,
+                                 sql_text: str,
+                                 parallelism: int) -> MvDef:
+        """CREATE MV onto the cluster: the whole graph deploys across
+        the compute nodes (vnode-partitioned fragments, cross-worker
+        exchange over the DCN tier); meta keeps only a shadow handle on
+        the MV's shared state table so batch SELECTs scan the committed
+        snapshot the cluster commit protocol publishes."""
+        n_live = len(self.cluster.live_workers())
+        if not self._recovering:
+            # fresh DDL spreads over every live worker; recovery keeps
+            # the ORIGINAL parallelism (the vnode bitmaps the durable
+            # state was written under), re-placed over the survivors
+            parallelism = max(parallelism, n_live)
+        planner = StreamPlanner(self.catalog, parallelism=parallelism,
+                                config=self.config)
+        plan = planner.plan_select(stmt.select)
+        async with self.coord._rounds_lock:
+            dep = await self.cluster.deploy(
+                plan.graph, scope=stmt.name,
+                mv_fragment=plan.mv_fragment, want_table=True)
+            mv = MvDef(stmt.name, plan.schema, plan.pk_indices, dep,
+                       self.coord, plan.mv_fragment, tap=None,
+                       sql=sql_text,
+                       append_only=getattr(plan, "append_only", False),
+                       parallelism=parallelism,
+                       sources=tuple(sorted(
+                           getattr(planner, "used_sources", ()))))
+            self.catalog.mvs[stmt.name] = mv
+            # NO serving-cache registration: the materialize changelog
+            # stays in the workers; meta serves from the committed
+            # snapshot (ROADMAP item 3's replica direction lifts this)
+        if not self._recovering:
+            await self.coord.run_rounds(0 if not self.coord._started
+                                        else 1)
+        return mv
+
     # ------------------------------------------------------------ runtime
     async def _create_sink(self, stmt, sql_text: str = "") -> "SinkDef":
+        if self.cluster is not None:
+            return await self._create_sink_cluster(stmt, sql_text)
         planner = StreamPlanner(self.catalog, config=self.config)
         plan = planner.plan_sink(stmt.select, stmt.options)
         async with self.coord._rounds_lock:
@@ -809,6 +924,25 @@ class Session:
         if not self._recovering:
             await self.coord.run_rounds(
                 0 if not self.coord._started else 1)
+        return sink
+
+    async def _create_sink_cluster(self, stmt, sql_text: str) -> "SinkDef":
+        n_live = len(self.cluster.live_workers())
+        planner = StreamPlanner(self.catalog, parallelism=n_live,
+                                config=self.config)
+        plan = planner.plan_sink(stmt.select, stmt.options)
+        async with self.coord._rounds_lock:
+            dep = await self.cluster.deploy(
+                plan.graph, scope=stmt.name,
+                mv_fragment=plan.mv_fragment, want_table=False)
+            sink = SinkDef(stmt.name, plan.schema, dep, plan.mv_fragment,
+                           sql=sql_text,
+                           sources=tuple(sorted(
+                               getattr(planner, "used_sources", ()))))
+            self.catalog.sinks[stmt.name] = sink
+        if not self._recovering:
+            await self.coord.run_rounds(0 if not self.coord._started
+                                        else 1)
         return sink
 
     async def alter_parallelism(self, name: str, n: int) -> MvDef:
@@ -937,6 +1071,12 @@ class Session:
         # monitor endpoint (if any) reads `self.coord` live, so it keeps
         # serving across the swap
         self._apply_obs_config()
+        if self.cluster is not None:
+            # prune dead workers, reset survivors (reopen their store
+            # handles at the committed manifest, fresh SST blocks) and
+            # re-attach them to the new coordinator; the DDL replay
+            # below re-places every fragment over the smaller live set
+            await self.cluster.on_recovery()
         self.catalog.mvs.clear()
         self.catalog.sinks.clear()
         log = list(self._ddl_log)
@@ -996,6 +1136,11 @@ class Session:
                     await t
                 except (asyncio.CancelledError, Exception):
                     pass
+        if self.cluster is not None:
+            # workers abandon their actors too (a real meta crash takes
+            # the control connections down and the workers self-reset;
+            # in-process crash simulation must do it explicitly)
+            await self.cluster.reset_all()
         await self.coord.abort_uploads()
 
     async def drop_all(self) -> None:
@@ -1011,6 +1156,16 @@ class Session:
         playground's exit path under --data; drop_all would erase the
         DDL log)."""
         await self.stop_monitor()
+        if self.cluster is not None:
+            for name in reversed(list(self.catalog.sinks)):
+                sink = self.catalog.sinks.pop(name)
+                await sink.deployment.stop()
+            for name in reversed(list(self.catalog.mvs)):
+                await self.catalog.mvs[name].deployment.stop()
+            self.catalog.mvs.clear()
+            await self.cluster.stop()
+            self.cluster = None
+            return
         for name in reversed(list(self.catalog.sinks)):
             sink = self.catalog.sinks.pop(name)
             await sink.deployment.stop()

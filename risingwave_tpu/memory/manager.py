@@ -102,6 +102,17 @@ class ReloadGuard:
                 del self._events[scope]
 
 
+def partition_budget(total_bytes: int, n_workers: int) -> int:
+    """Cluster HBM budget -> per-worker share (cluster/meta_service.py):
+    a cluster-level `SET hbm_budget_bytes` is an even split over the
+    live compute nodes — contiguous vnode ranges give every worker the
+    same expected state share, so an even split is the placement-
+    matched policy. 0 (accounting only) stays 0 everywhere."""
+    if total_bytes <= 0:
+        return 0
+    return max(1, int(total_bytes) // max(1, n_workers))
+
+
 class MemoryManager:
     def __init__(self, budget_bytes: int = 0, policy: str = POLICY_LRU,
                  guard_window: int = 8, guard_threshold: int = 2):

@@ -26,6 +26,7 @@ inline `store.sync()` path runs unchanged.
 from __future__ import annotations
 
 import asyncio
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -135,6 +136,19 @@ class BarrierCoordinator:
         self._m_commit = CHECKPOINT_COMMIT_SECONDS
         self._m_inflight = CHECKPOINT_INFLIGHT
         self._m_backpressure = CHECKPOINT_BACKPRESSURE_SECONDS
+        # ---- cluster mode (cluster/meta_service.py) ----
+        # worker_id -> WorkerHandle: barriers are ALSO injected over RPC
+        # into every compute node's source queues, each worker collects
+        # its own actors and reports ONCE per epoch (the per-worker
+        # injection/collection path of the reference GlobalBarrierManager);
+        # workers appear in EpochState.remaining as pseudo-actors with
+        # NEGATIVE ids (-worker_id), so collection/failure machinery is
+        # shared with the in-process path.
+        self.workers: dict[int, object] = {}
+        # compute-node side: called with (epoch, sst_ids) when this
+        # process's store finished seal+upload+local-install for an epoch
+        # — the worker's "sealed" report to meta rides it
+        self.commit_listener = None
         self.checkpoint_max_inflight = checkpoint_max_inflight
 
     # ------------------------------------------------- checkpoint pipeline
@@ -155,6 +169,11 @@ class BarrierCoordinator:
 
     @property
     def pipelined(self) -> bool:
+        # cluster mode is ALWAYS pipelined: the commit point is "all
+        # workers reported sealed", which by construction runs behind the
+        # barrier (there is no inline path across processes)
+        if self.workers:
+            return True
         return self._ckpt_max_inflight > 0 and hasattr(self.store, "seal")
 
     # -------------------------------------------------------- registration
@@ -163,6 +182,33 @@ class BarrierCoordinator:
 
     def register_actor(self, actor_id: int) -> None:
         self.actor_ids.add(actor_id)
+
+    def register_worker(self, handle) -> None:
+        """Attach a compute node (cluster mode): it participates in every
+        epoch as pseudo-actor -worker_id until removed."""
+        self.workers[handle.worker_id] = handle
+        self.actor_ids.add(-handle.worker_id)
+
+    def remove_worker(self, worker_id: int) -> None:
+        self.workers.pop(worker_id, None)
+        self.actor_ids.discard(-worker_id)
+
+    def collect_worker(self, worker_id: int, epoch: int) -> None:
+        """A compute node reports every one of ITS actors collected the
+        epoch (reference: the CN's BarrierComplete RPC)."""
+        st = self._epochs.get(epoch)
+        if st is None:
+            return
+        self.tracer.collect(epoch, -worker_id)
+        st.remaining.discard(-worker_id)
+        if not st.remaining:
+            st.done.set()
+
+    def worker_failed(self, worker_id: int, exc: BaseException) -> None:
+        """Lease expiry / connection loss: fail in-flight epochs fast,
+        exactly like an in-process actor death (the session's tick-path
+        auto-recovery then rebuilds over the surviving worker set)."""
+        self.actor_failed(-worker_id, exc)
 
     # ----------------------------------------------------------- collection
     def collect(self, actor_id: int, barrier: Barrier) -> None:
@@ -223,6 +269,42 @@ class BarrierCoordinator:
         self._ensure_watchdog()
         for q in self.source_queues:
             await q.put(barrier)
+        # per-worker injection (cluster mode): the barrier rides the
+        # control RPC into every compute node's local source queues; a
+        # send failure IS a worker failure (fail fast, then recovery)
+        for wid, handle in list(self.workers.items()):
+            try:
+                await handle.inject(barrier)
+            except Exception as e:  # noqa: BLE001 — connection-level death
+                self.worker_failed(wid, e)
+        return barrier
+
+    async def inject_remote(self, barrier: Barrier) -> Barrier:
+        """Compute-node side of cluster injection: meta already chose the
+        epoch/kind/mutation; this LocalBarrierManager role just fans the
+        barrier into ITS source queues and tracks ITS actors' collection.
+        Returns a rebased barrier whose inject timestamp is local (the
+        per-worker latency metric must not mix two monotonic clocks)."""
+        if self._failure is not None:
+            actor_id, exc = self._failure
+            raise RuntimeError(f"actor {actor_id} died") from exc
+        if self._upload_failure is not None:
+            exc = self._upload_failure
+            raise RuntimeError("checkpoint upload failed") from exc
+        barrier = Barrier(barrier.epoch, barrier.kind, barrier.mutation,
+                          (), time.monotonic_ns())
+        curr = barrier.epoch.curr
+        st = EpochState(barrier, set(self.actor_ids))
+        self._epochs[curr] = st
+        if not st.remaining:
+            # a worker hosting zero actors of the current topology still
+            # participates in the protocol (it reports collected at once)
+            st.done.set()
+        self._prev_epoch = curr
+        self.tracer.begin(curr)
+        self._ensure_watchdog()
+        for q in self.source_queues:
+            await q.put(barrier)
         return barrier
 
     # --------------------------------------------------- stuck-barrier watchdog
@@ -257,12 +339,18 @@ class BarrierCoordinator:
                     if age_ms >= thr:
                         self._stalls_reported.add(epoch)
                         self._m_stalls.inc()
+                        # stderr, NOT stdout: bench.py and the profile
+                        # gates parse this process's stdout for JSON
+                        # result lines — a multi-line diagnosis landing
+                        # there mid-measurement would corrupt the parse
+                        # (the watchdog is a diagnostic channel, and
+                        # diagnostics belong on stderr)
                         print(
                             f"[stuck barrier] epoch {epoch} in flight "
                             f"{age_ms:.0f}ms (threshold {thr:.0f}ms); "
                             f"remaining actors {sorted(st.remaining)}\n"
                             + format_stuck_barrier_report(self),
-                            flush=True)
+                            flush=True, file=sys.stderr)
             poll_s = max(0.02, min(1.0, (thr or 1000.0) / 1e3 / 8))
             await asyncio.sleep(poll_s)
 
@@ -290,22 +378,34 @@ class BarrierCoordinator:
             # this epoch may reference freshly-minted string ids, which
             # must be durable no later than the rows that carry them (an
             # orphan dict suffix after a crash is harmless — append-only,
-            # stable ids)
+            # stable ids). Manifest owner only: cluster compute nodes
+            # share the object store, and concurrent delta writers would
+            # race the log rename (their per-process dicts are local —
+            # the v1 cluster contract keeps dict-typed columns out of
+            # durable state, enforced at deploy).
             objects = getattr(self.store, "objects", None)
-            if objects is not None:
+            if objects is not None and getattr(self.store,
+                                               "manifest_owner", True):
                 from ..common.types import persist_dict_delta
                 self.dict_cursor = persist_dict_delta(
                     objects, self.dict_cursor)
             if self.pipelined:
                 # seal/upload/commit run behind the stream: the barrier
                 # completes as soon as the epoch is enqueued, so the
-                # latency below excludes the whole durable flush
+                # latency below excludes the whole durable flush. In
+                # cluster mode the same queue carries the epoch to the
+                # background committer, which waits for EVERY worker's
+                # sealed report before swapping the manifest.
                 self._enqueue_upload(barrier)
                 self.tracer.end(barrier.epoch.curr)
             else:
                 t_sync = time.monotonic_ns()
-                self.store.sync(barrier.epoch.prev)
+                res = self.store.sync(barrier.epoch.prev)
                 self.committed_epochs.append(barrier.epoch.prev)
+                if self.commit_listener is not None:
+                    self.commit_listener(
+                        barrier.epoch.prev,
+                        (res or {}).get("uncommitted_ssts", []))
                 self.tracer.end(barrier.epoch.curr,
                                 sync_ns=time.monotonic_ns() - t_sync)
         else:
@@ -404,6 +504,32 @@ class BarrierCoordinator:
                 return        # respawned by the next enqueue; no parked task
             job = self._upload_q.get_nowait()
             try:
+                if self.workers:
+                    # cluster commit: the epoch is durable once EVERY
+                    # compute node sealed + uploaded its share; only then
+                    # does meta install their SSTs and swap the manifest
+                    # (the reference's commit_epoch on meta after all CN
+                    # barrier-complete reports carry their synced SSTs)
+                    t0 = time.monotonic_ns()
+                    sst_ids: list[int] = []
+                    for handle in list(self.workers.values()):
+                        sst_ids.extend(await handle.wait_sealed(
+                            job.prev_epoch))
+                    t2 = time.monotonic_ns()
+                    self.store.commit_remote(job.prev_epoch,
+                                             sorted(sst_ids))
+                    t3 = time.monotonic_ns()
+                    self.committed_epochs.append(job.prev_epoch)
+                    self.upload_busy_ns += t3 - t0
+                    self._m_upload.observe((t2 - t0) / 1e9)
+                    self._m_commit.observe((t3 - t2) / 1e9)
+                    self.tracer.annotate(job.curr_epoch, upload_ns=t2 - t0,
+                                         commit_ns=t3 - t2)
+                    self._inflight -= 1
+                    self._m_inflight.set(self._inflight)
+                    self._slot_free.set()
+                    self._upload_q.task_done()
+                    continue
                 t0 = time.monotonic_ns()
                 for stages in store.take_deferred(job.prev_epoch):
                     for wait, cont in stages:
@@ -414,9 +540,13 @@ class BarrierCoordinator:
                 t1 = time.monotonic_ns()
                 await asyncio.to_thread(store.upload_sealed, batch)
                 t2 = time.monotonic_ns()
-                store.commit_sealed(batch)
+                res = store.commit_sealed(batch)
                 t3 = time.monotonic_ns()
                 self.committed_epochs.append(job.prev_epoch)
+                if self.commit_listener is not None:
+                    self.commit_listener(
+                        job.prev_epoch,
+                        (res or {}).get("uncommitted_ssts", []))
                 self.upload_busy_ns += t3 - t0
                 self._m_seal.observe((t1 - t0) / 1e9)
                 self._m_upload.observe((t2 - t1) / 1e9)

@@ -25,6 +25,30 @@ import json
 from typing import Optional
 
 
+def merge_worker_label(text: str, worker: str) -> str:
+    """Re-emit one compute node's exposition with a `worker` label on
+    every series, so the meta /metrics shows the whole cluster under one
+    scrape (the reference runs one exporter per node and relies on
+    Prometheus relabelling; the dependency-free monitor does the merge
+    itself). `# TYPE`/`# HELP` lines pass through — the registry dedupes
+    duplicate TYPE lines at parse time on the Prometheus side."""
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        head, _, value = line.rpartition(" ")
+        if not head:
+            out.append(line)
+            continue
+        if "{" in head:
+            name, _, rest = head.partition("{")
+            out.append(f'{name}{{worker="{worker}",{rest} {value}')
+        else:
+            out.append(f'{head}{{worker="{worker}"}} {value}')
+    return "\n".join(out)
+
+
 class MonitorService:
     def __init__(self, session, host: str = "127.0.0.1", port: int = 0):
         self._session = session          # live handle: coord may be
@@ -94,6 +118,16 @@ class MonitorService:
                     break
             try:
                 status, ctype, body = self._route(path)
+                cluster = getattr(self._session, "cluster", None)
+                if path == "/metrics" and cluster is not None:
+                    # one scrape sees the whole cluster: every live
+                    # compute node's series merged under worker="wN"
+                    # (the meta process's own series carry no label)
+                    parts = [body.rstrip("\n")]
+                    for wid, text in (await cluster.scrape_all()).items():
+                        parts.append(merge_worker_label(text.rstrip("\n"),
+                                                        f"w{wid}"))
+                    body = "\n".join(parts) + "\n"
             except Exception as e:        # a scrape must never kill us
                 status, ctype, body = (500, "text/plain",
                                        f"internal error: {e}\n")

@@ -806,3 +806,304 @@ def _build_materialize(args, inputs, ctx: ActorCtx, key):
     if args.get("conflict") is not None:
         kw["conflict"] = args["conflict"]
     return MaterializeExecutor(inputs[0], st, **kw)
+
+
+# ====================================================================
+# Cluster (multi-process) build — cluster/: meta assigns fragments to
+# compute nodes by vnode range; every process derives the SAME actor and
+# state-table ids from the pickled graph alone (no id exchange), builds
+# only its assigned actors, and cross-worker fragment edges ride the DCN
+# tier (stream/remote_exchange.py).
+# ====================================================================
+
+def fragment_node_order(frag: Fragment) -> list:
+    """The fragment's Node tree in the builder's visit order (post-order,
+    inputs first — the order `build_node` constructs executors and the
+    order builders request state-table ids). Exchange leaves excluded.
+    Deterministic across processes: it depends only on tree SHAPE, which
+    pickling preserves."""
+    out = []
+
+    def rec(n):
+        if isinstance(n, Exchange):
+            return
+        for i in n.inputs:
+            rec(i)
+        out.append(n)
+
+    rec(frag.root)
+    return out
+
+
+def _state_table_keys(kind: str, args: dict, key) -> list:
+    """The exact `ctx.table_id(...)` keys the registered builder for
+    `kind` will request, in request order — the single source of truth
+    the deterministic pre-assigner shares with the builders above."""
+    durable = bool(args.get("durable"))
+    if kind in ("nexmark_source", "hash_agg", "group_top_n",
+                "general_over_window", "dedup", "simple_agg",
+                "retract_top_n"):
+        return [key] if durable else []
+    if kind in ("hash_join", "sorted_join", "eowc_over_window",
+                "snapshot_join_agg"):
+        return [(key, 0), (key, 1)] if durable else []
+    if kind == "stream_scan":
+        return [key] if args.get("durable", True) else []
+    if kind == "materialize":
+        return [key]
+    return []
+
+
+def assign_graph_ids(graph: StreamGraph, actor_id_base: int,
+                     table_id_base: int):
+    """Deterministically derive every actor id and state-table id of a
+    graph from the graph alone: fragments in topo order, nodes in builder
+    visit order, actors idx-ordered within a fragment. Meta and every
+    compute node run this on the same pickled graph and agree on all ids
+    without exchanging them (ids must agree — vnode-partitioned state
+    tables are SHARED across workers, and stop mutations name global
+    actor ids).
+
+    Returns (actors, tables, next_actor_id, next_table_id) where
+    `actors[fid]` is the fragment's actor-id list and `tables[fid]` the
+    prefilled `ActorCtx.table_ids` dict (keys are (fid, node_idx)-based,
+    matching what the partial build passes to builders)."""
+    next_actor = actor_id_base
+    next_table = table_id_base
+    actors: dict[int, list[int]] = {}
+    tables: dict[int, dict] = {}
+    for fid in graph.topo_order():
+        f = graph.fragments[fid]
+        actors[fid] = list(range(next_actor, next_actor + f.parallelism))
+        next_actor += f.parallelism
+        tab: dict = {}
+        for idx, n in enumerate(fragment_node_order(f)):
+            for k in _state_table_keys(n.kind, n.args, (fid, idx)):
+                tab[k] = next_table
+                next_table += 1
+        tables[fid] = tab
+    return actors, tables, next_actor, next_table
+
+
+def infer_fragment_schemas(graph: StreamGraph,
+                           on_node=None) -> dict[int, Schema]:
+    """Planner-level output schema of EVERY fragment without building a
+    single executor — what a compute node needs to wire exchange
+    receivers for fragments built on OTHER nodes. Mirrors each
+    executor's own schema computation; kinds without a rule refuse
+    cluster deploy loudly instead of guessing. `on_node(node, input_
+    schemas)` is a per-node hook (the cluster deploy's supported-plan
+    checks ride it)."""
+    out: dict[int, Schema] = {}
+
+    def node_schema(n, fid) -> Schema:
+        if isinstance(n, Exchange):
+            return out[n.upstream]
+        ins = [node_schema(i, fid) for i in n.inputs]
+        if on_node is not None:
+            on_node(n, ins)
+        k, a = n.kind, n.args
+        if k == "nexmark_source":
+            conn = a.get("connector", "nexmark")
+            if conn == "jsonl":
+                from ..connectors.file_source import parse_columns
+                return parse_columns(a["columns"])
+            if conn == "tpch":
+                from ..connectors.tpch import TPCH_SCHEMAS
+                return TPCH_SCHEMAS[a["table"]]
+            from ..connectors.nexmark import (AUCTION_SCHEMA, BID_SCHEMA,
+                                              PERSON_SCHEMA)
+            return {"bid": BID_SCHEMA, "person": PERSON_SCHEMA,
+                    "auction": AUCTION_SCHEMA}[a["table"]]
+        if k == "project":
+            names = a.get("names") or [f"expr{i}"
+                                       for i in range(len(a["exprs"]))]
+            return Schema(tuple(SchemaField(nm, e.ret_type)
+                                for nm, e in zip(names, a["exprs"])))
+        if k in ("filter", "no_op", "dedup", "group_top_n",
+                 "retract_top_n", "materialize", "sink", "dynamic_filter"):
+            return ins[0]
+        if k == "row_id_gen":
+            return Schema(tuple(ins[0])
+                          + (SchemaField("_row_id", DataType.SERIAL),))
+        if k == "hop_window":
+            full = list(ins[0]) + [
+                SchemaField("window_start", DataType.TIMESTAMP),
+                SchemaField("window_end", DataType.TIMESTAMP)]
+            oi = a.get("output_indices")
+            idx = tuple(oi) if oi is not None else tuple(range(len(full)))
+            return Schema(tuple(full[i] for i in idx))
+        if k == "hash_agg":
+            gk = list(a["group_key_indices"])
+            names = list(a.get("group_key_names")
+                         or [ins[0][i].name for i in gk])
+            return Schema(tuple(
+                [SchemaField(nm, ins[0][i].data_type)
+                 for nm, i in zip(names, gk)]
+                + [SchemaField(f"agg{j}", c.ret_type)
+                   for j, c in enumerate(a["agg_calls"])]))
+        if k in ("simple_agg", "stateless_simple_agg"):
+            return Schema(tuple(SchemaField(f"agg{j}", c.ret_type)
+                                for j, c in enumerate(a["agg_calls"])))
+        if k in ("hash_join", "sorted_join"):
+            fields = tuple(ins[0]) + tuple(ins[1])
+            oi = a.get("output_indices")
+            if oi is not None:
+                fields = tuple(fields[i] for i in oi)
+            return Schema(fields)
+        if k == "snapshot_join_agg":
+            return Schema(tuple(SchemaField(nm, t) for nm, t in
+                                zip(a["out_names"], a["out_types"])))
+        raise NotImplementedError(
+            f"cluster deploy: no schema rule for node kind {k!r}")
+
+    for fid in graph.topo_order():
+        out[fid] = node_schema(graph.fragments[fid].root, fid)
+    return out
+
+
+def cluster_remote_edges(graph: StreamGraph, placement: dict):
+    """All cross-worker (edge, producer actor, consumer actor) pairs:
+    [((up_fid, down_fid, edge_k, u, d), up_worker, down_worker)].
+    Deterministic order — both endpoints derive the same pair list."""
+    pairs = []
+    for fid in graph.topo_order():
+        f = graph.fragments[fid]
+        for d_fid, k in graph.consumers(fid):
+            d = graph.fragments[d_fid]
+            for u in range(f.parallelism):
+                for di in range(d.parallelism):
+                    if f.dispatch == "simple" and f.parallelism > 1 \
+                            and u != di:
+                        continue          # NoShuffle pairs 1:1
+                    uw = placement[fid][u]
+                    dw = placement[d_fid][di]
+                    if uw != dw:
+                        pairs.append(((fid, d_fid, k, u, di), uw, dw))
+    return pairs
+
+
+def build_partial_graph(graph: StreamGraph, env: BuildEnv,
+                        placement: dict, my_worker: int,
+                        actors: dict, tables: dict,
+                        schemas: dict[int, Schema],
+                        remote_ins: dict, remote_outs: dict) -> Deployment:
+    """Compute-node side of `LocalStreamManager::build_actors`: build and
+    spawn ONLY the actors `placement` assigns to `my_worker`, with the
+    pre-derived global ids (`assign_graph_ids`) and with cross-worker
+    exchange legs resolved to the DCN endpoints the caller prepared
+    (`remote_ins[(up,down,k,u,d)]` = recv()-able channel from a remote
+    producer; `remote_outs[...]` = connected RemoteOutput to a remote
+    consumer). Local legs use ordinary bounded channels exactly like
+    `build_graph`."""
+    env.pending_source_queues = []
+    dep = Deployment(coord=env.coord)
+    channels: dict[tuple[int, int, int], dict] = {}
+    order = graph.topo_order()
+    consumers = {fid: graph.consumers(fid) for fid in order}
+
+    # local-local channel matrix entries only (sparse dict by (u, d))
+    for fid in order:
+        f = graph.fragments[fid]
+        for d_fid, k in consumers[fid]:
+            d = graph.fragments[d_fid]
+            mat: dict = {}
+            for u in range(f.parallelism):
+                for di in range(d.parallelism):
+                    if placement[fid][u] == my_worker \
+                            and placement[d_fid][di] == my_worker:
+                        mat[(u, di)] = Channel(env.channel_capacity)
+            channels[(fid, d_fid, k)] = mat
+
+    def edge_chan(up_fid, fid, k, u, di):
+        """Channel-like the consumer (fid actor di, local) reads for
+        producer actor u of up_fid — a local Channel or a remote leg."""
+        if placement[up_fid][u] == my_worker:
+            return channels[(up_fid, fid, k)][(u, di)]
+        return remote_ins[(up_fid, fid, k, u, di)]
+
+    for fid in order:
+        f = graph.fragments[fid]
+        dep.roots[fid] = []
+        frag_tables = tables[fid]
+        for idx in range(f.parallelism):
+            if placement[fid][idx] != my_worker:
+                continue
+            bitmaps = (shard_vnode_bitmaps(f.parallelism)
+                       if f.parallelism > 1 else [None])
+            actor_id = actors[fid][idx]
+            ctx = ActorCtx(env=env, fragment=f, actor_id=actor_id,
+                           actor_idx=idx, vnode_bitmap=bitmaps[idx],
+                           table_ids=frag_tables)
+            edge_seen: dict[int, int] = {}
+            node_idx = {id(n): i
+                        for i, n in enumerate(fragment_node_order(f))}
+
+            def build_node(n):
+                if isinstance(n, Exchange):
+                    k = edge_seen.get(n.upstream, 0)
+                    edge_seen[n.upstream] = k + 1
+                    up = graph.fragments[n.upstream]
+                    sch = schemas[n.upstream]
+                    stop_on = (lambda b, aid=ctx.actor_id: b.is_stop(aid))
+                    co = env.chunk_coalesce_max
+                    if up.dispatch == "simple" and up.parallelism > 1:
+                        return ChannelInput(
+                            edge_chan(n.upstream, fid, k, idx, idx), sch,
+                            stop_on=stop_on, coalesce_max=co)
+                    chans = [edge_chan(n.upstream, fid, k, u, idx)
+                             for u in range(up.parallelism)]
+                    if len(chans) == 1:
+                        return ChannelInput(chans[0], sch, stop_on=stop_on,
+                                            coalesce_max=co)
+                    return MergeExecutor(chans, sch, stop_on=stop_on,
+                                         coalesce_max=co)
+                inputs = [build_node(i) for i in n.inputs]
+                return BUILDERS[n.kind](dict(n.args), inputs, ctx,
+                                        (fid, node_idx[id(n)]))
+
+            root = build_node(f.root)
+            dep.roots[fid].append(root)
+            _register_memory(dep, env, root, actor_id)
+            dispatcher = _cluster_dispatcher(graph, f, consumers[fid],
+                                             channels, placement,
+                                             my_worker, remote_outs, idx)
+            env.coord.register_actor(actor_id)
+            actor = Actor(actor_id, root, dispatcher, env.coord)
+            dep.actors.append(actor)
+            env.coord.stats.register(env.memory_scope or "flow",
+                                     actor, root)
+    dep.source_queues = list(env.pending_source_queues)
+    return dep
+
+
+def _cluster_dispatcher(graph, f, cons, channels, placement, my_worker,
+                        remote_outs, idx):
+    """Output dispatcher for LOCAL actor `idx` of fragment `f`: per
+    consumer-actor targets are local channels or connected RemoteOutputs
+    (both are awaitable `send(msg)` sinks, so the dispatchers are
+    agnostic)."""
+    if not cons:
+        return None
+    per_consumer = []
+    for d_fid, k in cons:
+        d = graph.fragments[d_fid]
+
+        def target(di):
+            if placement[d_fid][di] == my_worker:
+                return channels[(f.fid, d_fid, k)][(idx, di)]
+            return remote_outs[(f.fid, d_fid, k, idx, di)]
+
+        if f.dispatch == "hash":
+            outs = [target(di) for di in range(d.parallelism)]
+            per_consumer.append(HashDispatcher(
+                outs, f.dist_key_indices, vnode_to_shard(d.parallelism)))
+        elif f.dispatch == "broadcast":
+            per_consumer.append(BroadcastDispatcher(
+                [target(di) for di in range(d.parallelism)]))
+        else:
+            assert d.parallelism == f.parallelism, \
+                "simple dispatch is 1:1 (NoShuffle)"
+            per_consumer.append(SimpleDispatcher(target(idx)))
+    return (per_consumer[0] if len(per_consumer) == 1
+            else FanoutDispatcher(per_consumer))

@@ -88,8 +88,22 @@ class HummockStateStore(StateStore):
         self._l1: Optional[SsTable] = None
         self._next_sst_id = 1
         self._committed_epoch = 0
+        # Cluster mode (cluster/): compute-node handles share this object
+        # store but NEVER own the manifest — the meta handle is the single
+        # writer (reference: only meta commits Hummock versions). A
+        # non-owner installs its own SSTs into its local L0 for
+        # read-through, skips the manifest swap, and never compacts
+        # (compaction rewrites + deletes objects the manifest references).
+        self.manifest_owner = True
         if object_store.exists(MANIFEST_PATH):
             self._load_manifest()
+
+    def set_sst_id_block(self, base: int) -> None:
+        """Give this handle a disjoint SST-id namespace (cluster compute
+        nodes): ids allocated by concurrent worker handles over one shared
+        object store must never collide, so meta hands each worker a
+        high block per deployment generation."""
+        self._next_sst_id = max(self._next_sst_id, base)
 
     # ------------------------------------------------------------ manifest
     def _load_manifest(self) -> None:
@@ -235,6 +249,13 @@ class HummockStateStore(StateStore):
             new_ids.append(batch.sst_id)
         self._sealed.pop(0)
         self._committed_epoch = max(self._committed_epoch, batch.seal_epoch)
+        if not self.manifest_owner:
+            # compute-node handle: the local L0 install above gives this
+            # worker read-through to its own flushed state; the COMMIT
+            # POINT (manifest swap) belongs to meta, which installs these
+            # SSTs via commit_remote only after every worker reported
+            # sealed. No compaction either — meta owns object lifetime.
+            return {"uncommitted_ssts": new_ids}
         obsolete: list[int] = []
         if len(self._l0) > self.L0_COMPACT_THRESHOLD:
             obsolete = self._compact()
@@ -243,6 +264,27 @@ class HummockStateStore(StateStore):
         for sst_id in obsolete:
             self.objects.delete(_sst_path(sst_id))
         return {"uncommitted_ssts": new_ids}
+
+    def commit_remote(self, epoch: int, sst_ids: list[int]) -> None:
+        """Meta-side commit of a cluster checkpoint: install the SSTs
+        every compute node uploaded for `epoch` (disjoint key ranges —
+        the state is vnode-partitioned) into L0 and swap the manifest.
+        Called strictly in epoch order by the coordinator's background
+        committer, and ONLY after all workers reported sealed — the
+        cluster generalization of `commit_sealed`'s commit point."""
+        assert self.manifest_owner, "only the meta handle commits"
+        assert epoch > self._committed_epoch, \
+            f"cluster commit out of order ({epoch} <= {self._committed_epoch})"
+        for sst_id in sst_ids:
+            self._l0.insert(
+                0, SsTable.parse(sst_id, self.objects.read(_sst_path(sst_id))))
+        self._committed_epoch = epoch
+        obsolete: list[int] = []
+        if len(self._l0) > self.L0_COMPACT_THRESHOLD:
+            obsolete = self._compact()
+        self._write_manifest()
+        for sst_id in obsolete:
+            self.objects.delete(_sst_path(sst_id))
 
     def sync(self, epoch: int) -> dict:
         """Inline composition of the pipeline: run any deferred executor

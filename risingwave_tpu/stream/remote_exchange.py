@@ -239,6 +239,37 @@ class RemoteInput(Executor):
             self._server.close()
             await self._server.wait_closed()
 
+    async def recv(self):
+        """Channel-compatible receive — the cluster partial build
+        (plan/build.py build_partial_graph) wires a RemoteInput as ONE
+        LEG of a ChannelInput/MergeExecutor, next to local channels.
+        Identical decode to execute(); a vanished peer raises (the
+        actor's failure report is the cluster's failure detector)."""
+        from ..common.types import DataType
+        while True:
+            tag, payload = await self._queue.get()
+            if tag == b"X":
+                raise ConnectionResetError(
+                    "remote exchange producer went away")
+            if tag == b"C":
+                chunk = _payload_chunk(payload, self.schema, self.capacity)
+                if self._conn_writer is not None:
+                    try:
+                        await _write_frame(self._conn_writer, b"K",
+                                           struct.pack("!I", 1))
+                    except (ConnectionResetError, BrokenPipeError, OSError):
+                        self._conn_writer = None
+                return chunk
+            if tag == b"B":
+                d = json.loads(payload)
+                return Barrier(EpochPair(d["curr"], d["prev"]),
+                               BarrierKind(d["kind"]),
+                               mutation=_de_mutation(d["mutation"]))
+            if tag == b"W":
+                d = json.loads(payload)
+                return Watermark(d["col_idx"], DataType[d["dtype"]],
+                                 d["val"])
+
     async def execute(self):
         from ..common.types import DataType
         while True:

@@ -1,15 +1,26 @@
-"""Fragment worker — a second-process host executing shipped fragments.
+"""Worker — the compute-node process of the deployment.
 
 Reference: the compute node role (compute/src/server.rs:86): it receives
 plan fragments from the control plane, builds executors through the same
-from_proto registry, and exchanges data with peers. This worker accepts
-a control connection per fragment (stream/remote_fragment.py ships the
-pickled Node subtree — trusted-deployment IR, the reference's protobuf
-equivalent), serves the fragment's inputs as DCN RemoteInput endpoints,
-runs the executor chain, and streams everything back on a RemoteOutput.
+from_proto registry, and exchanges data with peers.
 
-Run: python -m risingwave_tpu.worker [port]     (0 = ephemeral; the
-chosen port prints as the first stdout line for orchestration).
+One listener serves TWO protocols, selected by the connection's first
+frame:
+
+  * legacy fragment offload (stream/remote_fragment.py): a pickled spec
+    dict ships ONE Node subtree; the worker runs it as an identity-less
+    proxied child and streams everything back — kept for v1 remote
+    fragments (`SET streaming_fragment_worker`);
+  * the cluster control plane (cluster/compute_node.py): the first frame
+    is an RPC request (`hello`), after which this process is a
+    FIRST-CLASS compute node — it registers with meta, builds and OWNS
+    its assigned actors over vnode-partitioned fragments, runs a local
+    barrier manager, seals + uploads its own state, and serves its own
+    /metrics.
+
+Run: python -m risingwave_tpu.worker [port] [--monitor-port N]
+(port 0 = ephemeral; the chosen port prints as the first stdout line
+for orchestration).
 """
 
 from __future__ import annotations
@@ -57,6 +68,9 @@ class _StubCoord:
         pass
 
 
+_MONITOR_PORT = 0        # set by main(); workers have ONE listener
+
+
 async def _handle(reader, writer) -> None:
     from .common.types import Schema  # noqa: F401  (pickle needs types)
     from .plan.build import BUILDERS, ActorCtx, BuildEnv
@@ -70,6 +84,13 @@ async def _handle(reader, writer) -> None:
         spec = pickle.loads(await _recv_blob(reader))
     except (asyncio.IncompleteReadError, ConnectionResetError):
         writer.close()
+        return
+    if isinstance(spec, dict) and "method" in spec:
+        # cluster control plane: this connection IS meta — promote the
+        # process to a first-class compute node for its lifetime
+        from .cluster.compute_node import serve_connection
+        await serve_connection(reader, writer, spec,
+                               monitor_port=_MONITOR_PORT)
         return
     ins = []
     for sch in spec["in_schemas"]:
@@ -121,9 +142,20 @@ async def serve(port: int = 0, host: str = "127.0.0.1"):
 
 
 def main(argv=None) -> None:
+    global _MONITOR_PORT
     argv = sys.argv[1:] if argv is None else argv
-    port = int(argv[0]) if argv else 0
+    args = list(argv)
+    if "--monitor-port" in args:
+        i = args.index("--monitor-port")
+        _MONITOR_PORT = int(args[i + 1])
+        del args[i:i + 2]
+    port = int(args[0]) if args else 0
     _pin_jax_platform()
+    # cluster compute nodes compile the same per-shape programs the
+    # coordinator does: share the persistent compilation cache so a
+    # worker restarted by recovery starts hot
+    from .utils.compile_cache import enable_persistent_cache
+    enable_persistent_cache()
     asyncio.run(serve(port))
 
 

@@ -1,0 +1,176 @@
+"""CI gate: 2-worker cluster runs the canned q7 shape correctly and
+within a bounded barrier-latency multiple of single-process.
+
+Deploys the q7 MV (shared bid source, tumble MAX agg, interval join)
+over a meta session + 2 compute-node processes with vnode-partitioned
+fragments, paces the same number of barrier rounds in cluster and
+single-process mode, and REQUIRES:
+
+  * identical committed source offsets and BIT-IDENTICAL MV contents
+    (the cluster run must be indistinguishable from the single-process
+    oracle);
+  * a worker registry with 2 alive leases;
+  * cluster barrier p50 within `MAX_P50_MULTIPLE` of single-process
+    (the per-worker RPC injection/collection path and the DCN exchange
+    add latency; they must not add an order of magnitude);
+  * completion within the hard deadline (a stalled cluster — lost
+    collection, deadlocked commit — fails loudly, rc != 0).
+
+Prints one JSON line; exit code 0 iff every gate holds.
+"""
+
+import asyncio
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from risingwave_tpu.utils.compile_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
+ROUNDS = 8
+MAX_P50_MULTIPLE = 10.0
+HARD_DEADLINE_S = 420.0
+
+W = 10_000_000
+DDL = [
+    ("CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+     "chunk_size=256, splits=2, rate_limit=512, inter_event_us=250, "
+     f"emit_watermarks=1, watermark_lag_us={2 * W})"),
+    ("CREATE MATERIALIZED VIEW q7 AS "
+     "SELECT B.auction, B.price, B.bidder, B.date_time "
+     "FROM bid B JOIN ("
+     "  SELECT max(price) AS maxprice, window_end "
+     f"  FROM TUMBLE(bid, date_time, {W}) GROUP BY window_end) B1 "
+     "ON B.price = B1.maxprice "
+     f"AND B.date_time > B1.window_end - {W} "
+     "AND B.date_time <= B1.window_end"),
+]
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def spawn_worker(port: int) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS",
+                                                   "cpu"))
+    p = subprocess.Popen(
+        [sys.executable, "-m", "risingwave_tpu.worker", str(port)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=1).close()
+            return p
+        except OSError:
+            time.sleep(0.2)
+    p.terminate()
+    raise RuntimeError("worker never started listening")
+
+
+async def run_once(ports):
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.state import (HummockStateStore,
+                                      LocalFsObjectStore)
+    root = tempfile.mkdtemp(prefix="cluster_profile_")
+    s = Session(store=HummockStateStore(LocalFsObjectStore(root)))
+    workers = 0
+    if ports:
+        addr = ",".join(f"127.0.0.1:{p}" for p in ports)
+        await s.execute(f"SET cluster = '{addr}'")
+        workers = len(await s.execute("SHOW cluster"))
+    for d in DDL:
+        await s.execute(d)
+    for _ in range(ROUNDS):
+        await s.tick()
+    rows = sorted(s.query(
+        "SELECT auction, price, bidder, date_time FROM q7"))
+    p50 = s.coord.barrier_latency_percentile(0.5)
+    # committed split offsets (source state table over the meta handle)
+    from risingwave_tpu.common.types import DataType, Field, Schema
+    from risingwave_tpu.state.state_table import StateTable
+    from risingwave_tpu.state.storage_table import StorageTable
+    sch = Schema((Field("split_id", DataType.INT64),
+                  Field("offset", DataType.INT64)))
+    offsets = {}
+    for tid in range(1, 40):
+        st = StateTable(s.store, table_id=tid, schema=sch,
+                        pk_indices=(0,))
+        try:
+            rws = list(StorageTable.for_state_table(st).batch_iter())
+        except Exception:  # noqa: BLE001
+            continue
+        if rws and all(len(r) == 2 for r in rws) \
+                and {r[0] for r in rws} <= {0, 1}:
+            offsets = {int(k): int(v) for k, v in rws}
+            break
+    await s.shutdown()
+    return dict(rows=rows, p50=p50, offsets=offsets, workers=workers)
+
+
+async def main_async() -> dict:
+    ports = [free_port(), free_port()]
+    procs = [spawn_worker(p) for p in ports]
+    try:
+        cluster = await asyncio.wait_for(run_once(ports),
+                                         HARD_DEADLINE_S * 0.6)
+        single = await asyncio.wait_for(run_once([]),
+                                        HARD_DEADLINE_S * 0.35)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+    identical = cluster["rows"] == single["rows"]
+    same_offsets = (cluster["offsets"] == single["offsets"]
+                    and bool(cluster["offsets"]))
+    ratio = (cluster["p50"] / single["p50"]
+             if single["p50"] > 0 else float("inf"))
+    ok = (identical and same_offsets and bool(cluster["rows"])
+          and cluster["workers"] == 2 and ratio <= MAX_P50_MULTIPLE)
+    return {
+        "metric": "cluster_q7_gate",
+        "ok": ok,
+        "workers": cluster["workers"],
+        "rows": len(cluster["rows"]),
+        "bit_identical": identical,
+        "offsets_match": same_offsets,
+        "cluster_barrier_p50_s": round(cluster["p50"], 4),
+        "single_barrier_p50_s": round(single["p50"], 4),
+        "p50_multiple": round(ratio, 2),
+        "max_p50_multiple": MAX_P50_MULTIPLE,
+    }
+
+
+def main() -> int:
+    t0 = time.time()
+    try:
+        out = asyncio.run(asyncio.wait_for(main_async(),
+                                           HARD_DEADLINE_S))
+    except Exception as e:  # noqa: BLE001 — a stall IS a failure
+        print(json.dumps({"metric": "cluster_q7_gate", "ok": False,
+                          "error": f"{type(e).__name__}: {e}",
+                          "seconds": round(time.time() - t0, 1)}),
+              flush=True)
+        return 1
+    out["seconds"] = round(time.time() - t0, 1)
+    print(json.dumps(out), flush=True)
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,13 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# persistent XLA compilation cache (utils/compile_cache.py): the
+# gate re-runs a canned shape every CI round — repeat runs skip the
+# compile entirely
+from risingwave_tpu.utils.compile_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import numpy as np
