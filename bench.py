@@ -656,11 +656,24 @@ async def bench_q17(progress: dict) -> None:
         progress["rounds"] = rounds
         progress["barrier_p50_s"] = s.coord.barrier_latency_percentile(0.5)
     progress["seconds"] = time.perf_counter() - t0
+    # Quiesce BEFORE the error-counter fetch (root cause of the r05/r06
+    # q17 "Array has been deleted with shape=int32[3]" note): without a
+    # Pause, the sources keep free-running after the measured region, the
+    # event loop keeps appending — and every `_append_fact` DONATES the
+    # executor's `_errs` buffer. The worker thread below would grab
+    # `j._errs` and lose the race: jax deletes the donated array before
+    # `np.asarray` materializes it. After the Pause barrier collects, no
+    # chunk (hence no donation) is in flight, so the refs are stable.
+    _phase(progress, "quiesce")
+    from risingwave_tpu.stream.message import PauseMutation
+    b = await s.coord.inject_barrier(mutation=PauseMutation())
+    await s.coord.wait_collected(b)
     _phase(progress, "teardown")
+    errs_refs = [j._errs for j in fused]
     try:
         errs = await asyncio.wait_for(
             asyncio.to_thread(lambda: [
-                int(x) for j in fused for x in np.asarray(j._errs)]),
+                int(x) for a in errs_refs for x in np.asarray(a)]),
             timeout=15.0)
         progress["state_errs_checked"] = True
         if any(errs):
@@ -808,7 +821,16 @@ def _one_query_main(query: str) -> None:
         asyncio.run(QUERIES[query](progress))
         progress.setdefault("clean_exit", True)
     except Exception as e:  # noqa: BLE001 — a number beats a stack trace
-        note = f"error: {type(e).__name__}: {e}"
+        # ... but the raise SITE costs nothing and names the culprit
+        # (the r06 q17 "Array has been deleted" hunt burned a round on a
+        # note with no frame)
+        import traceback as _tb
+        frames = [f for f in _tb.extract_tb(e.__traceback__)
+                  if "risingwave_tpu" in (f.filename or "")
+                  or "bench.py" in (f.filename or "")]
+        at = (f" @ {os.path.basename(frames[-1].filename)}:"
+              f"{frames[-1].lineno} {frames[-1].name}" if frames else "")
+        note = f"error: {type(e).__name__}: {e}{at}"
         progress["clean_exit"] = False
     for t in timers:
         t.cancel()

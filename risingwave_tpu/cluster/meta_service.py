@@ -349,7 +349,23 @@ class ClusterManager:
             if n.kind == "stream_scan":
                 raise ValueError(
                     "cluster v1: MV-on-MV (stream_scan taps) is not "
-                    "supported — create the MV directly on sources")
+                    "supported — create the MV directly on sources, or "
+                    "feed the consumer from a changelog subscription "
+                    "(logstore/subscription.py, the serving-replica "
+                    "path)")
+            if n.kind == "sink" and int(n.args.get("exactly_once", 0)):
+                # a compute node's store handle never owns the manifest,
+                # so it cannot observe meta's commit point — the
+                # exactly-once log-store delivery (logstore/log.py) is
+                # meta-local in v1. Cluster sinks deliver directly at
+                # the barrier (at-least-once with per-epoch atomicity);
+                # refuse the stronger contract instead of degrading it
+                # silently.
+                raise ValueError(
+                    "cluster v1: exactly_once sinks are not supported "
+                    "(workers cannot observe the meta commit point); "
+                    "omit exactly_once or deploy the sink on the meta "
+                    "session")
             if n.kind != "nexmark_source" and _state_table_keys(
                     n.kind, n.args, None):
                 for f in state_fields(n, ins):

@@ -189,3 +189,23 @@ class RpcConn:
             self._writer.close()
         except Exception:  # noqa: BLE001
             pass
+
+
+async def start_rpc_server(handler_factory, host: str = "127.0.0.1",
+                           port: int = 0):
+    """Serve this control-plane protocol on a listening socket: each
+    accepted connection gets its own `RpcConn`. `handler_factory(conn)`
+    returns `(handler, on_closed)` — the conn is constructed first so
+    handlers can push back on it (the subscription server's changelog
+    stream, logstore/subscription.py, is the first user; the serving
+    replica's lookup endpoint is the second). Returns the
+    asyncio.Server; the bound port is
+    `server.sockets[0].getsockname()[1]`."""
+    async def on_conn(reader, writer):
+        conn = RpcConn(reader, writer)
+        handler, on_closed = handler_factory(conn)
+        conn._handler = handler
+        conn._on_closed = on_closed
+        conn.start()
+
+    return await asyncio.start_server(on_conn, host=host, port=port)

@@ -169,6 +169,11 @@ class Session:
         # monitor HTTP endpoint (meta/monitor_service.py): /metrics,
         # /healthz, /debug/traces, /debug/await_tree. 0 = off (default)
         "monitor_port": (0, int),
+        # changelog subscription endpoint (logstore/subscription.py):
+        # serving replicas connect here over the control-plane wire,
+        # subscribe to an MV's changelog with backfill-then-tail, and
+        # answer point lookups from their own snapshot cache. 0 = off.
+        "subscription_port": (0, int),
         # stuck-barrier watchdog threshold: an in-flight epoch older
         # than this logs format_stuck_barrier_report once and bumps
         # barrier_stalls_total; 0 disables the watchdog
@@ -214,6 +219,10 @@ class Session:
         self.recoveries = 0
         # monitor HTTP endpoint (SET monitor_port / start_monitor)
         self.monitor = None
+        # changelog subscription endpoint (SET subscription_port /
+        # start_subscription_server); reads self.coord live, so it
+        # serves across auto-recovery coordinator swaps
+        self.subscriptions = None
         # cluster manager (SET cluster = 'host:port,...'): when set, the
         # session IS the meta node and deploys onto compute nodes
         self.cluster = None
@@ -258,6 +267,22 @@ class Session:
         if self.monitor is not None:
             await self.monitor.stop()
             self.monitor = None
+
+    async def start_subscription_server(self, port: int = 0):
+        """Start (or move) the changelog subscription endpoint; port 0
+        binds an ephemeral port (chosen one in
+        `self.subscriptions.port`)."""
+        from ..logstore.subscription import SubscriptionServer
+        if self.subscriptions is not None:
+            await self.subscriptions.stop()
+        self.subscriptions = await SubscriptionServer(
+            self, port=port).start()
+        return self.subscriptions
+
+    async def stop_subscription_server(self) -> None:
+        if self.subscriptions is not None:
+            await self.subscriptions.stop()
+            self.subscriptions = None
 
     # ------------------------------------------------------ durable catalog
     def _persist_catalog(self) -> None:
@@ -472,6 +497,12 @@ class Session:
                     await self.start_monitor(port)
                 else:
                     await self.stop_monitor()
+            elif stmt.name == "subscription_port":
+                port = self.config[stmt.name]
+                if port > 0:
+                    await self.start_subscription_server(port)
+                else:
+                    await self.stop_subscription_server()
             return self.config[stmt.name]
         if isinstance(stmt, ast.Select):
             return self.query_select(stmt)
@@ -716,6 +747,10 @@ class Session:
             return [(n,) for n in sorted(self.catalog.mvs)]
         if what == "sinks":
             return [(n,) for n in sorted(self.catalog.sinks)]
+        if what == "subscriptions":
+            # (name, kind, cursor, delivered, state) for sink delivery
+            # tasks and live changelog subscriptions (logstore/)
+            return self.coord.logstore.report()
         if what == "all":
             return [(k, str(v)) for k, v in sorted(self.config.items())]
         if what in self.CONFIG_VARS:
@@ -844,13 +879,29 @@ class Session:
                        sources=tuple(sorted(
                            getattr(planner, "used_sources", ()))))
             self.catalog.mvs[stmt.name] = mv
-            # serving registration: the Materialize executor publishes
-            # its effective changelog through the hook; the per-MV
-            # snapshot cache builds lazily on first query touch
-            if len(dep.roots[plan.mv_fragment]) == 1:
-                root.serving_hook = self.coord.serving.register_mv(
-                    stmt.name, root.table, root.table.schema,
-                    root.table.pk_indices)
+            # serving registration: every Materialize executor publishes
+            # its effective changelog through a hook (one per actor — a
+            # parallel materialize's vnode-disjoint changelogs merge at
+            # the barrier); the per-MV snapshot cache builds lazily on
+            # first query touch
+            roots = dep.roots[plan.mv_fragment]
+            hooks = self.coord.serving.register_mv(
+                stmt.name, roots[0].table, roots[0].table.schema,
+                roots[0].table.pk_indices, n_hooks=len(roots))
+            for r, h in zip(roots, hooks):
+                r.serving_hook = h
+            # durable changelog log (logstore/): the feed for changelog
+            # subscriptions + serving replicas. Allocated AFTER the
+            # graph build so recovery replay (which re-floors table ids
+            # and rebuilds the same graph) derives the same log id.
+            # Lazy: writers drop their buffer until a subscription
+            # activates the log.
+            clog = self.coord.logstore.register_mv(
+                stmt.name, self.env.alloc_table_id(),
+                roots[0].table.schema, roots[0].table.pk_indices,
+                state_table=roots[0].table, n_writers=len(roots))
+            for r, w in zip(roots, clog.writers):
+                r.changelog_log = w
         # bring the new dataflow up: the first MV gets the Initial
         # barrier; later MVs initialize on the next ordinary barrier.
         # During catalog recovery NO barrier may run until the WHOLE
@@ -989,7 +1040,10 @@ class Session:
 
     async def drop_sink(self, name: str) -> None:
         sink = self.catalog.sinks.pop(name)
+        # stop drains uploads AND sink delivery (stop_all's quiesce), so
+        # the final epoch reaches the target before the task dies here
         await sink.deployment.stop()
+        self.coord.logstore.unregister_sink(name)
         for up, ch in sink.upstream_taps:
             up.tap.remove(ch)
         self._ddl_log = [e for e in self._ddl_log
@@ -1112,6 +1166,7 @@ class Session:
                 f"cannot drop {name!r}: {dependents} read it")
         mv = self.catalog.mvs.pop(name)
         self.coord.serving.unregister_mv(name)
+        self.coord.logstore.unregister_mv(name)
         await mv.deployment.stop()
         for up, ch in mv.upstream_taps:
             up.tap.remove(ch)
@@ -1156,6 +1211,7 @@ class Session:
         playground's exit path under --data; drop_all would erase the
         DDL log)."""
         await self.stop_monitor()
+        await self.stop_subscription_server()
         if self.cluster is not None:
             for name in reversed(list(self.catalog.sinks)):
                 sink = self.catalog.sinks.pop(name)

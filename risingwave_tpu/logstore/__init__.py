@@ -1,0 +1,31 @@
+"""Changelog log store — exactly-once sinks, durable epoch-indexed
+egress, and changelog subscriptions for serving replicas.
+
+Layers:
+  * log.py          — durable per-table logs riding the checkpoint
+                      (`SinkChangelog` seq-keyed delivery log,
+                      `MvChangelog` epoch-keyed subscription log) and
+                      the per-coordinator `LogStoreHub` driving
+                      background delivery off the commit pulse;
+  * subscription.py — backfill-then-tail subscription protocol, local
+                      (`ChangelogSubscription`) and over the cluster
+                      control-plane wire (`SubscriptionServer`);
+  * replica.py      — `ServingReplica`: a read-only SnapshotCache fed
+                      by the subscription, answering point lookups
+                      bit-identical to the meta-side serving cache.
+"""
+
+from .log import (
+    LogStoreHub, MvChangelog, MvChangelogWriter, SinkChangelog,
+    SinkDelivery,
+)
+from .replica import ServingReplica
+from .subscription import (
+    ChangelogSubscription, SubscribeError, SubscriptionServer,
+)
+
+__all__ = [
+    "LogStoreHub", "MvChangelog", "MvChangelogWriter", "SinkChangelog",
+    "SinkDelivery", "ServingReplica", "ChangelogSubscription",
+    "SubscribeError", "SubscriptionServer",
+]

@@ -1,0 +1,194 @@
+"""Serving replica — a read-only process fed by the MV changelog.
+
+Reference: ROADMAP item 3(d) / the reference's serving-node split: query
+traffic scales independently of the stream engine when read-only
+replicas subscribe to the MV change stream instead of sharing the
+stream cluster's process. The replica here is the first consumer of the
+changelog subscription protocol (logstore/subscription.py):
+
+  1. connect to the session's SubscriptionServer over the control-plane
+     wire (cluster/rpc.py frames);
+  2. `subscribe` returns the committed backfill — rows plus their store
+     keys and the MV's state-table id, so the replica constructs the
+     SAME key layout and its `SnapshotCache` compaction order is
+     bit-identical to the meta-side cache;
+  3. every `changelog` push (one committed epoch's effective changelog)
+     advances the cache exactly like the meta-side ServingManager does
+     at barrier collection.
+
+Point lookups answer from the replica's own epoch-pinned snapshot —
+the same `pk_index` probe the meta serving path uses — while barriers
+keep flowing upstream. Run in-process (tests, embedded read pools) or
+as a standalone process:
+
+    python -m risingwave_tpu.logstore.replica --connect HOST:PORT \
+        --mv NAME [--serve-port N]
+
+which additionally serves `lookup`/`rows`/`epoch` RPCs on its own port.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..serving.cache import SnapshotCache
+
+
+class ServingReplica:
+    """One MV's read-only replica: a SnapshotCache maintained from the
+    changelog subscription."""
+
+    def __init__(self, mv: str):
+        self.mv = mv
+        self.cache: Optional[SnapshotCache] = None
+        self.sub_id: Optional[str] = None
+        self.conn = None
+        self._epoch_advanced = asyncio.Event()
+        self.batches_applied = 0
+        self.closed = False
+
+    # ---------------------------------------------------------- connect
+    @classmethod
+    async def connect(cls, host: str, port: int, mv: str
+                      ) -> "ServingReplica":
+        from ..cluster.rpc import RpcConn
+        self = cls(mv)
+        reader, writer = await asyncio.open_connection(host, port)
+        self.conn = RpcConn(reader, writer, handler=self._on_push,
+                            on_closed=self._on_closed)
+        self.conn.start()
+        backfill = await self.conn.call("subscribe", mv=mv)
+        self._install_backfill(backfill)
+        return self
+
+    def _install_backfill(self, backfill: dict) -> None:
+        from ..state.state_table import StateTable
+        self.sub_id = backfill["sub_id"]
+        schema = backfill["schema"]
+        pk_indices = tuple(backfill["pk_indices"])
+        # store=None: the layout is pure key math (vnode hash +
+        # memcomparable pk) — the replica never touches a state store
+        layout = StateTable(None, table_id=backfill["table_id"],
+                            schema=schema, pk_indices=pk_indices)
+        self.cache = SnapshotCache(self.mv, schema, pk_indices, layout)
+        self.cache.build(backfill["rows"], backfill["keys"],
+                         backfill["epoch"])
+
+    async def _on_push(self, method: str, args: dict) -> None:
+        if method != "changelog" or args.get("sub_id") != self.sub_id:
+            return
+        # one committed epoch's effective changelog, in epoch order
+        # (the pump pushes ascending; TCP preserves it)
+        self.cache.advance([(args["epoch"], args["rows"])], args["epoch"])
+        self.batches_applied += 1
+        self._epoch_advanced.set()
+
+    def _on_closed(self, exc) -> None:
+        self.closed = True
+        self._epoch_advanced.set()
+
+    # ------------------------------------------------------------ reads
+    @property
+    def epoch(self) -> int:
+        return self.cache.snapshot.epoch if self.cache else 0
+
+    def lookup(self, pk: tuple) -> Optional[tuple]:
+        """Point lookup from the replica's pinned snapshot — the same
+        pk-index probe the meta serving cache answers with."""
+        snap = self.cache.snapshot
+        pos = snap.lookup(tuple(
+            self.cache._canon(v, i)
+            for v, i in zip(pk, self.cache.pk_indices)))
+        if pos is None:
+            return None
+        cols, valids = snap.point_rel(pos)
+        return tuple(
+            None if not bool(v[0]) else c[0].item()
+            for c, v in zip(cols, valids))
+
+    def rows(self):
+        """(cols, valids) of the live rows in store-key order —
+        bit-identical to the meta cache's `Snapshot.compact()` at the
+        same epoch."""
+        return self.cache.snapshot.compact()
+
+    async def wait_epoch(self, epoch: int, timeout: float = 30.0) -> int:
+        """Block until the replica has applied every batch <= `epoch`
+        (or the log reports no entry for it — epochs with no changes
+        are not pushed, so callers wait on the last CHANGED epoch)."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while self.epoch < epoch and not self.closed:
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"replica stuck at epoch {self.epoch} < {epoch}")
+            self._epoch_advanced.clear()
+            try:
+                await asyncio.wait_for(self._epoch_advanced.wait(),
+                                       remaining)
+            except asyncio.TimeoutError:
+                pass
+        return self.epoch
+
+    async def close(self) -> None:
+        if self.conn is not None and not self.conn.closed:
+            try:
+                await self.conn.call("unsubscribe", sub_id=self.sub_id,
+                                     timeout=5)
+            except Exception:  # noqa: BLE001 — server may be gone
+                pass
+            await self.conn.close()
+        self.closed = True
+
+
+async def serve_replica(host: str, port: int, mv: str,
+                        serve_port: int = 0):
+    """Process mode: maintain the replica and answer `lookup`/`rows`/
+    `epoch` RPCs on `serve_port` (0 = ephemeral). Returns (replica,
+    server)."""
+    from ..cluster.rpc import start_rpc_server
+    replica = await ServingReplica.connect(host, port, mv)
+
+    def handler_factory(conn):
+        async def handler(method, args):
+            if method == "lookup":
+                return replica.lookup(tuple(args["pk"]))
+            if method == "epoch":
+                return replica.epoch
+            if method == "rows":
+                cols, valids = replica.rows()
+                return {"cols": [c.tolist() for c in cols],
+                        "valids": [v.tolist() for v in valids]}
+            raise ValueError(f"unknown replica method {method!r}")
+
+        return handler, None
+
+    server = await start_rpc_server(handler_factory, port=serve_port)
+    return replica, server
+
+
+def main(argv=None) -> None:
+    import argparse
+    p = argparse.ArgumentParser(description="read-only serving replica")
+    p.add_argument("--connect", required=True,
+                   help="subscription server host:port")
+    p.add_argument("--mv", required=True, help="materialized view name")
+    p.add_argument("--serve-port", type=int, default=0)
+    args = p.parse_args(argv)
+    host, _, port = args.connect.rpartition(":")
+
+    async def run():
+        replica, server = await serve_replica(host, int(port), args.mv,
+                                              args.serve_port)
+        sp = server.sockets[0].getsockname()[1]
+        print(f"replica serving {args.mv} on 127.0.0.1:{sp} "
+              f"(epoch {replica.epoch})", flush=True)
+        async with server:
+            await server.serve_forever()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,254 @@
+"""Changelog subscriptions — backfill-then-tail over the MV log.
+
+Reference: the subscription surface of the reference's log store (
+`CREATE SUBSCRIPTION`, subscription cursors over the table change log)
+collapsed to the primitive the serving tier needs: a consumer asks for
+one MV's changelog and receives
+
+  1. a BACKFILL: the full committed snapshot of the MV's state table at
+     exactly `store.committed_epoch()` (call it E0), with store keys so
+     the consumer reproduces the scan order bit-identically, then
+  2. a TAIL: every committed log entry with epoch > E0, pushed in epoch
+     order as the checkpoint commits land.
+
+The no-gap/no-overlap handoff is by construction: the MV log activates
+at a collected barrier (everything <= that sealed epoch lives in table
+state, everything after is logged), the subscribe call waits until the
+commit point passes the activation floor, and the snapshot + cursor
+are taken in one synchronous step on the event loop — no commit can
+interleave between "snapshot at E0" and "tail from > E0".
+
+Two transports share the server-side pump:
+
+  * `ChangelogSubscription` — in-process (the local endpoint): batches
+    land in an asyncio queue, `next_batch()` pops them.
+  * `SubscriptionServer` — the cluster-tier endpoint: an RPC listener
+    (cluster/rpc.py frames) where `subscribe` returns the backfill and
+    `changelog` pushes carry the tail; serving replicas
+    (logstore/replica.py) connect here from other processes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..utils.metrics import GLOBAL_METRICS
+from .log import LogStoreHub, MvChangelog
+
+
+class SubscribeError(RuntimeError):
+    pass
+
+
+class _SubscriptionPump:
+    """Server-side tail for one subscription: wakes at every checkpoint
+    commit, reads committed log entries past its cursor, hands each
+    batch to the transport sink in epoch order."""
+
+    def __init__(self, hub: LogStoreHub, mv: str, log: MvChangelog,
+                 cursor_epoch: int, sink, sub_id: str):
+        self.hub = hub
+        self.mv = mv
+        self.log = log
+        self.cursor_epoch = cursor_epoch
+        self.sink = sink                  # async (epoch, rows) -> None
+        self.sub_id = sub_id
+        self.delivered_batches = 0
+        self.closing = False
+        self.task: Optional[asyncio.Task] = None
+        self._lock = asyncio.Lock()
+        self._lag = GLOBAL_METRICS.gauge(
+            "logstore_subscription_lag_epochs",
+            subscription=f"{mv}/{sub_id}")
+
+    def spawn(self) -> "_SubscriptionPump":
+        self.task = asyncio.get_running_loop().create_task(
+            self._run(), name=f"changelog-sub-{self.mv}-{self.sub_id}")
+        return self
+
+    async def _run(self) -> None:
+        seen = self.hub.commit_seq
+        while not self.closing:
+            try:
+                await self.pump_pending()
+            except asyncio.CancelledError:
+                raise
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                self.stop()               # subscriber went away
+                return
+            seen = await self.hub.wait_commit(seen)
+            if self.hub.aborted:
+                return
+
+    async def pump_pending(self) -> None:
+        async with self._lock:
+            pending = list(self.log.read_committed(self.cursor_epoch))
+            self._lag.set(float(len(pending)))
+            for epoch, rows in pending:
+                if self.closing:
+                    return
+                await self.sink(epoch, rows)
+                self.cursor_epoch = epoch
+                self.delivered_batches += 1
+                self._lag.dec()
+
+    def stop(self) -> None:
+        self.closing = True
+        if self.task is not None and not self.task.done():
+            self.task.cancel()
+        if self in self.hub.subscriptions:
+            self.hub.subscriptions.remove(self)
+        GLOBAL_METRICS.remove("logstore_subscription_lag_epochs",
+                              subscription=f"{self.mv}/{self.sub_id}")
+        # last consumer gone -> stop paying the log writes
+        if not any(p.mv == self.mv for p in self.hub.subscriptions):
+            self.log.deactivate()
+
+
+async def open_subscription(hub: LogStoreHub, mv: str, sink,
+                            sub_id: str) -> tuple:
+    """Shared server-side subscribe: activate the MV's log, wait for the
+    commit point to pass the activation floor, take the committed
+    backfill snapshot, register the tail pump — snapshot epoch and
+    pump cursor are assigned in ONE synchronous step, which is the
+    whole no-gap/no-overlap argument.
+
+    Returns (pump, backfill dict)."""
+    from ..state.storage_table import StorageTable
+    log = hub.mv_logs.get(mv)
+    if log is None:
+        raise SubscribeError(f"unknown changelog source {mv!r}")
+    if log.state_table is None:
+        raise SubscribeError(
+            f"{mv!r} has no subscribable state table (cluster MVs keep "
+            "their changelog in the workers — v1 subscriptions serve "
+            "meta-local MVs)")
+    log.activate(hub.collected_epoch)
+    floor = log.active_from
+    seen = hub.commit_seq
+    while hub.store.committed_epoch() < floor:
+        if hub.aborted:
+            raise SubscribeError("coordinator recovering; retry subscribe")
+        hub.check_failure()
+        seen = await hub.wait_commit(seen)
+    # ---- synchronous from here to pump registration ----
+    e0 = hub.store.committed_epoch()
+    storage = StorageTable.for_state_table(log.state_table)
+    rows, keys = storage.snapshot_with_keys(committed_only=True)
+    pump = _SubscriptionPump(hub, mv, log, e0, sink, sub_id)
+    hub.subscriptions.append(pump)
+    pump.spawn()
+    backfill = {
+        "sub_id": sub_id,
+        "table_id": log.state_table.table_id,
+        "schema": log.schema,
+        "pk_indices": tuple(log.pk_indices),
+        "epoch": e0,
+        "rows": rows,
+        "keys": keys,
+    }
+    return pump, backfill
+
+
+class ChangelogSubscription:
+    """The local endpoint: `start()` returns the backfill, then
+    `next_batch()` pops (epoch, rows) tail batches in epoch order."""
+
+    def __init__(self, hub: LogStoreHub, mv: str):
+        self.hub = hub
+        self.mv = mv
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.pump: Optional[_SubscriptionPump] = None
+        self.backfill: Optional[dict] = None
+
+    async def start(self) -> dict:
+        async def sink(epoch, rows):
+            await self.queue.put((epoch, rows))
+
+        self.pump, self.backfill = await open_subscription(
+            self.hub, self.mv, sink,
+            sub_id=f"local{id(self) & 0xffff:04x}")
+        return self.backfill
+
+    async def next_batch(self, timeout: Optional[float] = None):
+        if timeout is None:
+            return await self.queue.get()
+        return await asyncio.wait_for(self.queue.get(), timeout)
+
+    def close(self) -> None:
+        if self.pump is not None:
+            self.pump.stop()
+
+
+class SubscriptionServer:
+    """The cluster-tier endpoint: serves `subscribe` requests over the
+    control-plane wire (length-prefixed pickle frames between trusted
+    processes, cluster/rpc.py) and pushes `changelog` batches per
+    committed epoch. One server per session; serving replicas connect
+    here (`SET subscription_port = N`, 0 = off)."""
+
+    def __init__(self, session, port: int = 0, host: str = "127.0.0.1"):
+        self.session = session
+        self.host = host
+        self.port = port
+        self._server = None
+        self._conns: list = []
+
+    @property
+    def hub(self) -> LogStoreHub:
+        # read live: auto-recovery swaps the coordinator (and its hub)
+        return self.session.coord.logstore
+
+    async def start(self) -> "SubscriptionServer":
+        from ..cluster.rpc import start_rpc_server
+
+        def handler_factory(conn):
+            pumps: list = []
+            next_sub = [1]
+            self._conns.append(conn)
+
+            async def handler(method, args):
+                if method == "subscribe":
+                    sub_id = f"c{id(conn) & 0xffff:04x}.{next_sub[0]}"
+                    next_sub[0] += 1
+
+                    async def sink(epoch, rows, _sid=sub_id):
+                        await conn.push("changelog", sub_id=_sid,
+                                        epoch=epoch, rows=rows)
+
+                    pump, backfill = await open_subscription(
+                        self.hub, args["mv"], sink, sub_id)
+                    pumps.append(pump)
+                    return backfill
+                if method == "unsubscribe":
+                    for p in pumps:
+                        if p.sub_id == args["sub_id"]:
+                            p.stop()
+                    return {}
+                if method == "ping":
+                    return {}
+                raise ValueError(f"unknown subscription method {method!r}")
+
+            def on_closed(exc):
+                for p in pumps:
+                    p.stop()
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+            return handler, on_closed
+
+        self._server = await start_rpc_server(handler_factory,
+                                              host=self.host,
+                                              port=self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        for conn in list(self._conns):
+            await conn.close()
+        self._conns.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None

@@ -118,6 +118,14 @@ class BarrierCoordinator:
         # moment the memory manager uses — so pinned reads always sit on
         # a sealed epoch, consistent across every MV of the coordinator.
         self.serving = ServingManager()
+        # Changelog log-store authority (logstore/log.py): per-sink
+        # delivery tasks and changelog-subscription pumps wake on the
+        # commit pulse this coordinator emits at every checkpoint commit
+        # (inline, background-uploader and cluster paths alike); a
+        # delivery failure parks here and fail-stops the next injection
+        # exactly like an upload failure.
+        from ..logstore.log import LogStoreHub
+        self.logstore = LogStoreHub(store)
         # ---- async epoch uploader (the checkpoint pipeline) ----
         self._upload_q: asyncio.Queue[_UploadJob] = asyncio.Queue()
         self._uploader_task: Optional[asyncio.Task] = None
@@ -251,6 +259,11 @@ class BarrierCoordinator:
             raise RuntimeError(
                 "checkpoint upload/commit failed; recovery must replay "
                 "from the last committed epoch") from exc
+        # a parked sink-delivery failure fail-stops injection the same
+        # way (the target is unreachable/raising; recovery replays from
+        # the committed epoch and delivery resumes after the durable
+        # cursor — exactly-once either way)
+        self.logstore.check_failure()
         if kind is None:
             self._barrier_count += 1
             is_ckpt = (self._barrier_count % self.checkpoint_frequency) == 0
@@ -406,6 +419,7 @@ class BarrierCoordinator:
                     self.commit_listener(
                         barrier.epoch.prev,
                         (res or {}).get("uncommitted_ssts", []))
+                self.logstore.on_commit(barrier.epoch.prev)
                 self.tracer.end(barrier.epoch.curr,
                                 sync_ns=time.monotonic_ns() - t_sync)
         else:
@@ -427,6 +441,11 @@ class BarrierCoordinator:
         # full build scan here, before incremental maintenance takes
         # over)
         self.serving.on_barrier(barrier)
+        # the log-store hub tracks the sealed epoch: it is the
+        # activation floor for MV changelog logs (everything <= it is
+        # table state a subscription backfills; everything after is
+        # logged once active)
+        self.logstore.on_barrier(barrier)
 
     async def run_rounds(self, n: int, interval_s: Optional[float] = None) -> None:
         """Inject n barriers, waiting for each to complete. The very first
@@ -451,8 +470,11 @@ class BarrierCoordinator:
             # ticked epoch once this returns. Latency metrics are already
             # recorded per barrier, so the drain never inflates them; the
             # bench/profile measured loops call inject/wait directly and
-            # keep full overlap.
+            # keep full overlap. Sink delivery drains the same way: once
+            # a tick returns, everything it committed has reached the
+            # targets (delivery latency never lands in barrier latency).
             await self.drain_uploads()
+            await self.logstore.drain()
 
     async def stop_all(self, actor_ids: Optional[set[int]] = None) -> None:
         from ..stream.message import StopMutation
@@ -462,8 +484,10 @@ class BarrierCoordinator:
             b = await self.inject_barrier(mutation=StopMutation(ids))
             await self.wait_collected(b)
             # a stop is a quiesce point: everything enqueued must be
-            # durable before the caller reads committed state / exits
+            # durable — and delivered to sink targets — before the
+            # caller reads committed state / tears the deployment down
             await self.drain_uploads()
+            await self.logstore.drain()
 
     # -------------------------------------------------- background uploader
     def _enqueue_upload(self, barrier: Barrier) -> None:
@@ -520,6 +544,7 @@ class BarrierCoordinator:
                                              sorted(sst_ids))
                     t3 = time.monotonic_ns()
                     self.committed_epochs.append(job.prev_epoch)
+                    self.logstore.on_commit(job.prev_epoch)
                     self.upload_busy_ns += t3 - t0
                     self._m_upload.observe((t2 - t0) / 1e9)
                     self._m_commit.observe((t3 - t2) / 1e9)
@@ -547,6 +572,7 @@ class BarrierCoordinator:
                     self.commit_listener(
                         job.prev_epoch,
                         (res or {}).get("uncommitted_ssts", []))
+                self.logstore.on_commit(job.prev_epoch)
                 self.upload_busy_ns += t3 - t0
                 self._m_seal.observe((t1 - t0) / 1e9)
                 self._m_upload.observe((t2 - t1) / 1e9)
@@ -581,8 +607,12 @@ class BarrierCoordinator:
         WITHOUT committing them. An upload already in flight can at worst
         leave an orphan SST no manifest references; the commit point
         (manifest swap) never runs for aborted epochs, so the caller's
-        `reset_uncommitted` + replay from `committed_epoch` stays exact."""
+        `reset_uncommitted` + replay from `committed_epoch` stays exact.
+        Sink delivery and subscription pumps die here too — their
+        durable cursors commit with checkpoints, so the rebuilt
+        topology's fresh tasks resume exactly-once."""
         self._stop_watchdog()
+        self.logstore.abort()
         t = self._uploader_task
         self._uploader_task = None
         if t is not None and not t.done():

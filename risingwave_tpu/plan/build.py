@@ -792,7 +792,28 @@ def _build_sink(args, inputs, ctx: ActorCtx, key):
         raise ValueError(f"unknown sink connector {connector!r}")
     force = args.get("type") == "append-only" or str(
         args.get("force_append_only", "")).lower() in ("true", "1")
-    return SinkExecutor(inputs[0], target, force_append_only=force)
+    # Exactly-once via the changelog log store (logstore/): default for
+    # file/callback targets on a meta-local (manifest-owning) store —
+    # the epoch batch persists WITH the checkpoint and a background
+    # delivery task writes it to the target after the commit. Blackhole
+    # (the bench egress) skips the log by default: durably persisting
+    # every epoch for a row counter is pure write amplification.
+    # `WITH (exactly_once = 0/1)` overrides either way. A cluster
+    # compute node never owns the manifest (it cannot observe meta's
+    # commit point), so cluster sinks stay on the direct path — the
+    # deploy-time guard in cluster/meta_service.py rejects an explicit
+    # exactly_once request loudly instead of degrading silently.
+    default_eo = connector in ("file", "callback")
+    exactly_once = bool(int(args.get("exactly_once", default_eo)))
+    log = hub = None
+    if exactly_once and getattr(ctx.env.store, "manifest_owner", True):
+        from ..logstore.log import SinkChangelog
+        log = SinkChangelog(ctx.env.store, ctx.table_id((key, "log")),
+                            inputs[0].schema)
+        hub = ctx.env.coord.logstore
+    return SinkExecutor(inputs[0], target, force_append_only=force,
+                        log=log, hub=hub,
+                        name=ctx.env.memory_scope or f"sink_a{ctx.actor_id}")
 
 
 @register_builder("materialize")

@@ -36,7 +36,11 @@ class _MvEntry:
     table: object                  # the MV's StateTable (key layout + scan)
     schema: object
     pk_indices: tuple
-    hook: MvChangelogHook
+    # one hook per materialize ACTOR: a parallel-materialize MV has N
+    # vnode-partitioned executors, each publishing its own effective
+    # changelog; their pk sets are disjoint by construction, so the
+    # barrier-time drain merges them per epoch in any order
+    hooks: list
     cache: Optional[SnapshotCache] = None
     wanted: bool = False
     hits: int = 0
@@ -62,15 +66,17 @@ class ServingManager:
                             timeout_ms=timeout_ms)
 
     # ------------------------------------------------------ registration
-    def register_mv(self, name: str, table, schema,
-                    pk_indices) -> MvChangelogHook:
-        """Register an MV's serving entry; returns the changelog hook to
-        attach to its Materialize executor. Re-registration (rescale,
-        recovery replay) starts a fresh entry — the cache rebuilds."""
-        hook = MvChangelogHook(name)
+    def register_mv(self, name: str, table, schema, pk_indices,
+                    n_hooks: int = 1) -> list[MvChangelogHook]:
+        """Register an MV's serving entry; returns one changelog hook
+        per Materialize actor (`n_hooks` — parallel-materialize MVs
+        attach one to each executor; their vnode-disjoint changelogs
+        merge at the barrier). Re-registration (rescale, recovery
+        replay) starts a fresh entry — the cache rebuilds."""
+        hooks = [MvChangelogHook(name) for _ in range(n_hooks)]
         self._mvs[name] = _MvEntry(name, table, schema, tuple(pk_indices),
-                                   hook)
-        return hook
+                                   hooks)
+        return hooks
 
     def unregister_mv(self, name: str) -> None:
         if self._mvs.pop(name, None) is not None:
@@ -86,7 +92,7 @@ class ServingManager:
         self.collected_epoch = epoch
         for ent in self._mvs.values():
             if ent.cache is not None:
-                ent.cache.advance(ent.hook.drain(epoch), epoch)
+                ent.cache.advance(self._drain_hooks(ent, epoch), epoch)
             elif ent.wanted:
                 self._build(ent, epoch)
             if ent.cache is not None:
@@ -94,15 +100,33 @@ class ServingManager:
                                      mv=ent.name).set(
                     float(ent.cache.snapshot.row_count))
 
+    @staticmethod
+    def _drain_hooks(ent: _MvEntry, epoch: int) -> list:
+        """Merge every hook's stamped batches per epoch, ascending. A
+        parallel MV's actors write disjoint pk sets (vnode-partitioned
+        state), so the within-epoch merge order cannot change the
+        applied result."""
+        if len(ent.hooks) == 1:
+            return ent.hooks[0].drain(epoch)
+        by_epoch: dict[int, list] = {}
+        for hook in ent.hooks:
+            for e, rows in hook.drain(epoch):
+                by_epoch.setdefault(e, []).extend(rows)
+        return [(e, by_epoch[e]) for e in sorted(by_epoch)]
+
     def _build(self, ent: _MvEntry, epoch: int) -> None:
         from ..state.storage_table import StorageTable
+        # the layout table may carry one actor's vnode bitmap; the
+        # StorageTable rebinds the full vnode space, so the build scan
+        # covers every actor's slice of the shared table id
         storage = StorageTable.for_state_table(ent.table)
         rows, keys = storage.snapshot_with_keys(max_epoch=epoch)
         cache = SnapshotCache(ent.name, ent.schema, ent.pk_indices,
                               storage._layout)
         cache.build(rows, keys, epoch)
         ent.cache = cache
-        ent.hook.activate()
+        for hook in ent.hooks:
+            hook.activate()
 
     # ----------------------------------------------------------- pinning
     def pin(self, names) -> Optional[dict]:

@@ -147,6 +147,23 @@ class HummockStateStore(StateStore):
                 return v
         return None
 
+    def get_committed(self, key: bytes) -> Optional[bytes]:
+        """Point get at the COMMITTED snapshot (SSTs under the manifest
+        only): the shared buffer and the sealed-but-uncommitted queue
+        are invisible, exactly like `iter_range(committed_only=True)`.
+        The log store reads its delivery cursor here — a cursor staged
+        by an epoch whose commit never landed dies with the crash, and
+        resuming from it would skip the epochs it covered."""
+        for sst in self._l0:
+            found, v = sst.get(key)
+            if found:
+                return v
+        if self._l1 is not None:
+            found, v = self._l1.get(key)
+            if found:
+                return v
+        return None
+
     def iter_range(self, start: bytes, end: bytes,
                    committed_only: bool = False,
                    max_epoch: Optional[int] = None

@@ -108,7 +108,8 @@ class StorageTable:
         for vn in vnodes:
             yield from self.batch_iter_vnode(int(vn))
 
-    def snapshot_with_keys(self, max_epoch: Optional[int] = None
+    def snapshot_with_keys(self, max_epoch: Optional[int] = None,
+                           committed_only: bool = False
                            ) -> tuple[list[tuple], list[bytes]]:
         """(rows, store keys) of the whole table in key order, with
         staged (shared-buffer) epochs <= `max_epoch` visible on top of
@@ -116,13 +117,16 @@ class StorageTable:
         collection this sees EXACTLY the epochs the barrier sealed,
         whether or not the background uploader has committed them yet,
         so the cache and the changelog hook agree on where incremental
-        maintenance takes over."""
+        maintenance takes over. `committed_only=True` restricts to the
+        manifest snapshot — the changelog subscription's backfill read,
+        which must align exactly with `store.committed_epoch()` so the
+        tail (committed log entries > that epoch) overlaps nothing."""
         rows: list[tuple] = []
         keys: list[bytes] = []
         for vn in range(VNODE_COUNT):
             start, end = self._layout.vnode_key_range(vn)
             for k, v in self.store.iter_range(start, end,
-                                              committed_only=False,
+                                              committed_only=committed_only,
                                               max_epoch=max_epoch):
                 keys.append(k)
                 rows.append(self._serde.decode(v))

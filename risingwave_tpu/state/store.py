@@ -113,6 +113,14 @@ class StateStore:
     def get(self, key: bytes) -> Optional[bytes]:
         raise NotImplementedError
 
+    def get_committed(self, key: bytes) -> Optional[bytes]:
+        """Point get against the COMMITTED snapshot only — staged and
+        sealed-but-uncommitted epochs are invisible. The log store's
+        delivery cursor reads through here: a cursor staged by a
+        checkpoint that never committed must not be resumed from
+        (logstore/log.py)."""
+        raise NotImplementedError
+
     def get_many(self, keys) -> list:
         """Batch point-get over the same read view as `get` (mem-table
         merging is the StateTable's job): the evicted-range read-through
@@ -164,6 +172,11 @@ class MemoryStateStore(StateStore):
             buf = self._shared[epoch]
             if key in buf:
                 return buf[key]
+        return self._vals.get(key)
+
+    def get_committed(self, key: bytes) -> Optional[bytes]:
+        # the synced base map IS the committed view (sync() applies
+        # destructively — the in-memory analogue of the manifest)
         return self._vals.get(key)
 
     def iter_range(self, start: bytes, end: bytes,

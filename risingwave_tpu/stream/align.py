@@ -45,8 +45,20 @@ async def barrier_align(left: Executor, right: Executor) -> AsyncIterator[tuple]
                 continue
             finished, _ = await asyncio.wait(
                 ready, return_when=asyncio.FIRST_COMPLETED)
-            for t in finished:
-                s = next(k for k, v in tasks.items() if v is t)
+            # Process sides in FIXED order, not `for t in finished:` —
+            # asyncio.wait returns a SET, whose iteration order follows
+            # the task objects' addresses. When both sides are ready in
+            # the same pass (synchronous upstreams), that made the
+            # left/right interleaving depend on process memory layout:
+            # unrelated code-size changes flipped join emission
+            # interleavings run-to-run (found via the memory_profile
+            # gate flapping). Deterministic alignment also makes
+            # recovery REPLAY content-deterministic, which the log
+            # store's re-minted sequence numbers lean on (logstore/).
+            for s in (LEFT, RIGHT):
+                t = tasks[s]
+                if t not in finished or s in done or s in pending:
+                    continue
                 try:
                     msg = t.result()
                 except StopAsyncIteration:

@@ -33,27 +33,37 @@ class Channel:
     """Bounded mpsc channel (permit.rs analogue).
 
     `obs` (stream/monitor.py ChannelObs, attached at metric_level=debug)
-    adds queue-depth and blocked-put (backpressure) accounting: a full
-    queue means the RECEIVING actor is the bottleneck, and the seconds a
-    sender spends parked here are exactly the backpressure an operator
-    is hunting when an epoch runs long."""
+    adds queue-depth and blocked-put (backpressure) accounting labelled
+    by the RECEIVING actor: a full queue means the receiver is the
+    bottleneck. `send_obs` (a counter labelled by the SENDING actor,
+    attached when the sender's chain instruments) charges the same
+    parked seconds to the actor that actually paid them — without it,
+    "who is losing time to backpressure" and "who is causing it" were
+    conflated under one receiver-side label."""
 
     def __init__(self, capacity: int = 16):
         self.queue: asyncio.Queue[Message] = asyncio.Queue(maxsize=capacity)
         self.obs = None
+        self.send_obs = None
 
     async def send(self, msg: Message) -> None:
         obs = self.obs
-        if obs is None:
+        send_obs = self.send_obs
+        if obs is None and send_obs is None:
             await self.queue.put(msg)
             return
         if self.queue.full():
             t0 = time.monotonic()
             await self.queue.put(msg)
-            obs.blocked_put.inc(time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            if obs is not None:
+                obs.blocked_put.inc(dt)
+            if send_obs is not None:
+                send_obs.inc(dt)
         else:
             self.queue.put_nowait(msg)
-        obs.depth.set(float(self.queue.qsize()))
+        if obs is not None:
+            obs.depth.set(float(self.queue.qsize()))
 
     async def recv(self) -> Message:
         msg = await self.queue.get()
@@ -285,8 +295,15 @@ class MergeExecutor(Executor):
                     done, _ = await asyncio.wait(
                         waiting, return_when=asyncio.FIRST_COMPLETED)
                     obs.add_input_wait(time.monotonic_ns() - t0)
-                for t in done:
-                    i = next(k for k, v in getters.items() if v is t)
+                # fixed channel order, not set order: asyncio.wait's
+                # `done` is a set whose iteration follows task object
+                # addresses — with several upstreams ready in one pass
+                # the merge interleaving would depend on process memory
+                # layout (same fix as stream/align.py barrier_align)
+                for i in sorted(getters):
+                    t = getters[i]
+                    if t not in done or i in pending_barrier:
+                        continue
                     msg = t.result()
                     if obs is not None and isinstance(msg, StreamChunk):
                         obs.note_chunk_in()

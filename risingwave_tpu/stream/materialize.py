@@ -11,6 +11,14 @@ the post-conflict-resolution upserts/deletes actually applied to the
 table — so the per-MV SnapshotCache replays exactly what the storage
 sees, and stamps each interval's rows with the sealed epoch at the
 barrier.
+
+Changelog log: `changelog_log` (logstore/log.py MvChangelogWriter,
+registered alongside the serving hook) receives the SAME effective
+rows and stages them into the durable per-MV log under the sealed
+epoch at each barrier — the feed changelog subscriptions and serving
+replicas tail after the checkpoint commits. While no subscription has
+activated the log, the writer drops its buffer at each barrier, so
+unsubscribed MVs pay nothing durable.
 """
 
 from __future__ import annotations
@@ -44,6 +52,9 @@ class MaterializeExecutor(Executor):
         # serving changelog tap (serving/cache.py MvChangelogHook); set by
         # the session when the MV registers with the serving layer
         self.serving_hook = None
+        # durable changelog tap (logstore/log.py MvChangelogWriter); set
+        # by the session when the MV registers with the log-store hub
+        self.changelog_log = None
 
     async def execute(self):
         first = True
@@ -64,6 +75,11 @@ class MaterializeExecutor(Executor):
                     # the interval just committed belongs to the epoch
                     # this barrier seals
                     self.serving_hook.on_barrier(msg.epoch.prev)
+                if self.changelog_log is not None:
+                    # staged at the sealed epoch: the log entry rides
+                    # this barrier's checkpoint, committing atomically
+                    # with the table state it describes
+                    self.changelog_log.on_barrier(msg.epoch.prev)
                 yield msg
             else:
                 yield msg
@@ -72,14 +88,18 @@ class MaterializeExecutor(Executor):
         from ..serving.cache import OP_DEL, OP_PUT
         rows = chunk.to_rows()
         hook = self.serving_hook
+        clog = self.changelog_log
         if self.conflict is ConflictBehavior.NO_CHECK:
             self.table.write_chunk_rows(rows)
-            if hook is not None:
+            if hook is not None or clog is not None:
                 # NO_CHECK inserts land last-write-wins in the mem-table,
                 # i.e. upserts at the storage level — mirror that exactly
-                hook.on_rows([
-                    (OP_PUT if op in (OP_INSERT, OP_UPDATE_INSERT)
-                     else OP_DEL, row) for op, row in rows])
+                eff = [(OP_PUT if op in (OP_INSERT, OP_UPDATE_INSERT)
+                        else OP_DEL, row) for op, row in rows]
+                if hook is not None:
+                    hook.on_rows(eff)
+                if clog is not None:
+                    clog.on_rows(eff)
             return
         eff = []
         for op, row in rows:
@@ -97,5 +117,8 @@ class MaterializeExecutor(Executor):
             else:
                 self.table.delete(row)
                 eff.append((OP_DEL, row))
-        if hook is not None and eff:
-            hook.on_rows(eff)
+        if eff:
+            if hook is not None:
+                hook.on_rows(eff)
+            if clog is not None:
+                clog.on_rows(eff)

@@ -59,6 +59,29 @@ class MetricLevel(enum.IntEnum):
                 f"unknown metric_level {v!r} (expected off|info|debug)")
 
 
+def dispatcher_channels(d) -> list:
+    """The output Channel objects a dispatcher feeds (sender-side
+    instrumentation walk; remote/DCN legs are not Channels and are
+    skipped — their backpressure is visible on the socket, not here)."""
+    from .exchange import Channel
+    if d is None:
+        return []
+    out = []
+    outs = getattr(d, "outputs", None)
+    if outs is not None:
+        out.extend(outs)
+    if getattr(d, "output", None) is not None:
+        out.append(d.output)
+    chans = getattr(d, "channels", None)   # TapDispatcher: (ch, ids) pairs
+    if chans is not None:
+        out.extend(ch for ch, _ids in chans)
+    subs = getattr(d, "dispatchers", None)  # FanoutDispatcher
+    if subs is not None:
+        for sub in subs:
+            out.extend(dispatcher_channels(sub))
+    return [c for c in out if isinstance(c, Channel)]
+
+
 def dispatcher_fanout(d) -> int:
     """Number of output channels a dispatcher feeds right now (Tap
     fanout is runtime-extendable, so this re-reads on every call)."""
@@ -313,6 +336,22 @@ class StreamingStats:
             elif debug:
                 for part, fn in _occupancy_parts(ex):
                     obs.add_occupancy_gauge(ex.identity, part, fn)
+        if debug:
+            # sender-side backpressure attribution: seconds THIS actor
+            # spends parked on a FULL downstream channel are charged to
+            # it (the receiver-labelled blocked_put series keeps naming
+            # the culprit; this one names who pays)
+            for out_idx, ch in enumerate(
+                    dispatcher_channels(actor.dispatcher)):
+                labels = dict(actor=str(actor.actor_id),
+                              executor=executor_label,
+                              output=str(out_idx))
+                ch.send_obs = self.registry.counter(
+                    "stream_exchange_send_blocked_seconds_total",
+                    **labels)
+                obs.keys.append(
+                    ("stream_exchange_send_blocked_seconds_total",
+                     labels))
         actor.obs = obs
 
     def _uninstrument(self, actor, root) -> None:
@@ -331,3 +370,5 @@ class StreamingStats:
                          else list(ex.channels))
                 for ch in chans:
                     ch.obs = None
+        for ch in dispatcher_channels(actor.dispatcher):
+            ch.send_obs = None

@@ -1,36 +1,58 @@
-"""Sink executor — changelog egress with AT-LEAST-ONCE epoch delivery.
+"""Sink executor — changelog egress with EXACTLY-ONCE epoch delivery.
 
-Reference: src/connector/src/sink/ (trait Sink + 12 connectors; mod.rs)
-and the sink executor (stream/src/executor/sink.rs).
+Reference: src/connector/src/sink/ (trait Sink + 12 connectors; mod.rs),
+the sink executor (stream/src/executor/sink.rs), and the log-store
+decoupling (src/stream/src/common/log_store_impl/) that makes delivery
+exactly-once.
 
-Delivery semantics (ADVICE r3 #1, documented honestly): each epoch's rows
-deliver ATOMICALLY at its checkpoint barrier, ascending, and a restart
-never hands the target a half-epoch — but delivery happens when the
-barrier REACHES the sink, before the coordinator has durably committed
-the epoch, and post-crash replays mint fresh (wall-clock) epoch ids. The
-`committed_epoch()` dedupe therefore cannot match replayed rows, and the
-crash window delivers twice: at-least-once with per-epoch atomicity.
-Exactly-once requires the reference's log-store decoupling (persist the
-epoch batch in sink state committed WITH the checkpoint, deliver from
-the log after commit, target-side sequence dedupe) — not yet built.
-Delivering only after commit is NOT an alternative: a crash between
-commit and delivery would silently DROP the epoch (at-most-once), since
-recovery does not replay committed epochs.
+Delivery semantics: at each checkpoint barrier the executor APPENDS the
+epoch's changelog to a durable per-sink log (logstore/log.py
+`SinkChangelog`) staged at the sealed epoch — the entry commits
+atomically WITH the Hummock checkpoint, riding the exact
+seal/upload_sealed/commit_sealed path the rest of the epoch's state
+takes. A background delivery task (`SinkDelivery`, woken at every
+checkpoint commit) reads the COMMITTED log and writes each entry to the
+target AFTER the commit point, tagged with a dense log-store sequence
+number; the delivery cursor persists in sink state with the next
+checkpoint and the log truncates below it. Crash anywhere:
 
-Targets here:
+  * before the commit — the staged entry dies with the epoch; recovery
+    recomputes and re-mints the SAME sequence number (the counter
+    restarts from the committed prefix), so the target never sees an
+    uncommitted epoch at all;
+  * between commit and delivery — the committed log survives; the fresh
+    delivery task resumes after the durable cursor and delivers it
+    (deliver-after-commit alone would DROP it — recovery does not
+    replay committed epochs; the log is what replays them);
+  * between delivery and the cursor checkpoint — the entry is
+    re-delivered once, and the target dedupes on the STABLE sequence
+    number (`committed_seq()`), which — unlike the wall-clock epoch ids
+    the old direct path compared — survives restarts.
+
+Net: every committed epoch reaches the target exactly once. The legacy
+direct path (deliver at the barrier, before the commit: at-least-once
+with per-epoch atomicity) remains for the blackhole bench egress, for
+`WITH (exactly_once = 0)`, and for cluster-deployed sinks (v1: a worker
+cannot observe meta's commit point; cluster sinks stay at-least-once,
+rejected loudly if `exactly_once = 1` is requested).
+
+Targets:
   * BlackholeSink   — counts rows (the reference's blackhole connector,
                       the benchmark egress)
-  * FileSink        — newline-delimited JSON, one record per epoch with
-                      the epoch id embedded; re-delivery after recovery
-                      dedupes by epoch (append-only file = the log)
-  * CallbackSink    — hands (epoch, rows) to a Python callable
-                      (embedding/integration egress)
+  * FileSink        — newline-delimited JSON, one record per delivered
+                      log entry with seq + epoch embedded; reopening
+                      recovers `committed_seq()` from the file (torn
+                      trailing lines from a mid-write crash are ignored)
+  * CallbackSink    — hands (seq, epoch, rows) to a Python callable
+                      (embedding/integration egress); pass
+                      `committed_seq_fn` for cross-restart dedupe when
+                      the callable records sequence numbers durably
 
-Delivery contract: `write(epoch, rows)` with rows = list of (op, values)
-in changelog order, called once per epoch at its CHECKPOINT barrier,
-ascending epochs; `committed_epoch()` lets the executor skip epochs the
-target already saw WITHIN one incarnation (cross-restart dedupe limited
-as described above)."""
+Delivery contract: `write(seq, epoch, rows)` with rows = list of
+(op, values) in changelog order, called once per committed log entry,
+ascending sequence numbers; `committed_seq()` returns the last sequence
+the target saw (0 = none) and is how re-deliveries inside the crash
+window are skipped."""
 
 from __future__ import annotations
 
@@ -38,17 +60,17 @@ import json
 import os
 from typing import Callable, Optional
 
-from ..common.chunk import StreamChunk, OP_DELETE, OP_INSERT, OP_UPDATE_INSERT
+from ..common.chunk import StreamChunk, OP_INSERT, OP_UPDATE_INSERT
 from ..common.types import GLOBAL_DICT, DataType
 from .executor import Executor
-from .message import Barrier, BarrierKind, Watermark
+from .message import Barrier, BarrierKind
 
 
 class SinkTarget:
-    def write(self, epoch: int, rows: list) -> None:
+    def write(self, seq: int, epoch: int, rows: list) -> None:
         raise NotImplementedError
 
-    def committed_epoch(self) -> int:
+    def committed_seq(self) -> int:
         return 0
 
 
@@ -57,21 +79,30 @@ class BlackholeSink(SinkTarget):
         self.rows_written = 0
         self.epochs = 0
 
-    def write(self, epoch: int, rows: list) -> None:
+    def write(self, seq: int, epoch: int, rows: list) -> None:
         self.rows_written += len(rows)
         self.epochs += 1
 
 
 class CallbackSink(SinkTarget):
-    def __init__(self, fn: Callable[[int, list], None]):
+    def __init__(self, fn: Callable[[int, int, list], None],
+                 committed_seq_fn: Optional[Callable[[], int]] = None):
         self.fn = fn
+        self._committed_seq_fn = committed_seq_fn
+        self._committed = 0
 
-    def write(self, epoch: int, rows: list) -> None:
-        self.fn(epoch, rows)
+    def write(self, seq: int, epoch: int, rows: list) -> None:
+        self.fn(seq, epoch, rows)
+        self._committed = seq
+
+    def committed_seq(self) -> int:
+        if self._committed_seq_fn is not None:
+            return max(self._committed, int(self._committed_seq_fn()))
+        return self._committed
 
 
 class ArrowCallbackSink(SinkTarget):
-    """Delivers each epoch as a pyarrow RecordBatch (ops as an extra
+    """Delivers each log entry as a pyarrow RecordBatch (ops as an extra
     int8 'op' column) — the Arrow egress ramp (arrow_impl.rs role)."""
 
     def __init__(self, fn: Callable, schema):
@@ -83,7 +114,7 @@ class ArrowCallbackSink(SinkTarget):
         self._out_schema = self._asch.append(pa.field("op", pa.int8()))
         self._committed = 0
 
-    def write(self, epoch: int, rows: list) -> None:
+    def write(self, seq: int, epoch: int, rows: list) -> None:
         import pyarrow as pa
         cols = list(zip(*[vals for _, vals in rows])) if rows else [
             [] for _ in self.schema]
@@ -99,17 +130,19 @@ class ArrowCallbackSink(SinkTarget):
         batch = pa.RecordBatch.from_arrays(arrays,
                                            schema=self._out_schema)
         self.fn(epoch, batch)
-        self._committed = epoch
+        self._committed = seq
 
-    def committed_epoch(self) -> int:
+    def committed_seq(self) -> int:
         return self._committed
 
 
 class FileSink(SinkTarget):
-    """JSONL with per-epoch records: {"epoch": E, "rows": [[op, [...]], ...]}.
-    The append-only file doubles as the delivery log: recovery reads the
-    last epoch and skips SAME-ID re-deliveries (see module docstring for
-    why crash-window rows can still appear twice under fresh epoch ids)."""
+    """JSONL with per-entry records:
+    {"seq": S, "epoch": E, "rows": [[op, [...]], ...]}. The append-only
+    file doubles as the target-side dedupe state: reopening reads the
+    max delivered seq and `committed_seq()` makes crash-window
+    re-deliveries no-ops. A torn trailing line (crash mid-append) fails
+    to parse and is ignored — its entry re-delivers whole."""
 
     def __init__(self, path: str, schema=None):
         self.path = path
@@ -118,9 +151,14 @@ class FileSink(SinkTarget):
         if os.path.exists(path):
             with open(path, "r", encoding="utf-8") as f:
                 for line in f:
-                    if line.strip():
-                        self._committed = max(
-                            self._committed, json.loads(line)["epoch"])
+                    if not line.strip():
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue          # torn trailing line
+                    self._committed = max(self._committed,
+                                          rec.get("seq", 0))
 
     def _decode(self, values) -> list:
         if self.schema is None:
@@ -129,26 +167,30 @@ class FileSink(SinkTarget):
                 if f.data_type is DataType.VARCHAR and v is not None else v
                 for v, f in zip(values, self.schema)]
 
-    def write(self, epoch: int, rows: list) -> None:
-        rec = {"epoch": epoch,
+    def write(self, seq: int, epoch: int, rows: list) -> None:
+        rec = {"seq": seq, "epoch": epoch,
                "rows": [[op, self._decode(vals)] for op, vals in rows]}
         with open(self.path, "a", encoding="utf-8") as f:
             f.write(json.dumps(rec) + "\n")
             f.flush()
             os.fsync(f.fileno())
-        self._committed = epoch
+        self._committed = seq
 
-    def committed_epoch(self) -> int:
+    def committed_seq(self) -> int:
         return self._committed
 
 
 class DeviceBlackholeSinkExecutor(Executor):
     """Benchmark/terminal sink that consumes the changelog WITHOUT host
-    readback: chunks stay device arrays, only a reference to the last
-    column is kept so callers can block_until_ready() for drain syncs.
-    The reference's blackhole sink serves the same role in its benches;
-    on a tunneled TPU this is also the only sink that cannot poison
-    dispatch with d2h fetches."""
+    readback: chunks stay device arrays; a tiny on-device reduction of
+    the last column is kept so callers can block_until_ready() for
+    drain syncs. The reduction is a FRESH buffer on purpose: holding the
+    raw column would pin whatever buffer the producer emitted, and
+    executors that emit views of their device state (the fused q17
+    snapshot executor emits diff rows sliced from dense stores it
+    DONATES back to the next barrier's program) would leave this
+    executor holding a deleted array — the bench teardown's
+    "Array has been deleted" note (BENCH q17, pre-existing at seed)."""
 
     def __init__(self, input: Executor):
         self.input = input
@@ -158,30 +200,47 @@ class DeviceBlackholeSinkExecutor(Executor):
         self.last = None
 
     async def execute(self):
+        import jax.numpy as jnp
         from ..common.chunk import StreamChunk
         async for msg in self.input.execute():
             if isinstance(msg, StreamChunk) and msg.columns:
-                self.last = msg.columns[-1].data
+                self.last = jnp.sum(msg.columns[-1].data)
             yield msg
 
 
 class SinkExecutor(Executor):
-    """Terminal executor: buffers the epoch's changelog on the host and
-    delivers it at the barrier (rows leave the system here, so the d2h
-    transfer is inherent — it happens at barrier cadence, not per chunk)."""
+    """Terminal executor: buffers the epoch's changelog on the host and,
+    at each checkpoint barrier, either appends it to the durable
+    delivery log (`log` set — the exactly-once path; a background
+    `SinkDelivery` owned by the coordinator's LogStoreHub writes it to
+    the target after the commit) or delivers directly to the target
+    (legacy at-least-once path). Rows leave the system here, so the d2h
+    transfer is inherent — it happens at barrier cadence, not per
+    chunk."""
 
     def __init__(self, input: Executor, target: SinkTarget,
-                 force_append_only: bool = False):
+                 force_append_only: bool = False,
+                 log=None, hub=None, name: Optional[str] = None):
         self.input = input
         self.schema = input.schema
         self.pk_indices = input.pk_indices
         self.target = target
         self.force_append_only = force_append_only
+        self.log = log                    # logstore SinkChangelog or None
+        self.hub = hub                    # coordinator LogStoreHub
+        self.name = name or f"Sink({type(target).__name__})"
         self.identity = f"Sink({type(target).__name__})"
         self._buf: list[StreamChunk] = []
-        self.rows_delivered = 0
+        self._delivery = None
+        self.rows_delivered = 0           # legacy-path counter
+        self.rows_logged = 0              # log-path counter
+        # legacy direct path: wall-clock epochs delivered this
+        # incarnation (the old committed_epoch contract's residue —
+        # cross-restart dedupe on this path is content-blind, which is
+        # exactly why the log path exists)
+        self._direct_delivered = 0
 
-    def _drain(self, epoch: int) -> None:
+    def _epoch_rows(self) -> list:
         rows: list = []
         for chunk in self._buf:
             for op, vals in chunk.to_rows():
@@ -191,10 +250,28 @@ class SinkExecutor(Executor):
                 else:
                     rows.append((op, vals))
         self._buf = []
-        if epoch <= self.target.committed_epoch():
-            return                      # replayed epoch: already delivered
-        self.target.write(epoch, rows)
+        return rows
+
+    def _drain_direct(self, epoch: int) -> None:
+        """Legacy path: deliver at the barrier, before the commit
+        (at-least-once with per-epoch atomicity)."""
+        rows = self._epoch_rows()
+        if epoch <= self._direct_delivered:
+            return                      # replayed epoch this incarnation
+        self.target.write(0, epoch, rows)
+        self._direct_delivered = epoch
         self.rows_delivered += len(rows)
+
+    def _append_log(self, epoch: int) -> None:
+        """Exactly-once path: stage the epoch's entry + the delivery
+        cursor + truncation into the log AT the sealed epoch — all of
+        it commits atomically with this checkpoint."""
+        rows = self._epoch_rows()
+        if rows:
+            self.log.append(epoch, rows)
+            self.rows_logged += len(rows)
+        if self._delivery is not None:
+            self.log.persist_cursor(epoch, self._delivery.delivered_seq)
 
     async def execute(self):
         first = True
@@ -206,11 +283,18 @@ class SinkExecutor(Executor):
                 if first or msg.kind is BarrierKind.INITIAL:
                     first = False
                     self._buf = []
+                    if self.log is not None and self.hub is not None \
+                            and self._delivery is None:
+                        self._delivery = self.hub.register_sink(
+                            self.name, self.log, self.target)
                     yield msg
                     continue
                 if msg.kind is BarrierKind.CHECKPOINT:
                     # the epoch SEALED by this barrier is epoch.prev
-                    self._drain(msg.epoch.prev)
+                    if self.log is not None:
+                        self._append_log(msg.epoch.prev)
+                    else:
+                        self._drain_direct(msg.epoch.prev)
                 yield msg
             else:
                 yield msg

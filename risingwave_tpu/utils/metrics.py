@@ -294,3 +294,13 @@ SERVING_TIMEOUTS = GLOBAL_METRICS.counter("serving_timeouts_total")
 # stalled epoch when an in-flight barrier exceeds
 # barrier_stall_threshold_ms; the one-shot report rides stdout/logs.
 BARRIER_STALLS = GLOBAL_METRICS.counter("barrier_stalls_total")
+
+# Changelog log store (logstore/): exactly-once egress + subscriptions.
+# Bytes staged into the durable per-table logs (sink delivery logs + MV
+# changelog logs), epochs/rows the background delivery handed to sink
+# targets after commit, and per-subscription lag gauges
+# (`logstore_subscription_lag_epochs{subscription=...}`) ride alongside
+# once flows register.
+LOGSTORE_APPEND_BYTES = GLOBAL_METRICS.counter("logstore_append_bytes_total")
+SINK_DELIVERED_EPOCHS = GLOBAL_METRICS.counter("sink_delivered_epochs_total")
+SINK_DELIVERED_ROWS = GLOBAL_METRICS.counter("sink_delivered_rows_total")
