@@ -27,6 +27,7 @@ if anything hangs.
 import asyncio
 import json
 import os
+import re
 import subprocess
 import sys
 import threading
@@ -59,7 +60,8 @@ GLOBAL_BUDGET_S = 560.0
 DEVICE_PROBE_TIMEOUT_S = 120.0
 # Per-query subprocess budgets (compile + measure + baseline), seconds.
 QUERY_BUDGET_S = {"q1": 60.0, "q5": 150.0, "q7": 150.0, "q8": 170.0,
-                  "q17": 150.0, "q7d": 150.0}
+                  "q17": 150.0, "q7d": 150.0,
+                  "q5_8chip": 150.0, "q7_8chip": 150.0}
 # Baseline inputs are fixed (they don't depend on the device run), so the
 # orchestrator computes all four baselines in PARALLEL CPU subprocesses
 # while the device queries run serially.
@@ -352,6 +354,32 @@ async def bench_q1(progress: dict) -> None:
     await _bench_sql(progress, ddl, interval_s=0.5)
 
 
+def _q5_ddl(mesh_devices: int = 0) -> list:
+    # mesh variant: smaller chunks (q7d rationale) — the fused shard_map
+    # programs compile fresh and the giant-chunk configuration is a
+    # single-device dispatch-amortization tactic the fused interval scan
+    # already provides
+    cs = 32768 if mesh_devices else 131072
+    ddl = [
+        "SET streaming_durability = 0",
+        "SET streaming_watchdog = 0",
+        f"SET streaming_agg_capacity = {1 << 20}",
+        ("CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+         f"chunk_size={cs}, inter_event_us=2, emit_watermarks=1)"),
+        ("CREATE SINK q5 AS SELECT auction, window_start, count(*) AS n "
+         "FROM HOP(bid, date_time, 2000000, 10000000) "
+         "GROUP BY auction, window_start "
+         "WITH (connector='blackhole_device')"),
+    ]
+    if mesh_devices:
+        # fused mesh fragment (stream/sharded_agg.py): the agg fragment
+        # deploys as ONE actor whose exchange + state shard over the
+        # device mesh; same SQL, same per-shard capacity total
+        ddl.insert(0,
+                   f"SET streaming_parallelism_devices = {mesh_devices}")
+    return ddl
+
+
 async def bench_q5(progress: dict) -> None:
     """q5 core VIA SQL (BASELINE config 2): HOP(2s,10s) + count(*)
     GROUP BY (auction, window_start), watermark-cleaned.
@@ -361,18 +389,15 @@ async def bench_q5(progress: dict) -> None:
     spacing a 0.2s epoch spans ~50 event-seconds => (50+6 slides)*10k
     ~ 560k peak groups — fits 2^20 under the 0.7 threshold with margin
     (round-2 analysis, unchanged)."""
-    ddl = [
-        "SET streaming_durability = 0",
-        "SET streaming_watchdog = 0",
-        f"SET streaming_agg_capacity = {1 << 20}",
-        ("CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
-         "chunk_size=131072, inter_event_us=2, emit_watermarks=1)"),
-        ("CREATE SINK q5 AS SELECT auction, window_start, count(*) AS n "
-         "FROM HOP(bid, date_time, 2000000, 10000000) "
-         "GROUP BY auction, window_start "
-         "WITH (connector='blackhole_device')"),
-    ]
-    await _bench_sql(progress, ddl, interval_s=0.2)
+    await _bench_sql(progress, _q5_ddl(), interval_s=0.2)
+
+
+async def bench_q5_8chip(progress: dict) -> None:
+    """q5 on the 8-device mesh (ROADMAP item 2): the whole agg fragment
+    — source-side dispatch, hash exchange, sharded hash tables — runs as
+    one shard_map program per barrier interval over all 8 chips. Emitted
+    as nexmark_q5_rows_per_sec_8chip alongside the per-chip metric."""
+    await _bench_sql(progress, _q5_ddl(mesh_devices=8), interval_s=0.2)
 
 
 async def _bench_sql(progress: dict, ddl: list, interval_s: float,
@@ -457,6 +482,34 @@ async def _bench_sql(progress: dict, ddl: list, interval_s: float,
 W = 10_000_000          # 10s tumble window, microseconds
 
 
+def _q7_ddl(mesh_devices: int = 0) -> list:
+    # mesh variant: smaller chunks, same reasoning as _q5_ddl
+    cs = 32768 if mesh_devices else 131072
+    ddl = [
+        "SET streaming_durability = 0",
+        "SET streaming_watchdog = 0",
+        f"SET streaming_join_capacity = {1 << 19}",
+        "SET streaming_join_match_factor = 2",
+        f"SET streaming_agg_capacity = {1 << 13}",
+        ("CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+         f"chunk_size={cs}, inter_event_us=250, emit_watermarks=1, "
+         f"watermark_lag_us={2 * W})"),
+        ("CREATE SINK q7 AS "
+         "SELECT B.auction, B.price, B.bidder, B.date_time "
+         "FROM bid B JOIN ("
+         "  SELECT max(price) AS maxprice, window_end "
+         f"  FROM TUMBLE(bid, date_time, {W}) GROUP BY window_end) B1 "
+         "ON B.price = B1.maxprice "
+         f"AND B.date_time > B1.window_end - {W} "
+         "AND B.date_time <= B1.window_end "
+         "WITH (connector='blackhole_device')"),
+    ]
+    if mesh_devices:
+        ddl.insert(0,
+                   f"SET streaming_parallelism_devices = {mesh_devices}")
+    return ddl
+
+
 async def bench_q7(progress: dict) -> None:
     """q7 VIA SQL: tumble-window MAX(price) joined back to the bids at the
     max price (BASELINE config 3, reference workload q7.sql). The planner
@@ -469,26 +522,15 @@ async def bench_q7(progress: dict) -> None:
     reference's in-memory state backend) — same durability class as the
     numpy baseline; the durable path is covered by the crash-recovery
     test suite."""
-    ddl = [
-        "SET streaming_durability = 0",
-        "SET streaming_watchdog = 0",
-        f"SET streaming_join_capacity = {1 << 19}",
-        "SET streaming_join_match_factor = 2",
-        f"SET streaming_agg_capacity = {1 << 13}",
-        ("CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
-         f"chunk_size=131072, inter_event_us=250, emit_watermarks=1, "
-         f"watermark_lag_us={2 * W})"),
-        ("CREATE SINK q7 AS "
-         "SELECT B.auction, B.price, B.bidder, B.date_time "
-         "FROM bid B JOIN ("
-         "  SELECT max(price) AS maxprice, window_end "
-         f"  FROM TUMBLE(bid, date_time, {W}) GROUP BY window_end) B1 "
-         "ON B.price = B1.maxprice "
-         f"AND B.date_time > B1.window_end - {W} "
-         "AND B.date_time <= B1.window_end "
-         "WITH (connector='blackhole_device')"),
-    ]
-    await _bench_sql(progress, ddl, interval_s=0.05)
+    await _bench_sql(progress, _q7_ddl(), interval_s=0.05)
+
+
+async def bench_q7_8chip(progress: dict) -> None:
+    """q7 on the 8-device mesh: the sharded agg AND the sharded join
+    deploy as fused mesh fragments (one shard_map program per interval
+    each; in-mesh all_to_all exchange). Emitted as
+    nexmark_q7_rows_per_sec_8chip alongside the per-chip metric."""
+    await _bench_sql(progress, _q7_ddl(mesh_devices=8), interval_s=0.05)
 
 
 async def bench_q7d(progress: dict) -> None:
@@ -691,7 +733,8 @@ async def bench_q17(progress: dict) -> None:
 
 
 QUERIES = {"q1": bench_q1, "q5": bench_q5, "q7": bench_q7,
-           "q8": bench_q8, "q17": bench_q17, "q7d": bench_q7d}
+           "q8": bench_q8, "q17": bench_q17, "q7d": bench_q7d,
+           "q5_8chip": bench_q5_8chip, "q7_8chip": bench_q7_8chip}
 NORTH_STAR = ("q7", "q8")
 
 
@@ -901,6 +944,12 @@ def _emit_combined(results: dict, note: str = "",
         "seconds": (headline or {}).get("seconds", 0.0),
         "queries": results,
     }
+    # mesh-parallel numbers ride alongside the per-chip headline when the
+    # 8chip variants ran (>= 8 devices visible at probe time)
+    for q in ("q5", "q7"):
+        r8 = results.get(f"{q}_8chip")
+        if r8 and r8.get("rows_per_sec"):
+            out[f"nexmark_{q}_rows_per_sec_8chip"] = r8["rows_per_sec"]
     if extra:
         out.update(extra)
     if note:
@@ -948,7 +997,16 @@ def main() -> None:
                 note=f"DEVICE INIT STALL — no query ran: {dev_detail}",
                 extra={"device_init_stall": True})
         return
-    for q in ("q1", "q5", "q7", "q8", "q17", "q7d"):
+    # the probe prints "DEVICES <n> <platform> dispatch-ok": with >= 8
+    # devices visible, the mesh-parallel q5/q7 variants run too (fused
+    # mesh fragments, SET streaming_parallelism_devices = 8) and their
+    # numbers emit as nexmark_q{5,7}_rows_per_sec_8chip
+    m_dev = re.search(r"DEVICES (\d+)", dev_detail or "")
+    n_devices = int(m_dev.group(1)) if m_dev else 0
+    query_list = ["q1", "q5", "q7", "q8", "q17", "q7d"]
+    if n_devices >= 8:
+        query_list += ["q5_8chip", "q7_8chip"]
+    for q in query_list:
         remaining = GLOBAL_BUDGET_S - (time.perf_counter() - t0) - 10
         if remaining <= 40:   # a query needs import+compile time to matter
             results[q] = {"note": "skipped: global deadline"}
@@ -1025,6 +1083,22 @@ def main() -> None:
             rps = r.get("rows_per_sec")
             if rps:
                 r["vs_baseline"] = round(rps / base, 3)
+        _emit_combined(results, note="in progress")
+    # the mesh variants share their base query's workload: their ratios
+    # use the same baselines, and the scaling over the per-chip number
+    # (the ROADMAP item-2 deliverable) is reported explicitly
+    for q in ("q5", "q7"):
+        rq, r8 = results.get(q), results.get(f"{q}_8chip")
+        if not (rq and r8):
+            continue
+        base = rq.get("baseline_rows_per_sec")
+        rps = r8.get("rows_per_sec")
+        if base and rps:
+            r8["baseline_rows_per_sec"] = base
+            r8["vs_baseline"] = round(rps / base, 3)
+        if rps and rq.get("rows_per_sec"):
+            r8["scaling_vs_per_chip"] = round(
+                rps / rq["rows_per_sec"], 3)
         _emit_combined(results, note="in progress")
     # the durable variant shares q7's workload: its ratio uses q7's
     # baseline, and the flush tax is reported explicitly

@@ -400,6 +400,9 @@ class StreamPlanner:
                     clean_specs=(clean_l, clean_r),
                     mesh_devices=self.cfg(
                         "streaming_parallelism_devices", 1),
+                    mesh_shuffle=self.cfg("streaming_mesh_shuffle", 1),
+                    mesh_shuffle_slack=self.cfg(
+                        "streaming_mesh_shuffle_slack", 0),
                     watchdog_interval=wd,
                     durable=self.durable()),
                     inputs=(Exchange(lf), Exchange(rf)))
@@ -1563,6 +1566,9 @@ class StreamPlanner:
                     cleaning_watermark_col=(wm_keys[0] if wm_keys
                                             else None),
                     mesh_devices=md,
+                    mesh_shuffle=self.cfg("streaming_mesh_shuffle", 1),
+                    mesh_shuffle_slack=self.cfg(
+                        "streaming_mesh_shuffle_slack", 0),
                     watchdog_interval=wd),
                 inputs=(Exchange(fid),)),
                 dispatch="hash",

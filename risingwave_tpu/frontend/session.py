@@ -117,6 +117,19 @@ class Session:
         # analogue of the reference's parallel-unit placement
         # (meta/src/stream/stream_graph/schedule.rs)
         "streaming_parallelism_devices": (1, int),
+        # 1 (default): mesh fragments run the FUSED data plane — the
+        # exchange into a sharded agg/join is an in-program
+        # lax.all_to_all over ICI (parallel/exchange.mesh_ingest_chunk),
+        # one shard_map program per barrier interval. 0 restores the
+        # replicated-chunk + per-shard-mask plane.
+        "streaming_mesh_shuffle": (1, int),
+        # per-(src,dst) send-bucket sizing for the fused shuffle: 0 =
+        # zero-drop (bucket = the full per-shard slice, overflow
+        # impossible under any key skew); k > 0 = k * ceil(slice/shards)
+        # — near-linear per-shard compute for balanced keys, with
+        # on-device overflow counting that FAIL-STOPS the epoch
+        # (mesh_shuffle_dropped_rows_total) if the skew beats the slack
+        "streaming_mesh_shuffle_slack": (0, int),
         "streaming_over_window_capacity": (1 << 14, int),
         "streaming_dynamic_filter_capacity": (1 << 14, int),
         # "host:port" of a running fragment worker

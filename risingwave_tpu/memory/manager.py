@@ -187,7 +187,7 @@ class MemoryManager:
         rows = []
         for name, p in sorted(self._participants.items(),
                               key=lambda kv: -kv[1].state_bytes()):
-            rows.append({
+            row = {
                 "executor": name,
                 "state_bytes": p.state_bytes(),
                 "evicted_bytes": int(getattr(p, "mem_evicted_bytes", 0)),
@@ -195,7 +195,14 @@ class MemoryManager:
                 "spilled_rows": int(getattr(p, "mem_spilled_rows", 0)),
                 "guard_protected": int(
                     getattr(p, "mem_guard_protected", 0)),
-            })
+            }
+            # mesh-sharded executors split their state evenly over the
+            # device mesh: surface the per-shard (= per-device HBM) share
+            shards = int(getattr(p, "mem_shards", 0) or 0)
+            if shards > 1:
+                row["shards"] = shards
+                row["shard_bytes"] = row["state_bytes"] // shards
+            rows.append(row)
         return rows
 
     def render(self) -> list[str]:
@@ -204,12 +211,15 @@ class MemoryManager:
                  f" policy: {self.policy} "
                  f"total: {format_bytes(self.total_bytes())}"]
         for r in self.report():
+            shards = (f" shards={r['shards']}x"
+                      f"{format_bytes(r['shard_bytes'])}"
+                      if r.get("shards") else "")
             lines.append(
                 f"  {r['executor']}: state={format_bytes(r['state_bytes'])} "
                 f"evicted={format_bytes(r['evicted_bytes'])} "
                 f"reloads={r['reload_count']} "
                 f"spilled_rows={r['spilled_rows']} "
-                f"guard_protected={r['guard_protected']}")
+                f"guard_protected={r['guard_protected']}{shards}")
         return lines
 
     # ------------------------------------------------------ control loop

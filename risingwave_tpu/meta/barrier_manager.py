@@ -144,6 +144,16 @@ class BarrierCoordinator:
         self._m_commit = CHECKPOINT_COMMIT_SECONDS
         self._m_inflight = CHECKPOINT_INFLIGHT
         self._m_backpressure = CHECKPOINT_BACKPRESSURE_SECONDS
+        # ---- fused mesh fragments (plan/build.py _register_mesh) ----
+        # actor_id -> (n_shards, identity). A fused mesh fragment lowers
+        # a whole exchange -> sharded-executor chain onto the device mesh
+        # as ONE actor: its S shards participate in every epoch as ONE
+        # collection (one entry in EpochState.remaining, one fence on the
+        # sharded state — a collective boundary), where the host-exchange
+        # alternative is S actors = S collections + S per-device fences
+        # per epoch. The registry makes that legible to /healthz, tests
+        # and the mesh_profile gate.
+        self.mesh_fragments: dict[int, tuple[int, str]] = {}
         # ---- cluster mode (cluster/meta_service.py) ----
         # worker_id -> WorkerHandle: barriers are ALSO injected over RPC
         # into every compute node's source queues, each worker collects
@@ -190,6 +200,23 @@ class BarrierCoordinator:
 
     def register_actor(self, actor_id: int) -> None:
         self.actor_ids.add(actor_id)
+
+    def register_mesh_fragment(self, actor_id: int, n_shards: int,
+                               identity: str = "") -> None:
+        """A fused mesh fragment announces itself: `actor_id` is its ONE
+        collection unit covering all `n_shards` device shards."""
+        from ..utils.metrics import GLOBAL_METRICS
+        self.mesh_fragments[actor_id] = (int(n_shards), identity)
+        GLOBAL_METRICS.gauge("mesh_fragment_shards",
+                             actor=str(actor_id)).set(float(n_shards))
+
+    def unregister_mesh_fragment(self, actor_id: int) -> None:
+        from ..utils.metrics import GLOBAL_METRICS
+        if self.mesh_fragments.pop(actor_id, None) is not None:
+            # the labelled series dies with the fragment (same rule as
+            # per-actor streaming series)
+            GLOBAL_METRICS.remove("mesh_fragment_shards",
+                                  actor=str(actor_id))
 
     def register_worker(self, handle) -> None:
         """Attach a compute node (cluster mode): it participates in every

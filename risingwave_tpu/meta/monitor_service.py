@@ -86,6 +86,11 @@ class MonitorService:
                     coord.barrier_latency_percentile(0.5),
                 "inflight_epochs": len(coord._epochs),
                 "actors": len(coord.actor_ids),
+                # fused mesh fragments: actor -> device-shard count (each
+                # collects per epoch as ONE actor; plan/build.py
+                # _register_mesh)
+                "mesh_fragments": {str(aid): n for aid, (n, _)
+                                   in coord.mesh_fragments.items()},
                 "recoveries": self._session.recoveries,
             })
             return 200, "application/json", body + "\n"

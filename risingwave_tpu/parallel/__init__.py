@@ -7,9 +7,11 @@ rescheduling is a routing-table + state reshard update.
 """
 
 from .mesh import VNODE_AXIS, make_mesh, shard_vnode_bitmaps, vnode_to_shard
-from .exchange import bucket_by_dest, shuffle_by_vnode, shuffle_rows
+from .exchange import (bucket_by_dest, mesh_ingest_chunk, shuffle_by_vnode,
+                       shuffle_cap_out, shuffle_rows)
 
 __all__ = [
     "VNODE_AXIS", "make_mesh", "shard_vnode_bitmaps", "vnode_to_shard",
-    "bucket_by_dest", "shuffle_by_vnode", "shuffle_rows",
+    "bucket_by_dest", "mesh_ingest_chunk", "shuffle_by_vnode",
+    "shuffle_cap_out", "shuffle_rows",
 ]

@@ -21,7 +21,33 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 
+from ..common.chunk import Column, StreamChunk
 from ..common.vnode import compute_vnodes
+
+
+def shuffle_cap_out(local_rows: int, n_shards: int, slack: int = 0) -> int:
+    """Per-(src, dst) send capacity for `shuffle_rows`.
+
+    slack = 0 (the default) is ZERO-DROP sizing: a source shard holds at
+    most `local_rows` rows, so `cap_out = local_rows` can never overflow
+    regardless of key skew (a chunk whose rows all share one hot vnode —
+    e.g. a tumble-window group key inside one barrier interval — routes
+    everything to a single shard). The receive buffer is then
+    n_shards * local_rows = the global chunk capacity, i.e. the fused
+    path costs no more compute than the replicated-and-masked path while
+    still moving the data over ICI instead of the host.
+
+    slack = k > 0 sizes for BALANCED routing with k× headroom:
+    cap_out = k * ceil(local_rows / n_shards), so each shard's receive
+    buffer shrinks to ~k/n_shards of the chunk — the near-linear-compute
+    regime for well-distributed keys (q5's (auction, window) groups).
+    Overflow is counted on device and FAIL-STOPS the epoch at the next
+    barrier watchdog fetch (mesh_shuffle_dropped_rows_total), so an
+    undersized slack surfaces loudly instead of dropping rows."""
+    if slack <= 0:
+        return local_rows
+    per_pair = -(-local_rows // n_shards)
+    return min(local_rows, max(64, slack * per_pair))
 
 
 def bucket_by_dest(columns: Sequence[jnp.ndarray], vis: jnp.ndarray,
@@ -75,3 +101,35 @@ def shuffle_by_vnode(columns: Sequence[jnp.ndarray], vis: jnp.ndarray,
     vnodes = compute_vnodes(key_columns)
     dest = jnp.take(vnode_to_shard_table, vnodes)
     return shuffle_rows(columns, vis, dest, axis_name, n_shards, cap_out)
+
+
+def mesh_ingest_chunk(chunk: StreamChunk, key_indices: Sequence[int],
+                      vnode_to_shard_table: jnp.ndarray, axis_name: str,
+                      n_shards: int, cap_out: int):
+    """The fused exchange ingest (call INSIDE shard_map): this shard's
+    LOCAL row slice of a chunk is routed to the shards owning each row's
+    vnode — ops, every column (data + validity) and visibility ride one
+    all_to_all. Returns (local_chunk, n_dropped) where `local_chunk` has
+    capacity n_shards * cap_out and holds exactly the rows this shard
+    owns, in source-shard-major order. Because the host chunk is sliced
+    CONTIGUOUSLY over the mesh axis, source-shard-major order IS the
+    original chunk order restricted to the owned rows — the same
+    relative order the replicated-and-masked path sees, so per-shard
+    executor semantics (pk-run netting, extrema updates) are unchanged."""
+    payload = [chunk.ops]
+    for c in chunk.columns:
+        payload.append(c.data)
+        if c.valid is not None:
+            payload.append(c.valid)
+    key_cols = [chunk.columns[i].data for i in key_indices]
+    recv, recv_vis, n_dropped = shuffle_by_vnode(
+        payload, chunk.vis, key_cols, vnode_to_shard_table, axis_name,
+        n_shards, cap_out)
+    it = iter(recv)
+    ops = next(it)
+    cols = []
+    for c in chunk.columns:
+        data = next(it)
+        valid = next(it) if c.valid is not None else None
+        cols.append(Column(data, valid))
+    return StreamChunk(tuple(cols), ops, recv_vis, chunk.schema), n_dropped

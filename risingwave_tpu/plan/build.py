@@ -121,6 +121,7 @@ class Deployment:
     tasks: list[asyncio.Task] = field(default_factory=list)
     source_queues: list = field(default_factory=list)
     memory_names: list = field(default_factory=list)
+    mesh_actor_ids: list = field(default_factory=list)
 
     def spawn(self) -> "Deployment":
         self.tasks = [a.spawn() for a in self.actors]
@@ -153,6 +154,8 @@ class Deployment:
                     self.coord.source_queues.remove(q)
             for n in self.memory_names:
                 self.coord.memory.unregister(n)
+            for a in self.mesh_actor_ids:
+                self.coord.unregister_mesh_fragment(a)
 
 
 def _iter_executor_chain(root):
@@ -184,6 +187,24 @@ def _register_memory(dep: Deployment, env: BuildEnv, root,
             name = env.coord.memory.register(
                 f"{scope}/{ex.identity}@a{actor_id}", ex)
             dep.memory_names.append(name)
+
+
+def _register_mesh(dep: Deployment, env: BuildEnv, root,
+                   actor_id: int) -> None:
+    """The fused mesh plane: an exchange -> sharded-executor chain that
+    the builders lowered onto the device mesh announces itself to the
+    barrier coordinator — the fragment's S shards collect every epoch as
+    ONE actor (a single collective boundary), and /healthz + the
+    mesh_profile gate can see the mesh topology."""
+    reg = getattr(env.coord, "register_mesh_fragment", None)
+    if reg is None:
+        return
+    for ex in _iter_executor_chain(root):
+        n = getattr(ex, "n_shards", 0)
+        if n and getattr(ex, "mesh", None) is not None:
+            reg(actor_id, n, getattr(ex, "identity", type(ex).__name__))
+            dep.mesh_actor_ids.append(actor_id)
+            return                  # one registration per actor
 
 
 def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
@@ -291,6 +312,7 @@ def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
             root = build_node(f.root)
             dep.roots[fid].append(root)
             _register_memory(dep, env, root, actor_id)
+            _register_mesh(dep, env, root, actor_id)
             if idx == 0:
                 built_schema[fid] = root.schema
 
@@ -318,8 +340,19 @@ def _dispatcher_for(graph, f, cons, channels, idx):
         d = graph.fragments[d_fid]
         outs = channels[(f.fid, d_fid, k)][idx]
         if f.dispatch == "hash":
-            per_consumer.append(HashDispatcher(
-                outs, f.dist_key_indices, vnode_to_shard(d.parallelism)))
+            if d.parallelism == 1:
+                # a singleton consumer needs no host-side vnode routing:
+                # with one output every row lands there and update pairs
+                # cannot split, so the per-chunk route program is pure
+                # dispatch overhead. This is where the fused MESH
+                # fragment's source-side dispatch goes on-device — the
+                # consumer's shard_map ingest does the routing with an
+                # in-mesh all_to_all instead (stream/sharded_*.py).
+                per_consumer.append(SimpleDispatcher(outs[0]))
+            else:
+                per_consumer.append(HashDispatcher(
+                    outs, f.dist_key_indices,
+                    vnode_to_shard(d.parallelism)))
         elif f.dispatch == "broadcast":
             per_consumer.append(BroadcastDispatcher(outs))
         else:
@@ -496,7 +529,9 @@ def _build_hash_agg(args, inputs, ctx: ActorCtx, key):
             state_table=st,
             group_key_names=args.get("group_key_names"),
             cleaning_watermark_col=args.get("cleaning_watermark_col"),
-            watchdog_interval=args.get("watchdog_interval", 1))
+            watchdog_interval=args.get("watchdog_interval", 1),
+            mesh_shuffle=bool(args.get("mesh_shuffle", True)),
+            mesh_shuffle_slack=args.get("mesh_shuffle_slack", 0))
     return HashAggExecutor(
         inputs[0], args["group_key_indices"], args["agg_calls"],
         capacity=args.get("capacity", 1 << 16),
@@ -552,7 +587,9 @@ def _build_sorted_join(args, inputs, ctx: ActorCtx, key):
         from ..parallel.mesh import make_mesh
         from ..stream.sharded_join import ShardedSortedJoinExecutor
         cls = ShardedSortedJoinExecutor
-        extra = dict(mesh=make_mesh(md))
+        extra = dict(mesh=make_mesh(md),
+                     mesh_shuffle=bool(args.get("mesh_shuffle", True)),
+                     mesh_shuffle_slack=args.get("mesh_shuffle_slack", 0))
     return cls(
         inputs[0], inputs[1], **extra,
         left_key_indices=args["left_key_indices"],
@@ -1086,6 +1123,7 @@ def build_partial_graph(graph: StreamGraph, env: BuildEnv,
             root = build_node(f.root)
             dep.roots[fid].append(root)
             _register_memory(dep, env, root, actor_id)
+            _register_mesh(dep, env, root, actor_id)
             dispatcher = _cluster_dispatcher(graph, f, consumers[fid],
                                              channels, placement,
                                              my_worker, remote_outs, idx)
@@ -1116,9 +1154,15 @@ def _cluster_dispatcher(graph, f, cons, channels, placement, my_worker,
             return remote_outs[(f.fid, d_fid, k, idx, di)]
 
         if f.dispatch == "hash":
-            outs = [target(di) for di in range(d.parallelism)]
-            per_consumer.append(HashDispatcher(
-                outs, f.dist_key_indices, vnode_to_shard(d.parallelism)))
+            if d.parallelism == 1:
+                # same singleton-consumer simplification as
+                # _dispatcher_for: one output = no routing needed
+                per_consumer.append(SimpleDispatcher(target(0)))
+            else:
+                outs = [target(di) for di in range(d.parallelism)]
+                per_consumer.append(HashDispatcher(
+                    outs, f.dist_key_indices,
+                    vnode_to_shard(d.parallelism)))
         elif f.dispatch == "broadcast":
             per_consumer.append(BroadcastDispatcher(
                 [target(di) for di in range(d.parallelism)]))

@@ -5,9 +5,30 @@ vnode-bitmap slice of the 256 vnodes, fed by HashDataDispatcher
 (proto/stream_plan.proto:834-876, dispatch.rs:679). On a TPU mesh the
 dispatcher+merge pair collapses INTO the jitted step: state lives sharded
 along the `vnode` mesh axis (global arrays [S*C], each shard seeing a
-local [C] table), and each shard masks the replicated input chunk down to
-its own vnodes — the "exchange" is a visibility mask on ICI-resident data,
-not a data movement. The barrier flush runs per shard and concatenates
+local [C] table).
+
+Two input planes:
+
+* FUSED MESH SHUFFLE (default, `mesh_shuffle=True`): the whole fragment —
+  source-side dispatch, hash exchange, stateful apply — is ONE
+  shard_map-ed program per barrier interval. The host chunk is sliced
+  CONTIGUOUSLY over the mesh axis (shard s holds rows [s*L, (s+1)*L)),
+  each shard vnode-routes its slice to the owner shards with
+  `parallel/exchange.mesh_ingest_chunk` (`lax.all_to_all` over ICI — no
+  host Channel hop, no replication), and applies its local hash table to
+  exactly the rows it owns. Chunks buffered within an interval batch into
+  one `lax.scan` inside the same shard_map program, so device dispatches
+  per interval scale with neither chunk count nor shard count. Shuffle
+  overflow (per-pair capacity from `mesh_shuffle_slack`; 0 = zero-drop
+  sizing) accumulates on device and FAIL-STOPS the epoch at the barrier
+  watchdog fetch.
+
+* REPLICATED MASK (fallback: `mesh_shuffle=False`, or a chunk whose
+  capacity does not divide by the shard count): the input chunk is
+  replicated and each shard masks it down to its own vnodes — the
+  "exchange" is a visibility mask on ICI-resident data.
+
+The barrier flush runs per shard and concatenates
 along the shard axis into one global changelog chunk.
 
 This is the SAME executor logic as HashAggExecutor — `_apply_impl`,
@@ -38,14 +59,16 @@ from ..common.chunk import StreamChunk
 from ..common.vnode import compute_vnodes
 from ..expr.agg import AggCall
 from ..ops.jit_state import jit_state
+from ..parallel.exchange import mesh_ingest_chunk, shuffle_cap_out
 from ..parallel.mesh import VNODE_AXIS, shard_map, vnode_to_shard
 from .executor import Executor
 from .hash_agg import AggState, HashAggExecutor
 
 
 class ShardedHashAggExecutor(HashAggExecutor):
-    """HashAgg over `mesh`: state sharded on the vnode axis, input chunks
-    replicated and masked per shard. `capacity` is PER SHARD."""
+    """HashAgg over `mesh`: state sharded on the vnode axis, input routed
+    to its owner shard by the fused in-mesh shuffle (or replicated and
+    masked as the fallback). `capacity` is PER SHARD."""
 
     def __init__(self, input: Executor, group_key_indices: Sequence[int],
                  agg_calls: Sequence[AggCall], mesh: Mesh,
@@ -53,10 +76,25 @@ class ShardedHashAggExecutor(HashAggExecutor):
                  state_table=None,
                  group_key_names: Optional[Sequence[str]] = None,
                  cleaning_watermark_col: Optional[int] = None,
-                 watchdog_interval: Optional[int] = 1):
+                 watchdog_interval: Optional[int] = 1,
+                 mesh_shuffle: bool = True,
+                 mesh_shuffle_slack: int = 0):
         self.mesh = mesh
         self.n_shards = mesh.shape[VNODE_AXIS]
         self._routing = jnp.asarray(vnode_to_shard(self.n_shards))
+        self.mesh_shuffle = bool(mesh_shuffle)
+        self.mesh_shuffle_slack = int(mesh_shuffle_slack)
+        if self.mesh_shuffle_slack and watchdog_interval is None:
+            raise ValueError(
+                "mesh_shuffle_slack > 0 needs the barrier watchdog fetch "
+                "(watchdog_interval=1): shuffle drops would otherwise go "
+                "unchecked and a checkpoint could commit with rows "
+                "missing; transfer-free pipelines must use slack 0 "
+                "(zero-drop sizing)")
+        # fused-plane dispatch count (one per interval batch in steady
+        # state): tests and scripts/mesh_profile.py assert the fused
+        # exchange actually engaged
+        self.mesh_shuffle_applies = 0
         super().__init__(input, group_key_indices, agg_calls,
                          capacity=capacity, state_table=state_table,
                          group_key_names=group_key_names,
@@ -65,9 +103,10 @@ class ShardedHashAggExecutor(HashAggExecutor):
         # re-wrap the inherited step impls in shard_map (the parent set up
         # plain jits over the freshly built sharded state); donation rules
         # match the parent's — the sharded AggState and the per-shard
-        # accumulators are threaded, never aliased. Chunk batching stays
-        # off: the scan programs are built over the unsharded impls.
-        self._use_chunk_batching = False
+        # accumulators are threaded, never aliased. Chunk batching runs
+        # through the FUSED shard_map scan (_drain_pending below); the
+        # parent's unsharded scan programs are never built here.
+        self._use_chunk_batching = self.mesh_shuffle
         mesh_kw = dict(mesh=mesh)
         shard = P(VNODE_AXIS)
         repl = P()
@@ -87,6 +126,24 @@ class ShardedHashAggExecutor(HashAggExecutor):
             apply_sharded, in_specs=(shard, shard, repl),
             out_specs=(shard, shard, shard), **mesh_kw),
             donate_argnums=(0, 1), name="sharded_agg_apply")
+
+        # ---- fused mesh shuffle: exchange + apply in ONE program ----
+        # the chunk enters SHARDED over the row axis (in_spec P(vnode):
+        # shard s sees rows [s*L, (s+1)*L)); the in-mesh all_to_all
+        # routes rows to their owner shard, then the local hash table
+        # applies exactly the owned rows. `dropped` accumulates shuffle
+        # overflow per shard; the barrier watchdog fail-stops on it.
+        def apply_fused(state, overflow, dropped, chunk):
+            st, ov, dr, occ = self._fused_step(
+                state, overflow[0], dropped[0], chunk)
+            return st, ov[None], dr[None], occ[None]
+
+        self._apply_fused = jit_state(shard_map(
+            apply_fused, in_specs=(shard, shard, shard, shard),
+            out_specs=(shard, shard, shard, shard), **mesh_kw),
+            donate_argnums=(0, 1, 2), name="sharded_agg_apply_fused")
+        # interval-batched fused scans, keyed by batch size k
+        self._fused_scans: dict = {}
 
         def flush_sharded(state):
             st, cols, ops, vis = self._flush_impl(state)
@@ -117,13 +174,15 @@ class ShardedHashAggExecutor(HashAggExecutor):
             return self._purge(state)
         self._rehash = rehash_same_capacity
 
-        def watchdog_sharded(ov, occ):
+        def watchdog_sharded(ov, occ, dr):
             total_ov = jax.lax.psum(ov[0], VNODE_AXIS)
             max_occ = jax.lax.pmax(occ[0], VNODE_AXIS)
-            return jnp.stack([total_ov, max_occ])[None]
+            total_dr = jax.lax.psum(dr[0], VNODE_AXIS)
+            return jnp.stack([total_ov, max_occ, total_dr])[None]
 
         self._watchdog_pack = jit_state(shard_map(
-            watchdog_sharded, in_specs=(shard, shard), out_specs=shard,
+            watchdog_sharded, in_specs=(shard, shard, shard),
+            out_specs=shard,
             **mesh_kw), name="sharded_agg_watchdog_pack")
 
         def persist_view_sharded(state):
@@ -144,6 +203,102 @@ class ShardedHashAggExecutor(HashAggExecutor):
             jnp.zeros(self.n_shards, dtype=jnp.int32), sharding)
         self._occ_dev = jax.device_put(
             jnp.zeros(self.n_shards, dtype=jnp.int32), sharding)
+        self._dropped_dev = jax.device_put(
+            jnp.zeros(self.n_shards, dtype=jnp.int32), sharding)
+
+    # ------------------------------------------------ fused mesh shuffle
+    def _fused_step(self, state, overflow, dropped, chunk):
+        """One chunk's shuffle + apply, INSIDE shard_map (per-shard
+        views; `chunk` fields are this shard's local [L] row slices).
+        Shapes are static under trace, so the per-pair send capacity
+        re-derives per chunk-capacity signature."""
+        cap = shuffle_cap_out(chunk.capacity, self.n_shards,
+                              self.mesh_shuffle_slack)
+        local, n_drop = mesh_ingest_chunk(
+            chunk, self.group_key_indices, self._routing, VNODE_AXIS,
+            self.n_shards, cap)
+        st, ov, occ = self._apply_impl(state, overflow, local)
+        return st, ov, (dropped + n_drop).astype(dropped.dtype), occ
+
+    def _make_fused_scan(self, k: int):
+        """k identically-shaped chunks of one barrier interval, applied
+        in ONE device dispatch: lax.scan over the stacked batch INSIDE
+        the shard_map program, each step shuffling then applying — the
+        whole interval's exchange + compute is a single fused program
+        regardless of shard count."""
+        shard = P(VNODE_AXIS)
+
+        def scan_body(state, overflow, dropped, *chunks):
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *chunks)
+
+            def step(carry, chunk):
+                st, ov, dr = carry
+                st, ov2, dr2, occ = self._fused_step(st, ov, dr, chunk)
+                return (st, ov2.astype(ov.dtype), dr2), occ
+
+            (st, ov, dr), occs = jax.lax.scan(
+                step, (state, overflow[0], dropped[0]), stacked)
+            return st, ov[None], dr[None], occs[-1][None]
+
+        return jit_state(shard_map(
+            scan_body, mesh=self.mesh,
+            in_specs=(shard, shard, shard) + (shard,) * k,
+            out_specs=(shard, shard, shard, shard)),
+            donate_argnums=(0, 1, 2),
+            name=f"sharded_agg_apply_fused_scan{k}")
+
+    def _fused_eligible(self, chunk: StreamChunk) -> bool:
+        # shard_map row-slices the chunk contiguously over the mesh axis,
+        # which needs the capacity to divide evenly; everything else
+        # (including every power-of-two capacity >= n_shards) is eligible
+        return self.mesh_shuffle and chunk.capacity % self.n_shards == 0
+
+    def _apply_chunk_raw(self, chunk: StreamChunk) -> None:
+        if self._fused_eligible(chunk):
+            (self.state, self._overflow_dev, self._dropped_dev,
+             self._occ_dev) = self._apply_fused(
+                self.state, self._overflow_dev, self._dropped_dev, chunk)
+            self.mesh_shuffle_applies += 1
+        else:
+            self.state, self._overflow_dev, self._occ_dev = self._apply(
+                self.state, self._overflow_dev, chunk)
+        self._applied_since_flush = True
+
+    def _drain_pending(self) -> None:
+        """Interval drain: a multi-chunk run goes through the fused
+        shard_map scan (one dispatch); single chunks and ineligible
+        capacities fall back to the per-chunk programs. The parent's
+        unsharded scan machinery is bypassed entirely — its programs
+        would mis-handle the sharded global state."""
+        p = self._pending_chunks
+        if not p:
+            return
+        self._pending_chunks = []
+        if len(p) == 1 or not self._fused_eligible(p[0]):
+            self._mem_check_reload(p)
+            for ch in p:
+                self._apply_chunk_raw(ch)
+            return
+        # pow2 batch buckets with all-invisible fillers, exactly like the
+        # parent's scan path (zero-copy views of the last chunk's arrays)
+        k = 1 << (len(p) - 1).bit_length()
+        if k > len(p):
+            last = p[-1]
+            filler = StreamChunk(last.columns, last.ops,
+                                 jnp.zeros(last.capacity, dtype=bool),
+                                 last.schema)
+            p = p + [filler] * (k - len(p))
+        self._mem_check_reload(p)
+        scan = self._fused_scans.get(k)
+        if scan is None:
+            scan = self._make_fused_scan(k)
+            self._fused_scans[k] = scan
+        (self.state, self._overflow_dev, self._dropped_dev,
+         self._occ_dev) = scan(self.state, self._overflow_dev,
+                               self._dropped_dev, *p)
+        self.mesh_shuffle_applies += 1
+        self._applied_since_flush = True
 
     # ------------------------------------------------------------ state
     def _initial_state(self, capacity: int) -> AggState:
@@ -290,6 +445,16 @@ class ShardedHashAggExecutor(HashAggExecutor):
     # is exact), but per-shard capacity is STATIC in v1 — a shrinking
     # rehash would need a global re-layout — so the sharded agg reports
     # bytes and never evicts (ROADMAP open item).
+    @property
+    def mem_shards(self) -> int:
+        """Shard count for the memory manager's per-shard breakdown:
+        the global arrays split evenly over the mesh axis, so each
+        device holds state_bytes() / n_shards of this executor's HBM."""
+        return self.n_shards
+
+    def state_shard_bytes(self) -> int:
+        return self.state_bytes() // self.n_shards
+
     def memory_enable_lru(self) -> None:
         pass
 
@@ -298,10 +463,23 @@ class ShardedHashAggExecutor(HashAggExecutor):
 
     def _check_watchdog(self) -> None:
         vals = np.asarray(self._watchdog_pack(self._overflow_dev,
-                                              self._occ_dev))[0]
-        n_un = int(vals[0])
+                                              self._occ_dev,
+                                              self._dropped_dev))[0]
+        n_un, occ, n_drop = int(vals[0]), int(vals[1]), int(vals[2])
+        if n_drop:
+            # fail-stop BEFORE this epoch's checkpoint commits: a row the
+            # shuffle dropped was never applied, so committing would make
+            # the loss durable and silent. Recovery replays from the last
+            # committed epoch; the slack needs raising (0 = zero-drop).
+            from ..utils.metrics import MESH_SHUFFLE_DROPPED
+            MESH_SHUFFLE_DROPPED.inc(n_drop)
+            raise RuntimeError(
+                f"mesh shuffle overflow: {n_drop} rows dropped en route "
+                f"to their owner shard (per-pair send capacity sized by "
+                f"mesh_shuffle_slack={self.mesh_shuffle_slack}; 0 = "
+                f"zero-drop sizing)")
         if n_un:
             raise RuntimeError(
                 f"sharded hash-agg overflow ({n_un} rows, per-shard "
                 f"capacity {self.capacity})")
-        self._occ_known = int(vals[1])
+        self._occ_known = occ

@@ -8,11 +8,20 @@ same key values.
 
 On a TPU mesh the dispatcher+merge pair collapses INTO the jitted step
 (same re-design as ShardedHashAggExecutor, sharded_agg.py): each side's
-sorted state lives sharded along the `vnode` mesh axis, input chunks are
-replicated and masked down to each shard's own vnodes (vnode =
-crc32(key) & 255, identical on both sides => co-partitioned probes are
-shard-local), and the per-shard output chunks concatenate along the shard
-axis into one global changelog chunk. `capacity` is PER SHARD.
+sorted state lives sharded along the `vnode` mesh axis, and both sides'
+chunks route to the shard owning vnode = crc32(key) & 255 — identical
+hashing on both sides => co-partitioned probes are shard-local. The
+per-shard output chunks concatenate along the shard axis into one global
+changelog chunk. `capacity` is PER SHARD.
+
+Like the sharded agg, the default input plane is the FUSED MESH SHUFFLE
+(`mesh_shuffle=True`): the chunk enters row-sliced over the mesh axis and
+`parallel/exchange.mesh_ingest_chunk` routes rows to their owner shard
+with one in-program `lax.all_to_all` — exchange + probe + state update is
+ONE device program per chunk, with shuffle overflow accumulated on device
+and fail-stopped at the barrier watchdog. Chunks whose capacity does not
+divide by the shard count (and `mesh_shuffle=False`) fall back to the
+replicated-and-masked plane.
 
 Inherits ALL semantics (inner/outer, degrees, per-chunk eviction,
 netting) from SortedJoinExecutor — `_apply_impl` / `_evict_impl` run
@@ -31,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..common.chunk import StreamChunk
 from ..common.vnode import compute_vnodes
 from ..ops.jit_state import jit_state
+from ..parallel.exchange import mesh_ingest_chunk, shuffle_cap_out
 from ..parallel.mesh import VNODE_AXIS, shard_map, vnode_to_shard
 from .align import LEFT, RIGHT
 from .executor import Executor
@@ -49,10 +59,21 @@ def _vec_n(state: SortedSideState) -> SortedSideState:
 
 class ShardedSortedJoinExecutor(SortedJoinExecutor):
     def __init__(self, left: Executor, right: Executor, mesh: Mesh,
+                 mesh_shuffle: bool = True, mesh_shuffle_slack: int = 0,
                  **kwargs):
         self.mesh = mesh
         self.n_shards = mesh.shape[VNODE_AXIS]
         self._routing = jnp.asarray(vnode_to_shard(self.n_shards))
+        self.mesh_shuffle = bool(mesh_shuffle)
+        self.mesh_shuffle_slack = int(mesh_shuffle_slack)
+        if self.mesh_shuffle_slack \
+                and kwargs.get("watchdog_interval", 1) is None:
+            raise ValueError(
+                "mesh_shuffle_slack > 0 needs the barrier watchdog fetch "
+                "(watchdog_interval=1): shuffle drops would otherwise go "
+                "unchecked — transfer-free pipelines must use slack 0 "
+                "(zero-drop sizing)")
+        self.mesh_shuffle_applies = 0
         super().__init__(left, right, **kwargs)
         shard, repl = P(VNODE_AXIS), P()
 
@@ -81,17 +102,54 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
                            shard)), donate_argnums=(2,),
                 name=f"sharded_join_apply_s{side}")
 
-        # sharded programs trace per (side, match_factor): the steady
-        # state uses the per-side factors, recovery's generous replay
-        # buffer gets its own trace instead of being refused
+        # ---- fused mesh shuffle: exchange + probe in ONE program ----
+        # the chunk enters SHARDED over the row axis; the in-mesh
+        # all_to_all routes rows to the shard owning their join-key
+        # vnode, then the local sorted state probes/updates exactly the
+        # owned rows. `dropped` (arg 3) accumulates shuffle overflow per
+        # shard for the barrier watchdog's fail-stop.
+        def make_apply_fused(side, mf):
+            def apply_fused(own, other, errs, dropped, chunk, wm):
+                cap = shuffle_cap_out(chunk.capacity, self.n_shards,
+                                      self.mesh_shuffle_slack)
+                local, n_drop = mesh_ingest_chunk(
+                    chunk, self.key_indices[side], self._routing,
+                    VNODE_AXIS, self.n_shards, cap)
+                out = self._apply_impl(_scalar_n(own), _scalar_n(other),
+                                       errs[0], local, wm, side,
+                                       match_factor=mf)
+                own2, odeg, cols, ops, vis, errs2, _ = out
+                return (_vec_n(own2), odeg, cols, ops, vis, errs2[None],
+                        (dropped[0] + n_drop)[None],
+                        own2.n.reshape((1,)))
+            # donation: the error + shuffle-drop accumulators (threaded);
+            # side states stay aliased by the snapshot diff base (_snap)
+            return jit_state(shard_map(
+                apply_fused, mesh=mesh,
+                in_specs=(shard, shard, shard, shard, shard, repl),
+                out_specs=(shard,) * 8), donate_argnums=(2, 3),
+                name=f"sharded_join_apply_fused_s{side}")
+
+        # sharded programs trace per (side, match_factor, fused): the
+        # steady state uses the per-side factors, recovery's generous
+        # replay buffer gets its own trace instead of being refused
         applies: dict = {}
 
         def apply_dispatch(own, other, errs, chunk, wm, side,
                            match_factor=None):
             mf = match_factor or self.match_factors[side]
-            key = (side, mf)
+            fused = (self.mesh_shuffle
+                     and chunk.capacity % self.n_shards == 0)
+            key = (side, mf, fused)
             if key not in applies:
-                applies[key] = make_apply(side, mf)
+                applies[key] = (make_apply_fused(side, mf) if fused
+                                else make_apply(side, mf))
+            if fused:
+                (own2, odeg, cols, ops, vis, errs2, self._dropped_dev,
+                 n) = applies[key](own, other, errs, self._dropped_dev,
+                                   chunk, wm)
+                self.mesh_shuffle_applies += 1
+                return own2, odeg, cols, ops, vis, errs2, n
             return applies[key](own, other, errs, chunk, wm)
         self._apply = apply_dispatch
 
@@ -113,7 +171,17 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
         zero = jax.device_put(
             jnp.zeros(self.n_shards, dtype=jnp.int32), sharding)
         self._n_dev = [zero, zero]
+        # own buffer, NOT an alias of `zero`: the fused apply DONATES the
+        # drop accumulator, and donating a buffer `_n_dev` still holds
+        # would delete it out from under the watchdog fetch
+        self._dropped_dev = jax.device_put(
+            jnp.zeros(self.n_shards, dtype=jnp.int32), sharding)
         self.sides = [self._sharded_empty(s) for s in (LEFT, RIGHT)]
+        # one packed fetch per barrier: summed errs + shuffle drops
+        self._watchdog_pack_sh = jit_state(
+            lambda errs, dr: jnp.concatenate(
+                [jnp.sum(errs, axis=0), jnp.sum(dr)[None]]),
+            name="sharded_join_watchdog_pack")
 
     def _sharded_empty(self, side: int) -> SortedSideState:
         S = self.n_shards
@@ -253,6 +321,15 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
         self.sides[s] = self._sharded_empty(s)
 
     # ------------------------------------------------- HBM memory manager
+    @property
+    def mem_shards(self) -> int:
+        """Shard count for the memory manager's per-shard breakdown
+        (the side states split evenly over the mesh axis)."""
+        return self.n_shards
+
+    def state_shard_bytes(self) -> int:
+        return self.state_bytes() // self.n_shards
+
     def _mem_local_slices(self, s: int) -> list:
         """Spill programs run per shard slice — each is a valid local
         sorted side (the same shape trick the sharded persist diff uses),
@@ -269,8 +346,19 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
 
     # --------------------------------------------------------- watchdog
     def _check_watchdog(self) -> None:
-        errs = np.asarray(self._errs_dev).sum(axis=0)
-        n_mo, n_miss, n_ro = (int(x) for x in errs)
+        vals = np.asarray(self._watchdog_pack_sh(self._errs_dev,
+                                                 self._dropped_dev))
+        n_mo, n_miss, n_ro, n_drop = (int(x) for x in vals)
+        if n_drop:
+            # fail-stop before this epoch's checkpoint commits (same
+            # contract as the sharded agg's shuffle-overflow check)
+            from ..utils.metrics import MESH_SHUFFLE_DROPPED
+            MESH_SHUFFLE_DROPPED.inc(n_drop)
+            raise RuntimeError(
+                f"mesh shuffle overflow: {n_drop} rows dropped en route "
+                f"to their owner shard (per-pair send capacity sized by "
+                f"mesh_shuffle_slack={self.mesh_shuffle_slack}; 0 = "
+                f"zero-drop sizing)")
         if n_mo:
             raise RuntimeError(
                 f"sharded-join match-buffer overflow ({n_mo} dropped)")

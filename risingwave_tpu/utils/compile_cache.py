@@ -6,42 +6,87 @@ so steady state never recompiles), which makes them ideal persistent-
 cache citizens: a bench/CI/profile re-run of the same query shape skips
 the 2-6s (CPU) to 60-120s (tunneled-TPU) compile entirely.
 
+The cache directory is NAMESPACED by backend + host machine fingerprint:
+XLA:CPU AOT artifacts embed the COMPILE machine's CPU feature set, and
+jax's cache key does not include the host's — loading an artifact
+compiled on a different machine spams `cpu_aot_loader` "machine type
+doesn't match" warnings and risks SIGILL (MULTICHIP_r05's tail is full
+of exactly that: a cache directory shared between the tunnel host and
+the bench host). `<base>/<backend>-<fingerprint>/` keeps each
+(backend, machine) pair's artifacts to itself while still sharing one
+base directory across bench, CI gates and workers on the same host.
+
 `enable_persistent_cache()` is idempotent and safe before OR after jax
 import: it prefers `jax.config.update` (wins over env-var readers and
 sitecustomize overrides) and falls back to the environment for
-subprocesses that import jax later. Every entry point that re-runs
-canned shapes calls it: bench.py, the scripts/*_profile.py CI gates,
-and the cluster worker (a compute node restarted by recovery recompiles
-nothing it compiled in a previous life).
+subprocesses that import jax later. The environment variable is set to
+the NAMESPACED directory, so children on the same machine inherit it
+without re-deriving (re-application detects an already-namespaced path
+and leaves it alone). Every entry point that re-runs canned shapes
+calls it: bench.py, the scripts/*_profile.py CI gates, and the cluster
+worker (a compute node restarted by recovery recompiles nothing it
+compiled in a previous life).
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
 
 DEFAULT_MIN_COMPILE_SECS = 2.0
 
 
 def default_cache_dir() -> str:
-    """Repo-local cache dir (shared by bench, CI gates, and workers on
-    one machine; the content hash includes backend + compiler version,
-    so mixed cpu/tpu use is safe)."""
+    """Repo-local cache BASE dir (namespaced per backend + machine
+    below; see module docstring)."""
     return os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))), ".jax_cache")
 
 
+def machine_fingerprint() -> str:
+    """Stable per-host fingerprint of the CPU feature set — the exact
+    axis the XLA:CPU AOT loader validates (`cpu_aot_loader.cc` compares
+    compile-machine features against the executing host's)."""
+    bits = [platform.machine(), platform.system()]
+    try:
+        # x86 exposes `flags`, aarch64 `Features` — either line is the
+        # feature set AOT artifacts are specialized to
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    bits.append(line.split(":", 1)[1].strip())
+                    break
+    except OSError:
+        bits.append(platform.processor() or "")
+    return hashlib.sha256(" ".join(bits).encode()).hexdigest()[:12]
+
+
+def cache_namespace() -> str:
+    """`<backend>-<machine fingerprint>` leaf directory name."""
+    backend = (os.environ.get("JAX_PLATFORMS") or "default"
+               ).split(",")[0].strip() or "default"
+    return f"{backend}-{machine_fingerprint()}"
+
+
 def enable_persistent_cache(cache_dir: str | None = None,
                             min_compile_secs: float =
                             DEFAULT_MIN_COMPILE_SECS) -> str:
-    """Point jax's persistent compilation cache at `cache_dir` (default:
-    <repo>/.jax_cache). Returns the directory in effect. Environment
-    variables are ALSO set so child processes (bench query subprocesses,
-    cluster workers) inherit the cache without their own call."""
-    d = cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR") \
+    """Point jax's persistent compilation cache at the namespaced
+    directory under `cache_dir` (default: <repo>/.jax_cache, or an
+    externally-provided JAX_COMPILATION_CACHE_DIR treated as the base).
+    Returns the directory in effect. The environment variable is set to
+    the NAMESPACED directory so child processes (bench query
+    subprocesses, cluster workers) inherit it as-is."""
+    base = cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR") \
         or default_cache_dir()
+    ns = cache_namespace()
+    # idempotent under re-application (the env round-trip hands children
+    # the already-namespaced path)
+    d = base if os.path.basename(base) == ns else os.path.join(base, ns)
     os.makedirs(d, exist_ok=True)
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", d)
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = d
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
                           str(min_compile_secs))
     try:

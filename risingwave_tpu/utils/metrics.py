@@ -295,6 +295,18 @@ SERVING_TIMEOUTS = GLOBAL_METRICS.counter("serving_timeouts_total")
 # barrier_stall_threshold_ms; the one-shot report rides stdout/logs.
 BARRIER_STALLS = GLOBAL_METRICS.counter("barrier_stalls_total")
 
+# Mesh-parallel fragment execution (parallel/exchange.py +
+# stream/sharded_*.py): rows the in-mesh all_to_all shuffle dropped
+# because a (src, dst) send bucket overflowed its per-pair capacity
+# (streaming_mesh_shuffle_slack sized it too tight for the key skew).
+# Nonzero is a FAIL-STOP: the owning executor raises at the barrier
+# watchdog fetch before the epoch's checkpoint commits, so a dropped
+# row is never silently absent from durable state.
+# `mesh_fragment_shards{actor=...}` gauges ride alongside once fused
+# mesh fragments register with the barrier coordinator.
+MESH_SHUFFLE_DROPPED = GLOBAL_METRICS.counter(
+    "mesh_shuffle_dropped_rows_total")
+
 # Changelog log store (logstore/): exactly-once egress + subscriptions.
 # Bytes staged into the durable per-table logs (sink delivery logs + MV
 # changelog logs), epochs/rows the background delivery handed to sink

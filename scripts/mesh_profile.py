@@ -1,0 +1,198 @@
+"""Mesh-execution gate — fused mesh fragment vs host-exchange path.
+
+Runs the same q7-shaped windowed-agg SQL twice on an 8-device VIRTUAL
+CPU mesh (`--xla_force_host_platform_device_count=8` — no TPU needed):
+
+  host   SET streaming_parallelism = 8          8 actors, HashDispatcher
+                                                + host channels + Merge
+  mesh   SET streaming_parallelism_devices = 8  ONE actor, the whole
+                                                exchange -> sharded-agg
+                                                chain fused into one
+                                                shard_map program per
+                                                barrier interval
+                                                (lax.all_to_all shuffle)
+
+Exit status is 0 iff ALL hold:
+  * BOTH paths' materialized results equal the host recount of the
+    generator prefix at their exact source offsets (sources free-run
+    between paced barriers, so offsets are load-dependent; exact
+    content equality at the observed offset is the deterministic form
+    of "identical results" — any common prefix agrees transitively)
+  * fused device dispatches per interval strictly below the host path's
+    (the fused program count must not scale with shard count)
+  * the fused plane actually engaged: mesh_shuffle_applies > 0, the
+    fragment registered with the coordinator as ONE actor x 8 shards,
+    and zero mesh_shuffle_dropped_rows_total
+
+    JAX_PLATFORMS=cpu python scripts/mesh_profile.py
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# 8 virtual devices BEFORE jax initializes (tests/conftest.py discipline)
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+from risingwave_tpu.utils.compile_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+N_DEVICES = 8
+WARMUP_ROUNDS = 3
+MEASURE_ROUNDS = 6
+W = 10_000_000          # 10s tumble window, microseconds
+
+# q7-shaped windowed agg, with `auction` added to the group key so the
+# vnode routing actually spreads over all 8 shards (window_end alone is
+# one vnode per interval — maximal skew, which the zero-drop sizing
+# handles but which exercises only one shard's table)
+SQL = ("SELECT auction, window_end, max(price) AS maxprice, "
+       "count(*) AS n "
+       f"FROM TUMBLE(bid, date_time, {W}) GROUP BY auction, window_end")
+
+
+def _oracle(n: int) -> list:
+    """Host recount of the first n bid rows: per tumble window
+    (max(price), count(*)) — the single-device semantics of SQL above."""
+    import numpy as np
+    from risingwave_tpu.connectors import NexmarkGenerator
+    gen = NexmarkGenerator("bid", chunk_size=max(256, n))
+    c = gen.next_chunk()
+    cols = [np.asarray(col.data)[:n] for col in c.columns]
+    auction, price, ts = cols[0], cols[2], cols[5]
+    we = ts - ts % W + W
+    agg: dict = {}
+    for a, w, p in zip(auction, we, price):
+        k = (int(a), int(w))
+        m, cnt = agg.get(k, (0, 0))
+        agg[k] = (max(m, int(p)), cnt + 1)
+    return sorted((a, w, m, cnt) for (a, w), (m, cnt) in agg.items())
+
+
+def _dispatches() -> int:
+    from risingwave_tpu.utils.metrics import GLOBAL_METRICS
+    snap = GLOBAL_METRICS.snapshot()
+    return int(sum(e["value"] for e in snap.get("device_dispatch_count", [])
+                   if not e["labels"]))
+
+
+def _sources(session):
+    from risingwave_tpu.stream.source import SourceExecutor
+    out = []
+    for mv in session.catalog.mvs.values():
+        for roots in mv.deployment.roots.values():
+            for root in roots:
+                node = root
+                while node is not None:
+                    if isinstance(node, SourceExecutor):
+                        out.append(node)
+                    node = getattr(node, "input", None)
+    return out
+
+
+def _sharded_aggs(session):
+    from risingwave_tpu.stream.sharded_agg import ShardedHashAggExecutor
+    out = []
+    for mv in session.catalog.mvs.values():
+        for roots in mv.deployment.roots.values():
+            for root in roots:
+                node = root
+                while node is not None:
+                    if isinstance(node, ShardedHashAggExecutor):
+                        out.append(node)
+                    node = getattr(node, "input", None)
+    return out
+
+
+async def _run(mode: str) -> dict:
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.utils.metrics import MESH_SHUFFLE_DROPPED
+    s = Session()
+    await s.execute("SET streaming_durability = 0")
+    if mode == "mesh":
+        await s.execute(f"SET streaming_parallelism_devices = {N_DEVICES}")
+    else:
+        await s.execute(f"SET streaming_parallelism = {N_DEVICES}")
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=256, rate_limit=1024)")
+    await s.execute(f"CREATE MATERIALIZED VIEW m AS {SQL}")
+    aggs = _sharded_aggs(s)
+    n_actors = len(s.coord.actor_ids)
+    mesh_frags = dict(s.coord.mesh_fragments)
+    await s.tick(WARMUP_ROUNDS)
+    drop0 = MESH_SHUFFLE_DROPPED.value
+    d0 = _dispatches()
+    await s.tick(MEASURE_ROUNDS)
+    d1 = _dispatches()
+    # quiesce BEFORE reading: sources free-run between barriers, so
+    # without a Pause the connector offset runs ahead of the last
+    # materialized interval and the oracle comparison races (bench.py's
+    # quiesce phase, same reason)
+    from risingwave_tpu.stream.message import PauseMutation
+    b = await s.coord.inject_barrier(mutation=PauseMutation())
+    await s.coord.wait_collected(b)
+    rows = sorted(s.query(
+        "SELECT auction, window_end, maxprice, n FROM m"))
+    offset = max(g.connector.offset for g in _sources(s))
+    out = {
+        "mode": mode,
+        "actors": n_actors,
+        "mesh_fragments": {str(a): n for a, (n, _) in mesh_frags.items()},
+        "dispatches_per_interval": round((d1 - d0) / MEASURE_ROUNDS, 2),
+        "rows": len(rows),
+        "offset": offset,
+        "matches_oracle": rows == _oracle(offset),
+        "fused_applies": sum(a.mesh_shuffle_applies for a in aggs),
+        "sharded_aggs": len(aggs),
+        "shuffle_dropped": int(MESH_SHUFFLE_DROPPED.value - drop0),
+    }
+    await s.drop_all()
+    return out
+
+
+async def main() -> int:
+    host = await _run("host")
+    mesh = await _run("mesh")
+    verdict = {
+        "results_identical_to_oracle": (host["matches_oracle"]
+                                        and mesh["matches_oracle"]),
+        "dispatch_reduction": round(
+            host["dispatches_per_interval"]
+            / max(mesh["dispatches_per_interval"], 1e-9), 2),
+        "one_actor_covers_8_shards": (
+            mesh["sharded_aggs"] == 1
+            and mesh["mesh_fragments"]
+            and all(n == N_DEVICES
+                    for n in mesh["mesh_fragments"].values())),
+        "fused_plane_engaged": mesh["fused_applies"] > 0,
+        "zero_shuffle_drops": mesh["shuffle_dropped"] == 0,
+    }
+    print(json.dumps(host))
+    print(json.dumps(mesh))
+    print(json.dumps({"verdict": verdict}))
+    ok = (verdict["results_identical_to_oracle"]
+          and mesh["dispatches_per_interval"]
+          < host["dispatches_per_interval"]
+          and verdict["one_actor_covers_8_shards"]
+          and verdict["fused_plane_engaged"]
+          and verdict["zero_shuffle_drops"]
+          and mesh["rows"] > 0 and host["offset"] > 0
+          and mesh["offset"] > 0)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

@@ -1,0 +1,400 @@
+"""Fused mesh-fragment execution on the 8-device virtual CPU mesh
+(ISSUE 8 / ROADMAP item 2): the exchange -> sharded-executor chain runs
+as ONE shard_map program per barrier interval — rows vnode-route to
+their owner shard via an in-program lax.all_to_all
+(parallel/exchange.mesh_ingest_chunk) instead of replicate-and-mask or
+host channel hops.
+
+Covered here:
+  * bit-identical results vs the single-device executor for a q7-shaped
+    agg and a q5-shaped windowed join, incl. crash -> recover from a
+    committed epoch through the fused layout
+  * device dispatches per interval do not scale with shard count (one
+    fused program per interval, not N per-shard programs)
+  * shuffle-overflow fail-stop (mesh_shuffle_dropped_rows_total) when
+    mesh_shuffle_slack undersizes the per-pair send buckets
+  * mesh fragments register with the barrier coordinator as ONE actor
+    covering all shards
+  * persistent-compile-cache namespacing by backend + machine
+    fingerprint (the MULTICHIP_r05 cpu_aot_loader hazard)
+"""
+
+import asyncio
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.common import DataType, schema
+from risingwave_tpu.common.chunk import StreamChunk
+from risingwave_tpu.common.epoch import EpochPair
+from risingwave_tpu.expr.agg import AggCall, AggKind, agg_sum, count_star
+from risingwave_tpu.parallel import make_mesh
+from risingwave_tpu.stream import Barrier, BarrierKind, HashAggExecutor
+from risingwave_tpu.stream.executor import Executor
+from risingwave_tpu.stream.sharded_agg import ShardedHashAggExecutor
+from risingwave_tpu.stream.sharded_join import ShardedSortedJoinExecutor
+from risingwave_tpu.utils.metrics import GLOBAL_METRICS, MESH_SHUFFLE_DROPPED
+
+W = 10_000_000
+BID = schema(("auction", DataType.INT64), ("price", DataType.INT64),
+             ("wend", DataType.INT64))
+
+
+class ScriptSource(Executor):
+    def __init__(self, sch, messages):
+        self.schema = sch
+        self.messages = messages
+        self.identity = "ScriptSource"
+        self.pk_indices = ()
+
+    async def execute(self):
+        for m in self.messages:
+            yield m
+            await asyncio.sleep(0)
+
+
+def barrier(curr, prev, kind=BarrierKind.CHECKPOINT):
+    return Barrier(EpochPair(curr, prev), kind)
+
+
+def bid_chunk(rng, n=64, cap=64, epoch=0):
+    auction = rng.integers(0, 40, n).astype(np.int64)
+    price = rng.integers(1, 10_000, n).astype(np.int64)
+    ts = (epoch * W // 2 + rng.integers(0, W, n)).astype(np.int64)
+    wend = ts - ts % W + W
+    return StreamChunk.from_numpy(BID, [auction, price, wend],
+                                  capacity=cap)
+
+
+def q7_messages(seed=5, intervals=4, chunks_per=3):
+    rng = np.random.default_rng(seed)
+    msgs = [barrier(1, 0, BarrierKind.INITIAL)]
+    ep = 2
+    for i in range(intervals):
+        for _ in range(chunks_per):
+            msgs.append(bid_chunk(rng, epoch=i))
+        msgs.append(barrier(ep, ep - 1))
+        ep += 1
+    return msgs
+
+
+async def drive(ex):
+    out = []
+    async for m in ex.execute():
+        out.append(m)
+    return out
+
+
+def changelog(out):
+    """Accumulated MV content from a changelog stream (keyed upsert)."""
+    from risingwave_tpu.common.chunk import OP_DELETE, OP_UPDATE_DELETE
+    mv = Counter()
+    for m in out:
+        if isinstance(m, StreamChunk):
+            for op, row in m.to_rows():
+                if op in (OP_DELETE, OP_UPDATE_DELETE):
+                    mv[row] -= 1
+                    if mv[row] == 0:
+                        del mv[row]
+                else:
+                    mv[row] += 1
+    return mv
+
+
+def _fused_dispatches():
+    snap = GLOBAL_METRICS.snapshot()
+    return sum(e["value"] for e in snap.get("device_dispatch_count", [])
+               if "fused" in e["labels"].get("program", ""))
+
+
+# ------------------------------------------------------------------ agg
+
+async def test_fused_agg_bit_identical_and_one_dispatch_per_interval():
+    """q7-shaped agg (MAX(price), count per tumble window) through the
+    fused mesh plane: bit-identical to the single-device executor, and
+    the whole multi-chunk interval is ONE fused device dispatch."""
+    msgs = q7_messages()
+    mesh = make_mesh(8)
+    sh = ShardedHashAggExecutor(
+        ScriptSource(BID, msgs), [2],
+        [AggCall(AggKind.MAX, 1, BID[1].data_type, append_only=True),
+         count_star()],
+        mesh=mesh, capacity=64)
+    assert sh.mesh_shuffle, "fused plane must be the default"
+    d0 = _fused_dispatches()
+    got = changelog(await drive(sh))
+    d1 = _fused_dispatches()
+    plain = HashAggExecutor(
+        ScriptSource(BID, msgs), [2],
+        [AggCall(AggKind.MAX, 1, BID[1].data_type, append_only=True),
+         count_star()],
+        capacity=512)
+    want = changelog(await drive(plain))
+    assert got == want and len(got) > 0
+    # 4 intervals x 3 chunks: one fused scan dispatch per interval —
+    # chunk count amortized by the in-program lax.scan, shard count by
+    # shard_map (N per-shard programs would be 8x this)
+    assert sh.mesh_shuffle_applies == 4
+    assert d1 - d0 == 4, f"expected 4 fused dispatches, saw {d1 - d0}"
+
+
+async def test_fused_agg_crash_recover_bit_identical():
+    """Fused layout through persist -> crash -> recover from the
+    committed epoch -> more input: accumulated MV equals an unsharded
+    full run with no crash (exactly the durable contract)."""
+    from risingwave_tpu.state import MemoryStateStore, StateTable
+
+    rng = np.random.default_rng(11)
+
+    def chunks(n):
+        return [bid_chunk(rng, epoch=i) for i in range(n)]
+
+    phase1, phase2 = chunks(2), chunks(2)
+    store = MemoryStateStore()
+
+    def make_table():
+        return StateTable(
+            store, table_id=9,
+            schema=schema(("wend", DataType.INT64),
+                          ("mx", DataType.INT64),
+                          ("count", DataType.INT64),
+                          ("sum", DataType.INT64),
+                          ("_row_count", DataType.INT64)),
+            pk_indices=[0])
+
+    calls = [AggCall(AggKind.MAX, 1, BID[1].data_type, append_only=True),
+             count_star(), agg_sum(1)]
+    mesh = make_mesh(8)
+    msgs1 = [barrier(1, 0, BarrierKind.INITIAL), phase1[0], barrier(2, 1),
+             phase1[1], barrier(3, 2)]
+    sh1 = ShardedHashAggExecutor(
+        ScriptSource(BID, msgs1), [2], calls, mesh=mesh, capacity=64,
+        state_table=make_table())
+    out1 = await drive(sh1)
+    assert sh1.mesh_shuffle_applies > 0
+    store.sync(2)
+    del sh1                    # crash: device state dies
+
+    msgs2 = [barrier(3, 2, BarrierKind.INITIAL), phase2[0], barrier(4, 3),
+             phase2[1], barrier(5, 4)]
+    sh2 = ShardedHashAggExecutor(
+        ScriptSource(BID, msgs2), [2], calls, mesh=mesh, capacity=64,
+        state_table=make_table())
+    out2 = await drive(sh2)
+    got = changelog(out1 + out2)
+
+    full = [barrier(1, 0, BarrierKind.INITIAL), phase1[0], barrier(2, 1),
+            phase1[1], barrier(3, 2), phase2[0], barrier(4, 3),
+            phase2[1], barrier(5, 4)]
+    plain = HashAggExecutor(ScriptSource(BID, full), [2], calls,
+                            capacity=512)
+    want = changelog(await drive(plain))
+    assert got == want and len(got) > 0
+
+
+async def test_fused_agg_non_divisible_capacity_falls_back():
+    """A chunk whose capacity does not divide by the shard count cannot
+    row-slice over the mesh — it must take the replicated-mask path and
+    still produce identical results."""
+    rng = np.random.default_rng(7)
+    msgs = [barrier(1, 0, BarrierKind.INITIAL),
+            bid_chunk(rng, n=44, cap=44),        # 44 % 8 != 0
+            barrier(2, 1)]
+    mesh = make_mesh(8)
+    sh = ShardedHashAggExecutor(
+        ScriptSource(BID, msgs), [0], [count_star(), agg_sum(1)],
+        mesh=mesh, capacity=32)
+    got = changelog(await drive(sh))
+    assert sh.mesh_shuffle_applies == 0, "44-cap chunk must not fuse"
+    plain = HashAggExecutor(
+        ScriptSource(BID, msgs), [0], [count_star(), agg_sum(1)],
+        capacity=256)
+    want = changelog(await drive(plain))
+    assert got == want and len(got) > 0
+
+
+async def test_shuffle_overflow_fail_stops_epoch():
+    """An undersized mesh_shuffle_slack drops rows in the all_to_all —
+    the barrier watchdog must FAIL-STOP the epoch (raise before the
+    checkpoint) and bump mesh_shuffle_dropped_rows_total, never commit
+    silently short."""
+    # every row shares ONE group key -> one vnode -> every row routes to
+    # a single shard: per-(src,dst) demand is the full 32-row slice,
+    # slack=1 sizes the bucket at ceil(32/8)*1 = 64-floored... use a
+    # large chunk so the floor (64) is genuinely exceeded
+    n = 8 * 512
+    cols = [np.zeros(n, dtype=np.int64),
+            np.arange(n, dtype=np.int64),
+            np.full(n, W, dtype=np.int64)]
+    ch = StreamChunk.from_numpy(BID, cols, capacity=n)
+    msgs = [barrier(1, 0, BarrierKind.INITIAL), ch, barrier(2, 1)]
+    mesh = make_mesh(8)
+    sh = ShardedHashAggExecutor(
+        ScriptSource(BID, msgs), [0], [count_star()], mesh=mesh,
+        capacity=1024, mesh_shuffle_slack=1)
+    before = MESH_SHUFFLE_DROPPED.value
+    with pytest.raises(RuntimeError, match="mesh shuffle overflow"):
+        await drive(sh)
+    assert MESH_SHUFFLE_DROPPED.value > before
+
+
+async def test_slack_requires_watchdog():
+    """slack > 0 with the watchdog fetch disabled would let a checkpoint
+    commit unchecked drops — refused loudly at construction."""
+    mesh = make_mesh(8)
+    with pytest.raises(ValueError, match="mesh_shuffle_slack"):
+        ShardedHashAggExecutor(
+            ScriptSource(BID, []), [0], [count_star()], mesh=mesh,
+            capacity=32, watchdog_interval=None, mesh_shuffle_slack=2)
+
+
+async def test_fused_agg_with_slack_zero_drops_balanced_keys():
+    """A balanced key set under slack=4 shrinks the receive buffers
+    (near-linear per-shard compute) with zero drops and identical
+    results (host-recomputed expectation — count/sum per auction)."""
+    msgs = q7_messages(seed=9, intervals=2, chunks_per=2)
+    mesh = make_mesh(8)
+    sh = ShardedHashAggExecutor(
+        ScriptSource(BID, msgs), [0], [count_star(), agg_sum(1)],
+        mesh=mesh, capacity=64, mesh_shuffle_slack=4)
+    before = MESH_SHUFFLE_DROPPED.value
+    got = changelog(await drive(sh))
+    assert MESH_SHUFFLE_DROPPED.value == before
+    agg: dict = {}
+    for m in msgs:
+        if isinstance(m, StreamChunk):
+            for _, row in m.to_rows():
+                n, sp = agg.get(row[0], (0, 0))
+                agg[row[0]] = (n + 1, sp + row[1])
+    want = Counter({(a, n, sp): 1 for a, (n, sp) in agg.items()})
+    assert got == want and len(got) > 0
+
+
+# ----------------------------------------------------------------- join
+
+JOIN_SQL = (f"SELECT P.id, P.window_start "
+            f"FROM TUMBLE(person, date_time, {W}) P "
+            f"JOIN TUMBLE(auction, date_time, {W}) A "
+            f"ON P.id = A.seller AND P.window_start = A.window_start")
+
+
+async def _mk_join_sources(s):
+    await s.execute(
+        "CREATE SOURCE person WITH (connector='nexmark', table='person', "
+        "primary_key='id', chunk_size=128, rate_limit=256, "
+        "emit_watermarks=1)")
+    await s.execute(
+        "CREATE SOURCE auction WITH (connector='nexmark', "
+        "table='auction', primary_key='id', chunk_size=384, "
+        "rate_limit=768, emit_watermarks=1)")
+
+
+def _join_oracle(s, mv):
+    """Host recount of the windowed join at the MV's committed offsets."""
+    from oracle import committed_offsets, nexmark_prefix
+    offs = committed_offsets(s, mv)
+    p = nexmark_prefix("person", offs["person"])
+    a = nexmark_prefix("auction", offs["auction"])
+    persons: dict = {}
+    for pid, ts in zip(p[0], p[6]):
+        w = int(ts) - int(ts) % W
+        persons.setdefault(w, set()).add(int(pid))
+    exp = Counter()
+    for seller, ts in zip(a[7], a[5]):
+        w = int(ts) - int(ts) % W
+        if int(seller) in persons.get(w, ()):
+            exp[(int(seller), w)] += 1
+    return exp
+
+
+async def test_fused_join_planned_bit_identical_and_recovers(tmp_path):
+    """q5/q8-shaped windowed equi-join through the PLANNED fused mesh
+    fragment: the sharded join engages the fused shuffle, one mesh
+    fragment registers per sharded chain (ONE actor x 8 shards), the
+    results match the host recount at the exact committed offsets
+    (single-device semantics), and a crash recovers from the committed
+    epoch with the fused layout intact."""
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+    store = HummockStateStore(LocalFsObjectStore(str(tmp_path / "d")))
+    s = Session(store=store)
+    await _mk_join_sources(s)
+    await s.execute("SET streaming_parallelism_devices = 8")
+    await s.execute("SET streaming_join_capacity = 16384")
+    await s.execute(f"CREATE MATERIALIZED VIEW mj AS {JOIN_SQL}")
+    joins = []
+    for roots in s.catalog.mvs["mj"].deployment.roots.values():
+        for root in roots:
+            node = root
+            while node is not None:
+                if isinstance(node, ShardedSortedJoinExecutor):
+                    joins.append(node)
+                node = getattr(node, "input", None)
+    assert len(joins) == 1 and joins[0].mesh_shuffle
+    # the fused chain registered as ONE actor covering 8 shards
+    assert any(n == 8 for n, _ in s.coord.mesh_fragments.values())
+    await s.tick(2)
+    assert joins[0].mesh_shuffle_applies > 0, "fused join never engaged"
+
+    # crash one actor -> auto-recovery from the committed epoch
+    victim = s.catalog.mvs["mj"].deployment.tasks[-1]
+    victim.cancel()
+    try:
+        await victim
+    except (asyncio.CancelledError, Exception):
+        pass
+    await s.tick(2, max_recoveries=8)
+    assert s.recoveries >= 1
+    joins2 = []
+    for roots in s.catalog.mvs["mj"].deployment.roots.values():
+        for root in roots:
+            node = root
+            while node is not None:
+                if isinstance(node, ShardedSortedJoinExecutor):
+                    joins2.append(node)
+                node = getattr(node, "input", None)
+    assert joins2 and joins2[0].mesh_shuffle, \
+        "recovery replanned without the fused mesh"
+    got = Counter(s.query("SELECT id, window_start FROM mj"))
+    assert got == _join_oracle(s, "mj")
+    assert sum(got.values()) > 0
+    # mesh fragment registry survives recovery; dropping the MV clears it
+    assert s.coord.mesh_fragments
+    await s.drop_all()
+    assert not s.coord.mesh_fragments
+
+
+# ------------------------------------------------- compile-cache namespace
+
+def test_compile_cache_namespaced_by_backend_and_machine(tmp_path,
+                                                         monkeypatch):
+    """Satellite: AOT artifacts must not be shared across backends or
+    host machines (MULTICHIP_r05's cpu_aot_loader 'machine type does
+    not match' tail) — the persistent cache namespaces by
+    <backend>-<machine fingerprint> and is idempotent."""
+    import jax
+    from risingwave_tpu.utils import compile_cache as cc
+    orig = jax.config.jax_compilation_cache_dir
+    try:
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path))
+        d1 = cc.enable_persistent_cache()
+        fp = cc.machine_fingerprint()
+        assert d1 == str(tmp_path / f"cpu-{fp}")
+        import os
+        assert os.path.isdir(d1)
+        assert os.environ["JAX_COMPILATION_CACHE_DIR"] == d1
+        # idempotent: re-application (the child-process env round trip)
+        # must not nest another namespace level
+        d2 = cc.enable_persistent_cache()
+        assert d2 == d1
+        # a different backend gets its own namespace under the same base
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path))
+        monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+        d3 = cc.enable_persistent_cache()
+        assert d3 == str(tmp_path / f"tpu-{fp}") and d3 != d1
+        # fingerprint is stable per host
+        assert cc.machine_fingerprint() == fp
+    finally:
+        jax.config.update("jax_compilation_cache_dir", orig)
