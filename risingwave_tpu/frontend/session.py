@@ -191,6 +191,28 @@ class Session:
         # than this logs format_stuck_barrier_report once and bumps
         # barrier_stalls_total; 0 disables the watchdog
         "barrier_stall_threshold_ms": (60000, int),
+        # 1 (default): exchange channels buffer the uncommitted message
+        # suffix (trimmed at every checkpoint commit) and an actor
+        # failure whose blast radius is contained to ONE terminal
+        # fragment rebuilds only that fragment's actors from the last
+        # committed epoch — upstream fragments keep their device state
+        # and replay the in-flight interval from the channel buffers.
+        # 0: every failure takes the full stop-the-world recovery.
+        "partial_recovery": (1, int),
+        # exponential-backoff base between CONSECUTIVE auto-recovery
+        # attempts inside one tick (the first recovery is immediate; a
+        # persistent fault then waits base*2^(n-1) with +-50% jitter,
+        # capped at 5s, instead of hot-looping through max_recoveries).
+        # 0 disables the backoff. recovery_backoff_seconds_total counts
+        # the waited seconds.
+        "recovery_backoff_ms": (50, int),
+        # deterministic fault injection (utils/faults.py): named fault
+        # points armed by spec, e.g.
+        #   SET fault_injection = 'actor_crash:actor=4,at=2'
+        #   SET fault_injection = 'upload_fail;recovery_crash:phase=full'
+        # '' disarms. ZERO hot-path cost when off (sites guard on one
+        # attribute read). Consumed by scripts/chaos_profile.py.
+        "fault_injection": ("", str),
         # cluster mode (cluster/): comma-separated compute-node
         # addresses ("host:port,host:port"). Setting it attaches the
         # session's coordinator to the workers as a meta service: every
@@ -230,6 +252,10 @@ class Session:
         if blob:
             self._ddl_log = list(json.loads(blob)["ddl"])
         self.recoveries = 0
+        # most recent auto-recovery: {"scope","cause","duration_s",
+        # "actors"} — surfaced by /healthz (meta/monitor_service.py)
+        self.last_recovery = None
+        self.env.partial_recovery = bool(self.config["partial_recovery"])
         # monitor HTTP endpoint (SET monitor_port / start_monitor)
         self.monitor = None
         # changelog subscription endpoint (SET subscription_port /
@@ -501,6 +527,17 @@ class Session:
                 self._apply_obs_config()
                 if self.cluster is not None:
                     await self.cluster.push_config()
+            elif stmt.name == "partial_recovery":
+                # build-time knob: channels allocated after this carry
+                # (or not) the replay buffers; classification also
+                # re-checks it at failure time
+                self.env.partial_recovery = bool(self.config[stmt.name])
+            elif stmt.name == "fault_injection":
+                from ..utils.faults import FAULTS
+                try:
+                    FAULTS.arm(self.config[stmt.name])
+                except ValueError as e:
+                    raise BindError(str(e))
             elif stmt.name == "cluster":
                 await self._configure_cluster(self.config[stmt.name])
             elif stmt.name == "monitor_port":
@@ -1069,10 +1106,17 @@ class Session:
         """Advance the session's barrier loop (meta's periodic injection).
 
         Barrier-collection failure (a dead actor) triggers AUTOMATIC
-        recovery — stop everything, rebuild the whole topology from the
-        DDL log, resume from the last committed epoch — and the tick is
-        retried; no operator in the loop (reference:
-        meta/src/barrier/recovery.rs:332-625)."""
+        recovery and the tick is retried; no operator in the loop
+        (reference: meta/src/barrier/recovery.rs:332-625). The failure
+        is first CLASSIFIED (`_classify_failure`): a blast radius
+        contained to one terminal fragment rebuilds only that
+        fragment's actors from the last committed epoch (upstream
+        keeps its device state, channels replay the in-flight
+        interval); anything wider falls back to the full stop-the-world
+        rebuild. Consecutive attempts back off exponentially with
+        jitter (`recovery_backoff_ms`) so a persistent fault cannot
+        hot-loop through `max_recoveries`; a crash DURING recovery
+        (mid DDL replay) counts as an attempt and is retried too."""
         if not self.catalog.mvs and not self.catalog.sinks:
             return
         attempts = 0
@@ -1081,10 +1125,260 @@ class Session:
                 await self.coord.run_rounds(rounds, interval_s=interval_s)
                 return
             except RuntimeError:
-                attempts += 1
-                if attempts > max_recoveries:
-                    raise
-                await self._auto_recover()
+                recovered = False
+                while not recovered:
+                    attempts += 1
+                    if attempts > max_recoveries:
+                        raise
+                    await self._recovery_backoff(attempts)
+                    try:
+                        await self._recover_auto(
+                            cause_hint="recovery_retry"
+                            if attempts > 1 else None)
+                        recovered = True
+                    except asyncio.CancelledError:
+                        raise
+                    except BaseException:
+                        # recovery itself died (kill-during-recovery):
+                        # the DDL log is intact, the next attempt
+                        # replays it from scratch
+                        continue
+
+    async def _recovery_backoff(self, attempt: int) -> None:
+        """Exponential backoff with +-50% jitter between consecutive
+        recovery attempts; the FIRST recovery of a tick is immediate
+        (fast path for the common one-shot fault)."""
+        base = self.config.get("recovery_backoff_ms", 50) / 1000.0
+        if attempt < 2 or base <= 0:
+            return
+        import random
+        from ..utils.metrics import RECOVERY_BACKOFF
+        delay = min(base * (2 ** (attempt - 2)), 5.0) \
+            * (0.5 + random.random())
+        RECOVERY_BACKOFF.inc(delay)
+        await asyncio.sleep(delay)
+
+    # ------------------------------------------------------------ recovery
+    def _classify_failure(self):
+        """Blast-radius classification (reference: the recovery scope
+        decision in meta/src/barrier/recovery.rs — regional vs global).
+        Returns (scope, cause, flow, fid): scope "fragment" means every
+        reported failure maps into ONE terminal, replay-covered fragment
+        of one non-cluster deployment, so rebuilding just that fragment
+        from the committed epoch is exactly as correct as the full
+        rebuild; anything else is "full" with the cause named."""
+        coord = self.coord
+        if coord._upload_failure is not None:
+            return "full", "upload_failure", None, None
+        if coord.logstore.failure is not None:
+            return "full", "sink_delivery", None, None
+        failed = dict(coord.failed_actors)
+        if not failed:
+            return "full", "unknown", None, None
+        if any(aid < 0 for aid in failed):
+            return "full", "worker_death", None, None
+        if self.cluster is not None:
+            return "full", "cluster", None, None
+        if not bool(self.config.get("partial_recovery", 1)):
+            return "full", "partial_recovery_off", None, None
+        # locate the owning (flow, fragment) of every failed actor
+        sites = set()
+        flow = None
+        for aid in failed:
+            for f in (list(self.catalog.mvs.values())
+                      + list(self.catalog.sinks.values())):
+                fid = getattr(f.deployment, "actor_fragment",
+                              {}).get(aid)
+                if fid is not None:
+                    sites.add((id(f.deployment), fid))
+                    flow = f
+                    break
+            else:
+                return "full", "unknown_actor", None, None
+        if len(sites) > 1:
+            return "full", "multi_fragment", None, None
+        fid = next(iter(sites))[1]
+        dep = flow.deployment
+        if dep.rebuild_info is None:
+            return "full", "unsupported_deployment", None, None
+        if dep.fragment_consumers.get(fid):
+            # a downstream fragment consumed part of the in-flight
+            # interval's output — its uncommitted state is tainted, so
+            # the radius is not one fragment
+            return "full", "downstream_fragments", None, None
+        graph = dep.rebuild_info["graph"]
+        frag = graph.fragments[fid]
+        if getattr(frag, "remote_worker", None):
+            return "full", "remote_fragment", None, None
+        if any(aid in coord.mesh_fragments
+               for aid in dep.frag_actor_ids.get(fid, ())):
+            return "full", "mesh_fragment", None, None
+        kinds = {n.kind for n in _fragment_node_kinds(frag)}
+        if "stream_scan" in kinds:
+            return "full", "backfill_fragment", None, None
+        tap = getattr(flow, "tap", None)
+        if tap is not None and tap.channels:
+            # a live MV-on-MV consumer taps this fragment's output — it
+            # saw part of the in-flight interval
+            return "full", "downstream_tap", None, None
+        # the flow must be durable: a volatile fragment has no committed
+        # state to rebuild from
+        entry = next((e for e in self._ddl_log
+                      if e["name"] == flow.name
+                      and e["kind"] in ("mv", "sink")), None)
+        if entry is None or entry.get("config", {}).get(
+                "streaming_durability", 1) == 0:
+            return "full", "volatile", None, None
+        # every inbound edge must carry a replay buffer
+        for (u, d, k), mat in dep.rebuild_info["channels"].items():
+            if d != fid:
+                continue
+            for row in mat:
+                for ch in row:
+                    if not ch.replay_enabled:
+                        return "full", "unbuffered_edge", None, None
+        return "fragment", "actor_exception", flow, fid
+
+    async def _recover_auto(self, cause_hint=None) -> None:
+        """Classify, then recover at the narrowest correct scope. Any
+        exception during the partial path falls back to the full
+        rebuild — partial recovery is an optimization, never a weaker
+        correctness mode."""
+        import time as _time
+        t0 = _time.monotonic_ns()
+        scope, cause, flow, fid = self._classify_failure()
+        if cause == "unknown" and cause_hint:
+            # a retry after a crashed recovery starts from a fresh
+            # coordinator with no failure marker — name it honestly
+            cause = cause_hint
+        if scope == "fragment":
+            try:
+                rebuilt = await self._partial_recover(flow, fid)
+                self._note_recovery("fragment", cause, t0, rebuilt)
+                return
+            except asyncio.CancelledError:
+                raise
+            except BaseException:
+                cause = "partial_recovery_failed"
+        await self._auto_recover()
+        all_ids = sorted(
+            a.actor_id
+            for f in (list(self.catalog.mvs.values())
+                      + list(self.catalog.sinks.values()))
+            for a in f.deployment.actors)
+        self._note_recovery("full", cause, t0, all_ids)
+
+    def _note_recovery(self, scope: str, cause: str, t0_ns: int,
+                       actors) -> None:
+        import time as _time
+        from ..utils.metrics import (GLOBAL_METRICS, RECOVERY_BUCKETS,
+                                     RECOVERY_DURATION, RECOVERY_TOTAL)
+        dur_ns = _time.monotonic_ns() - t0_ns
+        RECOVERY_TOTAL.inc()
+        GLOBAL_METRICS.counter("recovery_total", scope=scope,
+                               cause=cause).inc()
+        RECOVERY_DURATION.observe(dur_ns / 1e9)
+        GLOBAL_METRICS.histogram("recovery_duration_seconds",
+                                 buckets=RECOVERY_BUCKETS,
+                                 scope=scope).observe(dur_ns / 1e9)
+        self.last_recovery = {"scope": scope, "cause": cause,
+                              "duration_s": round(dur_ns / 1e9, 6),
+                              "actors": list(actors)}
+        self.coord.tracer.note_recovery(scope, cause, dur_ns, actors)
+
+    async def _partial_recover(self, flow, fid: int) -> list[int]:
+        """Rebuild ONE terminal fragment in place (the narrow scope the
+        classifier proved safe): cancel its actors, discard its staged
+        uncommitted writes, rebuild the same actor/table ids from the
+        committed epoch, re-attach the terminal plumbing (tap, serving
+        hooks, changelog writers), arm channel replay, respawn. The
+        coordinator, every OTHER fragment's actors, and their device
+        state are untouched — upstream never re-backfills. Returns the
+        rebuilt actor ids (the chaos gate asserts this set is strictly
+        smaller than the full topology's)."""
+        from ..plan.build import rebuild_fragment
+        from ..utils.faults import FAULTS, FaultInjected
+        coord = self.coord
+        dep = flow.deployment
+        self.recoveries += 1
+        async with coord._rounds_lock:
+            # 1. let fully-collected checkpoints finish committing: after
+            # this the ONLY uncommitted staged state belongs to the
+            # failed (never-collected) epoch(s). Raises on a parked
+            # upload failure -> caller falls back to full recovery.
+            await coord.drain_uploads()
+            if FAULTS.active and FAULTS.hit(
+                    "recovery_crash", phase="partial") is not None:
+                raise FaultInjected("injected crash during partial "
+                                    "recovery")
+            # 2. cancel the fragment's actor tasks (dead and siblings)
+            ids = set(dep.frag_actor_ids[fid])
+            by_id = {a.actor_id: i for i, a in enumerate(dep.actors)}
+            for aid in sorted(ids):
+                t = dep.tasks[by_id[aid]]
+                if not t.done():
+                    t.cancel()
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
+            # 3. drop the fragment's staged uncommitted writes + pending
+            # deferred flushes; survivors' partial-epoch writes stay and
+            # commit with the next checkpoint (their dirty tracking
+            # already cleared at the failed barrier)
+            table_ids = set(dep.frag_tables.get(fid, {}).values())
+            clog = coord.logstore.mv_logs.get(flow.name)
+            if isinstance(flow, MvDef) and fid == flow.mv_fragment \
+                    and clog is not None:
+                table_ids.add(clog.table_id)
+            discard = getattr(self.store, "discard_staged_tables", None)
+            if discard is not None and table_ids:
+                discard(table_ids)
+            # 4. the coordinator survives: clear the failure marker and
+            # the never-collected epochs; injection resumes at the same
+            # epoch stream every surviving actor already follows
+            coord.clear_failure()
+            # 5. rebuild the fragment's actors (same ids, same tables)
+            self.env.memory_scope = flow.name
+            try:
+                new_actors = rebuild_fragment(dep, fid)
+            finally:
+                self.env.memory_scope = None
+            # 6. re-attach terminal plumbing
+            roots = dep.roots[fid]
+            if isinstance(flow, MvDef) and fid == flow.mv_fragment:
+                root_actor = next(a for a in new_actors
+                                  if a.consumer is roots[0])
+                assert root_actor.dispatcher is None
+                root_actor.dispatcher = flow.tap     # empty by contract
+                hooks = coord.serving.register_mv(
+                    flow.name, roots[0].table, roots[0].table.schema,
+                    roots[0].table.pk_indices, n_hooks=len(roots))
+                for r, h in zip(roots, hooks):
+                    r.serving_hook = h
+                if clog is not None:
+                    # same durable log (subscriptions keep their pumps);
+                    # FRESH writers — the old ones hold the aborted
+                    # interval's rows, which replay recomputes
+                    from ..logstore.log import MvChangelogWriter
+                    clog.state_table = roots[0].table
+                    clog.writers = [MvChangelogWriter(clog, i)
+                                    for i in range(len(roots))]
+                    for r, w in zip(roots, clog.writers):
+                        r.changelog_log = w
+            # 7. arm replay on every inbound edge, THEN spawn: the
+            # rebuilt consumers see a synthetic INITIAL barrier at the
+            # committed point, the buffered uncommitted suffix, then the
+            # live stream (queue duplicates skipped by sequence number)
+            for (u, d, k), mat in dep.rebuild_info["channels"].items():
+                if d != fid:
+                    continue
+                for row in mat:
+                    for ch in row:
+                        ch.begin_replay()
+            for a in new_actors:
+                dep.tasks[by_id[a.actor_id]] = a.spawn()
+        return sorted(ids)
 
     async def _auto_recover(self) -> None:
         """Tear down every actor, drop uncommitted store state, rebuild
@@ -1127,7 +1421,8 @@ class Session:
         self.env = BuildEnv(
             self.store, self.coord,
             chunk_coalesce_max=self.config.get(
-                "streaming_chunk_coalesce", 0))
+                "streaming_chunk_coalesce", 0),
+            partial_recovery=bool(self.config.get("partial_recovery", 1)))
         self.env.session = self
         self._apply_memory_config()
         # fresh ServingManager with the coordinator: every cache is
@@ -1149,8 +1444,17 @@ class Session:
         log = list(self._ddl_log)
         self._recovering = True
         saved_config = dict(self.config)
+        from ..utils.faults import FAULTS, FaultInjected
         try:
-            for entry in log:
+            for i, entry in enumerate(log):
+                if FAULTS.active and FAULTS.hit(
+                        "recovery_crash", phase="full",
+                        entry=i) is not None:
+                    # kill-during-recovery (chaos harness): the DDL log
+                    # is intact, tick retries the whole recovery
+                    raise FaultInjected(
+                        f"injected crash during recovery replay "
+                        f"(entry {i})")
                 self.env._next_table_id = entry.get(
                     "table_id_floor", self.env._next_table_id)
                 self._replay_parallelism = entry.get("parallelism", 1)
@@ -1295,6 +1599,23 @@ class Session:
         return await serving.pool.run(
             lambda: run_pinned_select(self.catalog, sel, pins, serving),
             cleanup=lambda: serving.unpin(pins))
+
+
+def _fragment_node_kinds(frag) -> list:
+    """Every plan Node of one fragment's tree (Exchange leaves excluded)
+    — the blast-radius classifier checks kinds (e.g. stream_scan) here."""
+    from ..plan.graph import Exchange
+    out = []
+
+    def walk(n):
+        if isinstance(n, Exchange):
+            return
+        out.append(n)
+        for i in n.inputs:
+            walk(i)
+
+    walk(frag.root)
+    return out
 
 
 def _render_batch_plan(sel) -> list:

@@ -53,6 +53,7 @@ from ..utils.metrics import (
 # key-space layout under one log table id
 _ENTRIES = 0x00        # log entries: tid ++ 0x00 ++ index_be8
 _CURSOR = 0x01         # delivery cursor: tid ++ 0x01
+_SUBCUR = 0x02         # durable subscription cursors: tid ++ 0x02 ++ name
 
 
 def _entry_key(table_id: int, index: int) -> bytes:
@@ -62,6 +63,16 @@ def _entry_key(table_id: int, index: int) -> bytes:
 
 def _cursor_key(table_id: int) -> bytes:
     return table_id.to_bytes(4, "big") + bytes([_CURSOR])
+
+
+def _sub_cursor_key(table_id: int, name: str) -> bytes:
+    return table_id.to_bytes(4, "big") + bytes([_SUBCUR]) \
+        + name.encode("utf-8")
+
+
+def _sub_cursor_range(table_id: int) -> tuple[bytes, bytes]:
+    return (table_id.to_bytes(4, "big") + bytes([_SUBCUR]),
+            table_id.to_bytes(4, "big") + bytes([_SUBCUR + 1]))
 
 
 def _entry_range(table_id: int, after_index: int) -> tuple[bytes, bytes]:
@@ -208,6 +219,10 @@ class MvChangelog:
         # activation — everything <= it is covered by the snapshot a
         # subscriber backfills from)
         self.active_from: Optional[int] = None
+        # retention floor this incarnation truncated to (the durable
+        # truth is the committed tombstones; this just avoids rescanning
+        # when nothing advanced)
+        self.truncated_below = 0
 
     @property
     def active(self) -> bool:
@@ -224,6 +239,59 @@ class MvChangelog:
 
     def deactivate(self) -> None:
         self.active_from = None
+
+    # ------------------------------------- durable subscription cursors
+    def persist_sub_cursor(self, name: str, cursor_epoch: int,
+                           stage_epoch: int) -> None:
+        """Stage a named subscription's delivered-through epoch; it
+        commits with the next checkpoint, so after a reconnect the
+        durable cursor is at or (by at most the delivery-to-checkpoint
+        window) behind what the subscriber actually applied — resuming
+        the tail from it re-delivers at most that window, which
+        epoch-keyed application dedupes."""
+        self.store.ingest_batch(WriteBatch(
+            self.table_id, stage_epoch,
+            {_sub_cursor_key(self.table_id, name):
+             cursor_epoch.to_bytes(8, "big")}))
+
+    def read_sub_cursor(self, name: str) -> Optional[int]:
+        v = self.store.get_committed(_sub_cursor_key(self.table_id, name))
+        return int.from_bytes(v, "big") if v is not None else None
+
+    def committed_sub_cursors(self) -> dict[str, int]:
+        start, end = _sub_cursor_range(self.table_id)
+        out = {}
+        for k, v in self.store.iter_range(start, end, committed_only=True):
+            out[k[5:].decode("utf-8")] = int.from_bytes(v, "big")
+        return out
+
+    def drop_sub_cursor(self, name: str, stage_epoch: int) -> None:
+        """Forget a named subscription (tombstone its durable cursor) —
+        without this an abandoned replica pins retention forever."""
+        self.store.ingest_batch(WriteBatch(
+            self.table_id, stage_epoch,
+            {_sub_cursor_key(self.table_id, name): None}))
+
+    # --------------------------------------------------------- retention
+    def truncate_below(self, floor_epoch: int, stage_epoch: int) -> None:
+        """Tombstone committed entries with epoch <= floor_epoch (the
+        minimum subscriber cursor): every subscriber — live pump or
+        durable named cursor — has already consumed them, so they ride
+        the next checkpoint out, exactly like the sink log's delivery-
+        cursor truncation. The log stays bounded by subscriber lag
+        instead of growing for the MV's lifetime."""
+        start, end = _entry_range(self.table_id, 0)
+        puts: dict[bytes, Optional[bytes]] = {}
+        for k, _v in self.store.iter_range(start, end,
+                                           committed_only=True):
+            if int.from_bytes(k[5:13], "big") <= floor_epoch:
+                puts[k] = None
+            else:
+                break
+        if puts:
+            self.store.ingest_batch(WriteBatch(
+                self.table_id, stage_epoch, puts))
+        self.truncated_below = max(self.truncated_below, floor_epoch)
 
     # ------------------------------------------------------------- reads
     def read_committed(self, after_epoch: int
@@ -392,6 +460,15 @@ class LogStoreHub:
                     state_table=None, n_writers: int = 1) -> MvChangelog:
         log = MvChangelog(self.store, table_id, schema, pk_indices,
                           state_table=state_table, n_writers=n_writers)
+        cursors = log.committed_sub_cursors()
+        if cursors:
+            # durable named cursors survive a restart: re-activate
+            # immediately so the rebuilt writers log every post-recovery
+            # epoch — entries in (min cursor, committed] are already
+            # durable in the log (retention floors at the min cursor),
+            # so a reconnecting subscriber's resume stays gapless across
+            # the crash
+            log.activate(min(cursors.values()))
         self.mv_logs[name] = log
         return log
 
@@ -406,9 +483,24 @@ class LogStoreHub:
     # ----------------------------------------------------------- commits
     def on_commit(self, epoch: int) -> None:
         """Pulsed by the coordinator at every checkpoint commit (inline
-        sync, background uploader, and cluster commit_remote paths)."""
+        sync, background uploader, and cluster commit_remote paths).
+        Also the MV-changelog retention point: entries below every
+        subscriber's cursor (live pumps AND durable named cursors) are
+        tombstoned, staged at the current open epoch so the truncation
+        rides the next checkpoint."""
         self.commit_seq += 1
         self._commit_event.set()
+        for name, log in self.mv_logs.items():
+            if not log.active:
+                continue
+            cursors = [p.cursor_epoch for p in self.subscriptions
+                       if p.mv == name]
+            cursors.extend(log.committed_sub_cursors().values())
+            if not cursors:
+                continue
+            floor = min(cursors)
+            if floor > log.truncated_below:
+                log.truncate_below(floor, self.collected_epoch)
 
     def on_barrier(self, barrier) -> None:
         """Collected-barrier hook: remember the sealed epoch — the

@@ -37,30 +37,65 @@ from ..serving.cache import SnapshotCache
 
 class ServingReplica:
     """One MV's read-only replica: a SnapshotCache maintained from the
-    changelog subscription."""
+    changelog subscription.
 
-    def __init__(self, mv: str):
+    `cursor_name` makes the subscription DURABLE (logstore cursor
+    keyspace): the server persists the replica's delivered-through
+    epoch with each checkpoint and keeps the changelog retained while
+    the replica is away, so `resubscribe()` after a connection loss
+    resumes the tail from the cursor — the snapshot the replica
+    already holds stays valid and no backfill rows ship."""
+
+    def __init__(self, mv: str, cursor_name: Optional[str] = None):
         self.mv = mv
+        self.cursor_name = cursor_name
         self.cache: Optional[SnapshotCache] = None
         self.sub_id: Optional[str] = None
         self.conn = None
         self._epoch_advanced = asyncio.Event()
         self.batches_applied = 0
+        self.resumed = False              # last (re)subscribe skipped backfill
         self.closed = False
 
     # ---------------------------------------------------------- connect
     @classmethod
-    async def connect(cls, host: str, port: int, mv: str
+    async def connect(cls, host: str, port: int, mv: str,
+                      cursor_name: Optional[str] = None
                       ) -> "ServingReplica":
+        self = cls(mv, cursor_name=cursor_name)
+        await self._subscribe(host, port)
+        return self
+
+    async def _subscribe(self, host: str, port: int) -> None:
         from ..cluster.rpc import RpcConn
-        self = cls(mv)
         reader, writer = await asyncio.open_connection(host, port)
         self.conn = RpcConn(reader, writer, handler=self._on_push,
                             on_closed=self._on_closed)
         self.conn.start()
-        backfill = await self.conn.call("subscribe", mv=mv)
-        self._install_backfill(backfill)
-        return self
+        # resume only when this process still HOLDS a snapshot to resume
+        # onto — a fresh replica must backfill even if a durable cursor
+        # survives from a previous incarnation
+        backfill = await self.conn.call(
+            "subscribe", mv=self.mv, cursor_name=self.cursor_name,
+            allow_resume=self.cache is not None)
+        self.closed = False
+        if backfill.get("resume"):
+            # keep the local snapshot; the tail continues past the
+            # durable cursor (epochs already applied dedupe in _on_push)
+            self.sub_id = backfill["sub_id"]
+            self.resumed = True
+        else:
+            self.resumed = False
+            self._install_backfill(backfill)
+
+    async def resubscribe(self, host: str, port: int) -> None:
+        """Reconnect after a dropped subscription. With a `cursor_name`
+        the server resumes the tail from the durable cursor (no
+        backfill, no cache rebuild); without one this is a fresh
+        backfill subscribe."""
+        if self.conn is not None and not self.conn.closed:
+            await self.conn.close()
+        await self._subscribe(host, port)
 
     def _install_backfill(self, backfill: dict) -> None:
         from ..state.state_table import StateTable
@@ -77,6 +112,11 @@ class ServingReplica:
 
     async def _on_push(self, method: str, args: dict) -> None:
         if method != "changelog" or args.get("sub_id") != self.sub_id:
+            return
+        if args["epoch"] <= self.epoch:
+            # re-delivery inside the cursor-persistence window (the
+            # durable cursor lags applied epochs by at most one
+            # checkpoint): the snapshot already reflects this epoch
             return
         # one committed epoch's effective changelog, in epoch order
         # (the pump pushes ascending; TCP preserves it)

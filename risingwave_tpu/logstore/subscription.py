@@ -47,13 +47,19 @@ class _SubscriptionPump:
     batch to the transport sink in epoch order."""
 
     def __init__(self, hub: LogStoreHub, mv: str, log: MvChangelog,
-                 cursor_epoch: int, sink, sub_id: str):
+                 cursor_epoch: int, sink, sub_id: str,
+                 cursor_name: Optional[str] = None):
         self.hub = hub
         self.mv = mv
         self.log = log
         self.cursor_epoch = cursor_epoch
         self.sink = sink                  # async (epoch, rows) -> None
         self.sub_id = sub_id
+        # durable cursor: a NAMED subscription persists its delivered-
+        # through epoch with each checkpoint, keeps the log active (and
+        # retention pinned) while disconnected, and resumes the tail
+        # from the cursor on reconnect instead of re-backfilling
+        self.cursor_name = cursor_name
         self.delivered_batches = 0
         self.closing = False
         self.task: Optional[asyncio.Task] = None
@@ -92,6 +98,11 @@ class _SubscriptionPump:
                 self.cursor_epoch = epoch
                 self.delivered_batches += 1
                 self._lag.dec()
+            if self.cursor_name is not None and pending:
+                # stage the durable cursor; it rides the next checkpoint
+                self.log.persist_sub_cursor(
+                    self.cursor_name, self.cursor_epoch,
+                    self.hub.collected_epoch)
 
     def stop(self) -> None:
         self.closing = True
@@ -101,18 +112,32 @@ class _SubscriptionPump:
             self.hub.subscriptions.remove(self)
         GLOBAL_METRICS.remove("logstore_subscription_lag_epochs",
                               subscription=f"{self.mv}/{self.sub_id}")
-        # last consumer gone -> stop paying the log writes
-        if not any(p.mv == self.mv for p in self.hub.subscriptions):
+        # last LIVE consumer gone -> stop paying the log writes — unless
+        # a durable named cursor is parked on the log: the whole point
+        # of the cursor is that a reconnect resumes the tail, which
+        # needs the log to keep accumulating while nobody is connected
+        if not any(p.mv == self.mv for p in self.hub.subscriptions) \
+                and not self.log.committed_sub_cursors():
             self.log.deactivate()
 
 
 async def open_subscription(hub: LogStoreHub, mv: str, sink,
-                            sub_id: str) -> tuple:
+                            sub_id: str,
+                            cursor_name: Optional[str] = None,
+                            allow_resume: bool = True) -> tuple:
     """Shared server-side subscribe: activate the MV's log, wait for the
     commit point to pass the activation floor, take the committed
     backfill snapshot, register the tail pump — snapshot epoch and
     pump cursor are assigned in ONE synchronous step, which is the
     whole no-gap/no-overlap argument.
+
+    `cursor_name` names a DURABLE cursor: the pump persists its
+    delivered-through epoch with each checkpoint, and a later subscribe
+    under the same name RESUMES the tail from the committed cursor —
+    no backfill rows ship (`backfill["resume"]` is True) when the log
+    has stayed active and retention has not passed the cursor; the
+    consumer keeps the snapshot it already has and continues applying
+    epochs > cursor. Otherwise the normal backfill runs.
 
     Returns (pump, backfill dict)."""
     from ..state.storage_table import StorageTable
@@ -124,6 +149,27 @@ async def open_subscription(hub: LogStoreHub, mv: str, sink,
             f"{mv!r} has no subscribable state table (cluster MVs keep "
             "their changelog in the workers — v1 subscriptions serve "
             "meta-local MVs)")
+    if cursor_name is not None and allow_resume and log.active:
+        cur = log.read_sub_cursor(cursor_name)
+        if cur is not None and cur >= log.active_from \
+                and cur >= log.truncated_below:
+            # resume: entries > cur are all retained (retention floors
+            # at the minimum cursor, which includes this one) and the
+            # log has been active since before the cursor — the tail
+            # from cur is gapless by the same argument as a fresh
+            # backfill handoff
+            pump = _SubscriptionPump(hub, mv, log, cur, sink, sub_id,
+                                     cursor_name=cursor_name)
+            hub.subscriptions.append(pump)
+            pump.spawn()
+            return pump, {
+                "sub_id": sub_id,
+                "table_id": log.state_table.table_id,
+                "schema": log.schema,
+                "pk_indices": tuple(log.pk_indices),
+                "epoch": cur,
+                "resume": True,
+            }
     log.activate(hub.collected_epoch)
     floor = log.active_from
     seen = hub.commit_seq
@@ -136,7 +182,8 @@ async def open_subscription(hub: LogStoreHub, mv: str, sink,
     e0 = hub.store.committed_epoch()
     storage = StorageTable.for_state_table(log.state_table)
     rows, keys = storage.snapshot_with_keys(committed_only=True)
-    pump = _SubscriptionPump(hub, mv, log, e0, sink, sub_id)
+    pump = _SubscriptionPump(hub, mv, log, e0, sink, sub_id,
+                             cursor_name=cursor_name)
     hub.subscriptions.append(pump)
     pump.spawn()
     backfill = {
@@ -153,11 +200,17 @@ async def open_subscription(hub: LogStoreHub, mv: str, sink,
 
 class ChangelogSubscription:
     """The local endpoint: `start()` returns the backfill, then
-    `next_batch()` pops (epoch, rows) tail batches in epoch order."""
+    `next_batch()` pops (epoch, rows) tail batches in epoch order.
+    `cursor_name` makes the subscription durable (see
+    `open_subscription`): a later incarnation under the same name
+    resumes the tail from the committed cursor instead of
+    re-backfilling."""
 
-    def __init__(self, hub: LogStoreHub, mv: str):
+    def __init__(self, hub: LogStoreHub, mv: str,
+                 cursor_name: Optional[str] = None):
         self.hub = hub
         self.mv = mv
+        self.cursor_name = cursor_name
         self.queue: asyncio.Queue = asyncio.Queue()
         self.pump: Optional[_SubscriptionPump] = None
         self.backfill: Optional[dict] = None
@@ -168,7 +221,8 @@ class ChangelogSubscription:
 
         self.pump, self.backfill = await open_subscription(
             self.hub, self.mv, sink,
-            sub_id=f"local{id(self) & 0xffff:04x}")
+            sub_id=f"local{id(self) & 0xffff:04x}",
+            cursor_name=self.cursor_name)
         return self.backfill
 
     async def next_batch(self, timeout: Optional[float] = None):
@@ -218,7 +272,10 @@ class SubscriptionServer:
                                         epoch=epoch, rows=rows)
 
                     pump, backfill = await open_subscription(
-                        self.hub, args["mv"], sink, sub_id)
+                        self.hub, args["mv"], sink, sub_id,
+                        cursor_name=args.get("cursor_name"),
+                        allow_resume=bool(args.get("allow_resume",
+                                                   True)))
                     pumps.append(pump)
                     return backfill
                 if method == "unsubscribe":

@@ -36,6 +36,7 @@ from ..memory.manager import MemoryManager
 from ..serving.manager import ServingManager
 from ..state.store import StateStore
 from ..stream.message import Barrier, BarrierKind, Mutation
+from ..utils.faults import FAULTS, FaultInjected
 
 
 @dataclass
@@ -72,6 +73,16 @@ class BarrierCoordinator:
         self.committed_epochs: list[int] = []
         self._stopped = False
         self._failure: Optional[tuple] = None
+        # EVERY reported failure this generation (actor_id -> exc):
+        # `_failure` keeps the first-reported one for messages, but the
+        # blast-radius classification must see all of them — two actors
+        # dying in one epoch across two fragments is a full recovery,
+        # not a partial rebuild of whichever reported last
+        self.failed_actors: dict[int, BaseException] = {}
+        # exchange channels with replay buffers (plan/build.py): trimmed
+        # at every checkpoint commit so each holds exactly the
+        # uncommitted message suffix per-fragment recovery would replay
+        self.replay_channels: list = []
         # Serializes whole ROUNDS (inject..collect) across concurrent
         # callers: the REPL's \tick / DDL bring-up can otherwise interleave
         # with the background ticker on the same coordinator, breaking the
@@ -267,13 +278,41 @@ class BarrierCoordinator:
         triggers global recovery, barrier/recovery.rs:332): a dead actor
         can never collect, so every in-flight and future barrier wait must
         fail fast instead of hanging the coordinator forever."""
-        self._failure = (actor_id, exc)
+        if self._failure is None:
+            self._failure = (actor_id, exc)
+        self.failed_actors[actor_id] = exc
         for st in self._epochs.values():
             st.done.set()
         # the failure path has its own diagnosis; a stall report on a
         # dead coordinator would be noise (and the task would otherwise
         # poll the never-deleted failed epoch forever)
         self._stop_watchdog()
+
+    def clear_failure(self) -> None:
+        """Per-fragment recovery keeps THIS coordinator (surviving actors
+        hold references to it): drop the failure marker and every
+        never-collected epoch so injection resumes where it left off —
+        the next barrier continues from `_prev_epoch`, and a late
+        `collect` for a cleared epoch is ignored by construction."""
+        self._failure = None
+        self.failed_actors.clear()
+        for epoch in list(self._epochs):
+            self.tracer.end(epoch)
+            del self._epochs[epoch]
+        self._stalls_reported.clear()
+
+    # ------------------------------------------------ replay-buffer trims
+    def register_replay_channels(self, channels) -> None:
+        self.replay_channels.extend(channels)
+
+    def unregister_replay_channels(self, channels) -> None:
+        drop = {id(c) for c in channels}
+        self.replay_channels = [c for c in self.replay_channels
+                                if id(c) not in drop]
+
+    def _trim_replay_buffers(self, committed_epoch: int) -> None:
+        for ch in self.replay_channels:
+            ch.trim_replay(committed_epoch)
 
     # ------------------------------------------------------------ injection
     async def inject_barrier(self, mutation: Optional[Mutation] = None,
@@ -447,6 +486,7 @@ class BarrierCoordinator:
                         barrier.epoch.prev,
                         (res or {}).get("uncommitted_ssts", []))
                 self.logstore.on_commit(barrier.epoch.prev)
+                self._trim_replay_buffers(barrier.epoch.prev)
                 self.tracer.end(barrier.epoch.curr,
                                 sync_ns=time.monotonic_ns() - t_sync)
         else:
@@ -572,6 +612,7 @@ class BarrierCoordinator:
                     t3 = time.monotonic_ns()
                     self.committed_epochs.append(job.prev_epoch)
                     self.logstore.on_commit(job.prev_epoch)
+                    self._trim_replay_buffers(job.prev_epoch)
                     self.upload_busy_ns += t3 - t0
                     self._m_upload.observe((t2 - t0) / 1e9)
                     self._m_commit.observe((t3 - t2) / 1e9)
@@ -590,6 +631,17 @@ class BarrierCoordinator:
                         cont(payload)
                 batch = store.seal(job.prev_epoch)
                 t1 = time.monotonic_ns()
+                if FAULTS.active:
+                    # chaos harness: an injected store fault takes the
+                    # exact fail-stop path a real PUT error takes
+                    d = FAULTS.hit("upload_delay", epoch=job.prev_epoch)
+                    if d is not None:
+                        await asyncio.sleep(d.get("ms", 100) / 1e3)
+                    if FAULTS.hit("upload_fail",
+                                  epoch=job.prev_epoch) is not None:
+                        raise FaultInjected(
+                            f"injected upload_fail at epoch "
+                            f"{job.prev_epoch}")
                 await asyncio.to_thread(store.upload_sealed, batch)
                 t2 = time.monotonic_ns()
                 res = store.commit_sealed(batch)
@@ -600,6 +652,7 @@ class BarrierCoordinator:
                         job.prev_epoch,
                         (res or {}).get("uncommitted_ssts", []))
                 self.logstore.on_commit(job.prev_epoch)
+                self._trim_replay_buffers(job.prev_epoch)
                 self.upload_busy_ns += t3 - t0
                 self._m_seal.observe((t1 - t0) / 1e9)
                 self._m_upload.observe((t2 - t1) / 1e9)

@@ -79,7 +79,7 @@ class MonitorService:
             return (200, "text/plain; version=0.0.4; charset=utf-8",
                     GLOBAL_METRICS.render_prometheus())
         if path == "/healthz":
-            body = json.dumps({
+            payload = {
                 "status": "ok",
                 "committed_epoch": self._session.store.committed_epoch(),
                 "barrier_latency_p50_s":
@@ -92,7 +92,13 @@ class MonitorService:
                 "mesh_fragments": {str(aid): n for aid, (n, _)
                                    in coord.mesh_fragments.items()},
                 "recoveries": self._session.recoveries,
-            })
+            }
+            last = getattr(self._session, "last_recovery", None)
+            if last is not None:
+                # cause/scope/duration of the most recent auto-recovery
+                # (the recovery-time SLO's operator surface)
+                payload["last_recovery"] = last
+            body = json.dumps(payload)
             return 200, "application/json", body + "\n"
         if path == "/debug/traces":
             lines = []
@@ -102,6 +108,10 @@ class MonitorService:
                 lines.extend(t.render() for t in stuck)
             lines.append("== recent epochs ==")
             lines.extend(t.render() for t in coord.tracer.recent())
+            rec = coord.tracer.render_recoveries()
+            if rec:
+                lines.append("== recoveries ==")
+                lines.extend(rec)
             return 200, "text/plain; charset=utf-8", "\n".join(lines) + "\n"
         if path == "/debug/await_tree":
             from ..utils.trace import dump_task_tree

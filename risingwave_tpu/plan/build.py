@@ -54,7 +54,8 @@ class BuildEnv:
     and the barrier coordinator being wired up."""
 
     def __init__(self, store: StateStore, coord: BarrierCoordinator,
-                 channel_capacity: int = 64, chunk_coalesce_max: int = 0):
+                 channel_capacity: int = 64, chunk_coalesce_max: int = 0,
+                 partial_recovery: bool = True):
         self.store = store
         self.coord = coord
         self.channel_capacity = channel_capacity
@@ -62,6 +63,12 @@ class BuildEnv:
         # chunks up to this total capacity into one chunk per dispatch
         # (SET streaming_chunk_coalesce; common/chunk.py ChunkCoalescer)
         self.chunk_coalesce_max = chunk_coalesce_max
+        # exchange channels keep a replay buffer of the not-yet-committed
+        # message suffix so a failed terminal fragment can be rebuilt
+        # alone and fed the in-flight interval again (Channel.enable_
+        # replay; SET partial_recovery = 0 turns it off, every failure
+        # then takes the full-recovery path)
+        self.partial_recovery = partial_recovery
         self._next_table_id = 1
         self._next_actor_id = 1
         # session services for cross-MV nodes (stream_scan taps); set by
@@ -122,6 +129,18 @@ class Deployment:
     source_queues: list = field(default_factory=list)
     memory_names: list = field(default_factory=list)
     mesh_actor_ids: list = field(default_factory=list)
+    # ---- per-fragment recovery bookkeeping (frontend/session.py) ----
+    actor_fragment: dict = field(default_factory=dict)   # actor_id -> fid
+    frag_actor_ids: dict = field(default_factory=dict)   # fid -> [ids]
+    frag_memory_names: dict = field(default_factory=dict)
+    frag_source_queues: dict = field(default_factory=dict)
+    frag_tables: dict = field(default_factory=dict)      # fid -> table map
+    fragment_consumers: dict = field(default_factory=dict)
+    replay_channels: list = field(default_factory=list)
+    # everything rebuild_fragment needs to re-run one fragment's build:
+    # {"graph","env","channels","built_schema","consumers"}; None when
+    # the deployment came from a path without rebuild support (cluster)
+    rebuild_info: Optional[dict] = None
 
     def spawn(self) -> "Deployment":
         self.tasks = [a.spawn() for a in self.actors]
@@ -156,6 +175,9 @@ class Deployment:
                 self.coord.memory.unregister(n)
             for a in self.mesh_actor_ids:
                 self.coord.unregister_mesh_fragment(a)
+            unreg = getattr(self.coord, "unregister_replay_channels", None)
+            if unreg is not None and self.replay_channels:
+                unreg(self.replay_channels)
 
 
 def _iter_executor_chain(root):
@@ -177,7 +199,7 @@ def _iter_executor_chain(root):
 
 
 def _register_memory(dep: Deployment, env: BuildEnv, root,
-                     actor_id: int) -> None:
+                     actor_id: int, fid=None) -> None:
     """Register every stateful executor in the chain (duck-typed on
     `state_bytes`) with the coordinator's MemoryManager, labelled by the
     owning flow so operators can see which MV owns the HBM."""
@@ -187,6 +209,8 @@ def _register_memory(dep: Deployment, env: BuildEnv, root,
             name = env.coord.memory.register(
                 f"{scope}/{ex.identity}@a{actor_id}", ex)
             dep.memory_names.append(name)
+            if fid is not None:
+                dep.frag_memory_names.setdefault(fid, []).append(name)
 
 
 def _register_mesh(dep: Deployment, env: BuildEnv, root,
@@ -207,6 +231,64 @@ def _register_mesh(dep: Deployment, env: BuildEnv, root,
             return                  # one registration per actor
 
 
+def _build_fragment_actor(graph, env, dep, channels, built_schema,
+                          f, fid, idx, actor_id, vnode_bitmap,
+                          frag_tables, consumers):
+    """Build ONE actor of fragment `f` (executor chain from the node
+    tree, exchange legs resolved against the channel matrices, output
+    dispatcher) and register it everywhere — the shared body of the
+    initial `build_graph` loop and `rebuild_fragment` (per-fragment
+    recovery re-runs exactly this with the ORIGINAL actor id and table
+    map, so the rebuilt chain binds the same state)."""
+    ctx = ActorCtx(env=env, fragment=f, actor_id=actor_id,
+                   actor_idx=idx, vnode_bitmap=vnode_bitmap,
+                   table_ids=frag_tables)
+    # per-actor Exchange occurrence counters: the build walk visits
+    # leaves in the same pre-order as StreamGraph.edges()
+    edge_seen: dict[int, int] = {}
+
+    def build_node(n):
+        if isinstance(n, Exchange):
+            k = edge_seen.get(n.upstream, 0)
+            edge_seen[n.upstream] = k + 1
+            up = graph.fragments[n.upstream]
+            matrix = channels[(n.upstream, fid, k)]
+            sch = built_schema[n.upstream]
+            # terminate only on THIS actor's stop (a shared
+            # coordinator routes other deployments' stops here too)
+            stop_on = (lambda b, aid=ctx.actor_id: b.is_stop(aid))
+            co = env.chunk_coalesce_max
+            if up.dispatch == "simple" and up.parallelism > 1:
+                # NoShuffle: 1:1 actor pairing
+                return ChannelInput(matrix[idx][idx], sch,
+                                    stop_on=stop_on, coalesce_max=co,
+                                    actor_id=ctx.actor_id)
+            chans = [matrix[u][idx] for u in range(up.parallelism)]
+            if len(chans) == 1:
+                return ChannelInput(chans[0], sch, stop_on=stop_on,
+                                    coalesce_max=co,
+                                    actor_id=ctx.actor_id)
+            return MergeExecutor(chans, sch, stop_on=stop_on,
+                                 coalesce_max=co)
+        inputs = [build_node(i) for i in n.inputs]
+        return BUILDERS[n.kind](dict(n.args), inputs, ctx, id(n))
+
+    root = build_node(f.root)
+    dep.roots[fid].append(root)
+    _register_memory(dep, env, root, actor_id, fid=fid)
+    _register_mesh(dep, env, root, actor_id)
+    dispatcher = _dispatcher_for(graph, f, consumers[fid], channels, idx)
+    env.coord.register_actor(actor_id)
+    actor = Actor(actor_id, root, dispatcher, env.coord)
+    # streaming-stats registration rides the same walk as the memory
+    # manager's: per-actor series (metric_level=debug) appear labelled
+    # by the owning flow
+    env.coord.stats.register(env.memory_scope or "flow", actor, root)
+    dep.actor_fragment[actor_id] = fid
+    dep.frag_actor_ids.setdefault(fid, []).append(actor_id)
+    return root, actor
+
+
 def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
     env.pending_source_queues = []
     dep = Deployment(coord=env.coord)
@@ -221,17 +303,30 @@ def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
 
     # allocate the channel matrices first (consumers may be built after
     # producers, but the producer's dispatcher needs the channels)
+    replay = getattr(env, "partial_recovery", True)
     for fid in order:
         f = graph.fragments[fid]
         for d_fid, k in consumers[fid]:
             d = graph.fragments[d_fid]
-            channels[(fid, d_fid, k)] = [
+            mat = [
                 [Channel(env.channel_capacity) for _ in range(d.parallelism)]
                 for _ in range(f.parallelism)]
+            if replay and not getattr(d, "remote_worker", None):
+                for row in mat:
+                    for ch in row:
+                        ch.enable_replay()
+                        dep.replay_channels.append(ch)
+            channels[(fid, d_fid, k)] = mat
+    reg = getattr(env.coord, "register_replay_channels", None)
+    if reg is not None and dep.replay_channels:
+        # the coordinator trims every buffer at each checkpoint commit,
+        # keeping the replay window == the uncommitted suffix
+        reg(dep.replay_channels)
 
     for fid in order:
         f = graph.fragments[fid]
         dep.roots[fid] = []
+        dep.fragment_consumers[fid] = list(consumers[fid])
         if getattr(f, "remote_worker", None):
             # DCN placement (stream/remote_fragment.py): the fragment
             # runs in a worker process; locally it appears as ONE actor
@@ -275,59 +370,81 @@ def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
                    if f.parallelism > 1 else [None])
         # table ids are shared across a fragment's actors (vnode-split)
         frag_tables: dict = {}
+        dep.frag_tables[fid] = frag_tables
+        q_before = len(env.pending_source_queues)
         for idx in range(f.parallelism):
             actor_id = env.alloc_actor_id()
-            ctx = ActorCtx(env=env, fragment=f, actor_id=actor_id,
-                           actor_idx=idx, vnode_bitmap=bitmaps[idx],
-                           table_ids=frag_tables)
-            # per-actor Exchange occurrence counters: the build walk visits
-            # leaves in the same pre-order as StreamGraph.edges()
-            edge_seen: dict[int, int] = {}
-
-            def build_node(n):
-                if isinstance(n, Exchange):
-                    k = edge_seen.get(n.upstream, 0)
-                    edge_seen[n.upstream] = k + 1
-                    up = graph.fragments[n.upstream]
-                    matrix = channels[(n.upstream, fid, k)]
-                    sch = built_schema[n.upstream]
-                    # terminate only on THIS actor's stop (a shared
-                    # coordinator routes other deployments' stops here too)
-                    stop_on = (lambda b, aid=ctx.actor_id: b.is_stop(aid))
-                    co = env.chunk_coalesce_max
-                    if up.dispatch == "simple" and up.parallelism > 1:
-                        # NoShuffle: 1:1 actor pairing
-                        return ChannelInput(matrix[idx][idx], sch,
-                                            stop_on=stop_on,
-                                            coalesce_max=co)
-                    chans = [matrix[u][idx] for u in range(up.parallelism)]
-                    if len(chans) == 1:
-                        return ChannelInput(chans[0], sch, stop_on=stop_on,
-                                            coalesce_max=co)
-                    return MergeExecutor(chans, sch, stop_on=stop_on,
-                                         coalesce_max=co)
-                inputs = [build_node(i) for i in n.inputs]
-                return BUILDERS[n.kind](dict(n.args), inputs, ctx, id(n))
-
-            root = build_node(f.root)
-            dep.roots[fid].append(root)
-            _register_memory(dep, env, root, actor_id)
-            _register_mesh(dep, env, root, actor_id)
+            root, actor = _build_fragment_actor(
+                graph, env, dep, channels, built_schema, f, fid, idx,
+                actor_id, bitmaps[idx], frag_tables, consumers)
+            dep.actors.append(actor)
             if idx == 0:
                 built_schema[fid] = root.schema
-
-            dispatcher = _dispatcher_for(graph, f, consumers[fid],
-                                         channels, idx)
-            env.coord.register_actor(actor_id)
-            actor = Actor(actor_id, root, dispatcher, env.coord)
-            dep.actors.append(actor)
-            # streaming-stats registration rides the same walk as the
-            # memory manager's: per-actor series (metric_level=debug)
-            # appear labelled by the owning flow
-            env.coord.stats.register(env.memory_scope or "flow",
-                                     actor, root)
+        dep.frag_source_queues[fid] = list(
+            env.pending_source_queues[q_before:])
     dep.source_queues = list(env.pending_source_queues)
+    dep.rebuild_info = {"graph": graph, "env": env, "channels": channels,
+                        "built_schema": built_schema,
+                        "consumers": consumers}
     return dep
+
+
+def rebuild_fragment(dep: Deployment, fid: int) -> list[Actor]:
+    """Per-fragment recovery: tear down ONE fragment's registrations and
+    rebuild its actors in place — same actor ids, same table ids (the
+    shared `frag_tables` map re-binds every durable table), same channel
+    matrices (upstream producers keep their ends untouched). The caller
+    (Session._partial_recover) has already cancelled the old tasks,
+    discarded the fragment's staged writes, and arms channel replay
+    AFTER this returns, BEFORE spawning the new actors. Mirrors the
+    reference's partial/regional recovery, meta/src/barrier/recovery.rs
+    (only the failed fragment's actors are recreated)."""
+    info = dep.rebuild_info
+    assert info is not None, "deployment has no rebuild support"
+    graph, env = info["graph"], info["env"]
+    channels, built_schema = info["channels"], info["built_schema"]
+    consumers = info["consumers"]
+    f = graph.fragments[fid]
+    coord = env.coord
+
+    # drop the old incarnation's per-fragment registrations
+    for name in dep.frag_memory_names.pop(fid, []):
+        coord.memory.unregister(name)
+        if name in dep.memory_names:
+            dep.memory_names.remove(name)
+    for q in dep.frag_source_queues.pop(fid, []):
+        if q in coord.source_queues:
+            coord.source_queues.remove(q)
+        if q in dep.source_queues:
+            dep.source_queues.remove(q)
+    old_ids = dep.frag_actor_ids.pop(fid)
+    for aid in old_ids:
+        coord.stats.unregister(aid)
+        if aid in dep.mesh_actor_ids:
+            coord.unregister_mesh_fragment(aid)
+            dep.mesh_actor_ids.remove(aid)
+
+    # rebuild with the ORIGINAL ids; builders re-read durable state at
+    # their first barrier (the committed epoch — the caller discarded
+    # this fragment's staged suffix)
+    q_before = len(env.pending_source_queues)
+    dep.roots[fid] = []
+    bitmaps = (shard_vnode_bitmaps(f.parallelism)
+               if f.parallelism > 1 else [None])
+    frag_tables = dep.frag_tables[fid]
+    by_id = {a.actor_id: i for i, a in enumerate(dep.actors)}
+    new_actors = []
+    for idx in range(f.parallelism):
+        actor_id = old_ids[idx]
+        _root, actor = _build_fragment_actor(
+            graph, env, dep, channels, built_schema, f, fid, idx,
+            actor_id, bitmaps[idx], frag_tables, consumers)
+        dep.actors[by_id[actor_id]] = actor
+        new_actors.append(actor)
+    new_queues = env.pending_source_queues[q_before:]
+    dep.frag_source_queues[fid] = list(new_queues)
+    dep.source_queues.extend(new_queues)
+    return new_actors
 
 
 def _dispatcher_for(graph, f, cons, channels, idx):
@@ -785,7 +902,8 @@ def _build_stream_scan(args, inputs, ctx: ActorCtx, key):
         st = ctx.env.state_table(ctx.table_id(key), sch, (0,))
     return BackfillExecutor(
         ChannelInput(ch, mv.schema,
-                     stop_on=lambda b, aid=ctx.actor_id: b.is_stop(aid)),
+                     stop_on=lambda b, aid=ctx.actor_id: b.is_stop(aid),
+                     actor_id=ctx.actor_id),
         storage, state_table=st,
         batch_rows=args.get("batch_rows", 65536))
 

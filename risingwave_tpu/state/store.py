@@ -83,8 +83,12 @@ class StateStore:
     d2h + encode + ingest cost."""
 
     def __init__(self):
-        # FIFO of (epoch, stages); epoch = the shared-buffer epoch the
-        # flush writes into (must run before that epoch seals)
+        # FIFO of (epoch, stages, table_id); epoch = the shared-buffer
+        # epoch the flush writes into (must run before that epoch seals);
+        # table_id attributes the flush to its owning executor's primary
+        # state table so per-fragment recovery can discard exactly the
+        # rebuilt fragment's pending flushes (None = untagged, never
+        # discarded selectively)
         self._deferred: list[tuple] = []
         self.defer_enabled = False
 
@@ -93,18 +97,35 @@ class StateStore:
         for wait, cont in stages:
             cont(wait() if wait is not None else None)
 
-    def defer_flush(self, epoch: int, *stages) -> None:
+    def defer_flush(self, epoch: int, *stages, table_id=None) -> None:
         if self.defer_enabled:
-            self._deferred.append((epoch, stages))
+            self._deferred.append((epoch, stages, table_id))
         else:
             self._run_stages(stages)
 
     def take_deferred(self, epoch: int) -> list[tuple]:
         """Pop every stage list registered for epochs <= epoch, in
         registration order."""
-        taken = [st for e, st in self._deferred if e <= epoch]
+        taken = [st for e, st, _t in self._deferred if e <= epoch]
         self._deferred = [t for t in self._deferred if t[0] > epoch]
         return taken
+
+    def discard_staged_tables(self, table_ids) -> None:
+        """Per-fragment recovery: drop the STAGED (uncommitted shared-
+        buffer) writes and pending deferred flushes of exactly these
+        tables. The rest of the shared buffer — surviving fragments'
+        partial-epoch writes — stays put and commits with the next
+        checkpoint (`seal` sweeps every staged epoch <= its target), so
+        a survivor whose dirty tracking already cleared at the failed
+        barrier never loses its flushed rows. The rebuilt fragment
+        re-reads its tables at the committed view and re-stages the
+        replayed intervals itself."""
+        ids = set(table_ids)
+        self._deferred = [t for t in self._deferred if t[2] not in ids]
+        for buf in getattr(self, "_shared", {}).values():
+            for k in [k for k in buf
+                      if int.from_bytes(k[:4], "big") in ids]:
+                del buf[k]
 
     def run_deferred(self, epoch: int) -> None:
         for stages in self.take_deferred(epoch):

@@ -23,6 +23,7 @@ import time
 from typing import Optional, Protocol
 
 from ..common.chunk import StreamChunk
+from ..utils.faults import FAULTS, FaultInjected
 from .exchange import Dispatcher
 from .executor import Executor
 from .message import Barrier
@@ -92,6 +93,14 @@ class Actor:
                     obs.note_chunk_out(msg,
                                        dispatcher_fanout(self.dispatcher))
             elif isinstance(msg, Barrier):
+                if FAULTS.active and FAULTS.hit(
+                        "actor_crash", actor=self.actor_id,
+                        epoch=msg.epoch.curr) is not None:
+                    # before the dispatch: downstream never sees this
+                    # barrier, exactly like a mid-interval executor death
+                    raise FaultInjected(
+                        f"injected actor_crash at actor {self.actor_id} "
+                        f"epoch {msg.epoch.curr}")
                 barrier = msg.with_passed(self.actor_id)
                 if self.dispatcher is not None:
                     await self.dispatcher.dispatch(barrier)

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import time
+from collections import deque
 from typing import Optional, Sequence
 
 import jax
@@ -25,8 +26,9 @@ from ..common.chunk import (
 )
 from ..common.vnode import VNODE_COUNT, compute_vnodes
 from .executor import Executor
-from .message import Barrier, Message, Watermark
+from .message import Barrier, BarrierKind, Message, Watermark
 from ..ops.jit_state import jit_state
+from ..utils.faults import FAULTS, FaultInjected
 
 
 class Channel:
@@ -39,37 +41,128 @@ class Channel:
     attached when the sender's chain instruments) charges the same
     parked seconds to the actor that actually paid them — without it,
     "who is losing time to backpressure" and "who is causing it" were
-    conflated under one receiver-side label."""
+    conflated under one receiver-side label.
+
+    Replay buffering (per-fragment recovery, plan/build.py): with
+    `enable_replay()` every sent message is ALSO appended to an ordered
+    buffer tagged with a per-channel sequence number. The barrier
+    coordinator trims the buffer at every checkpoint COMMIT — it drops
+    everything up to and including the barrier that sealed the committed
+    epoch — so the buffer always holds exactly the not-yet-durable
+    suffix of the stream (bounded by the checkpoint in-flight window).
+    When the consuming fragment is rebuilt from the committed epoch,
+    `begin_replay()` re-delivers the whole buffer to the NEW consumer
+    (prefixed by a synthetic INITIAL barrier standing for the committed
+    point, so the rebuilt executors init/recover BEFORE any replayed
+    chunk); live queue entries the dead consumer never drained are
+    recognized by sequence number and skipped as duplicates, and a
+    producer parked on the full queue is unblocked by the new consumer's
+    normal draining. The producer never rewinds — its device state and
+    its emitted stream are untouched, which is the whole point."""
 
     def __init__(self, capacity: int = 16):
-        self.queue: asyncio.Queue[Message] = asyncio.Queue(maxsize=capacity)
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=capacity)
         self.obs = None
         self.send_obs = None
+        # replay machinery (None/off for plain channels — the hot path
+        # below stays the pre-recovery one)
+        self._buf = None                  # deque[(seq, msg)] | None
+        self._seq = 0
+        self._base_barrier = None         # last trimmed (committed) barrier
+        self._replay = None               # deque to deliver before queue
+        self._last_seq = 0                # max seq ever delivered
+
+    # ------------------------------------------------------------ replay
+    def enable_replay(self) -> None:
+        if self._buf is None:
+            self._buf = deque()
+
+    @property
+    def replay_enabled(self) -> bool:
+        return self._buf is not None
+
+    def trim_replay(self, committed_epoch: int) -> None:
+        """Drop buffered messages covered by the committed checkpoint:
+        everything up to and including the LAST barrier whose
+        `epoch.prev <= committed_epoch` (that barrier sealed the epoch;
+        all earlier messages are reflected in durable state). The
+        dropped barrier is remembered as the replay base — the epoch a
+        rebuilt consumer resumes from."""
+        buf = self._buf
+        if not buf:
+            return
+        cut, base = -1, None
+        for i, (_seq, m) in enumerate(buf):
+            if isinstance(m, Barrier) and m.epoch.prev <= committed_epoch:
+                cut, base = i, m
+        for _ in range(cut + 1):
+            buf.popleft()
+        if base is not None:
+            self._base_barrier = base
+
+    def begin_replay(self) -> int:
+        """Arm re-delivery of the buffered suffix to the next consumer.
+        Prepends a synthetic INITIAL barrier at the committed point (the
+        rebuilt chain's executors init their state tables and reload
+        durable state at their first barrier — which must precede every
+        replayed chunk). Returns the number of messages to replay."""
+        assert self._buf is not None, "replay not enabled on this channel"
+        items = deque(self._buf)
+        base = self._base_barrier
+        if base is not None:
+            items.appendleft((None, Barrier(
+                base.epoch, BarrierKind.INITIAL, None, (),
+                base.inject_time_ns)))
+        self._replay = items
+        return len(items)
 
     async def send(self, msg: Message) -> None:
+        item = msg
+        if self._buf is not None:
+            self._seq += 1
+            item = (self._seq, msg)
+            # buffer BEFORE the (possibly blocking) queue put: a sender
+            # parked on a full queue at rebuild time already has its
+            # message in the buffer, so replay covers it and the queued
+            # copy dedupes by seq when it finally lands
+            self._buf.append(item)
         obs = self.obs
         send_obs = self.send_obs
         if obs is None and send_obs is None:
-            await self.queue.put(msg)
+            await self.queue.put(item)
             return
         if self.queue.full():
             t0 = time.monotonic()
-            await self.queue.put(msg)
+            await self.queue.put(item)
             dt = time.monotonic() - t0
             if obs is not None:
                 obs.blocked_put.inc(dt)
             if send_obs is not None:
                 send_obs.inc(dt)
         else:
-            self.queue.put_nowait(msg)
+            self.queue.put_nowait(item)
         if obs is not None:
             obs.depth.set(float(self.queue.qsize()))
 
     async def recv(self) -> Message:
-        msg = await self.queue.get()
-        if self.obs is not None:
-            self.obs.depth.set(float(self.queue.qsize()))
-        return msg
+        if self._replay:
+            seq, msg = self._replay.popleft()
+            if seq is not None and seq > self._last_seq:
+                self._last_seq = seq
+            return msg
+        if self._buf is None:
+            msg = await self.queue.get()
+            if self.obs is not None:
+                self.obs.depth.set(float(self.queue.qsize()))
+            return msg
+        while True:
+            seq, msg = await self.queue.get()
+            if self.obs is not None:
+                self.obs.depth.set(float(self.queue.qsize()))
+            if seq <= self._last_seq:
+                continue            # duplicate of a replayed message
+            self._last_seq = seq
+            return msg
 
 
 # ------------------------------------------------------------- dispatchers
@@ -198,7 +291,7 @@ class ChannelInput(Executor):
     any Stop ends the stream."""
 
     def __init__(self, channel: Channel, schema, stop_on=None,
-                 coalesce_max: int = 0):
+                 coalesce_max: int = 0, actor_id=None):
         self.channel = channel
         self.schema = schema
         self.stop_on = stop_on
@@ -208,6 +301,9 @@ class ChannelInput(Executor):
         self.coalescer = (ChunkCoalescer(coalesce_max) if coalesce_max
                           else None)
         self.identity = "ChannelInput"
+        # owning actor id (fault-point context: poison_chunk/channel_stall
+        # rules filter on the CONSUMING actor)
+        self.actor_id = actor_id
         # owning actor's ActorObs (stream/monitor.py): recv waits are the
         # align component of the interval phase split
         self.obs = None
@@ -225,6 +321,15 @@ class ChannelInput(Executor):
                 obs.add_input_wait(time.monotonic_ns() - t0)
                 if isinstance(msg, StreamChunk):
                     obs.note_chunk_in()
+            if FAULTS.active and isinstance(msg, StreamChunk):
+                if FAULTS.hit("poison_chunk",
+                              actor=self.actor_id) is not None:
+                    raise FaultInjected(
+                        f"injected poison_chunk at consumer actor "
+                        f"{self.actor_id}")
+                stall = FAULTS.hit("channel_stall", actor=self.actor_id)
+                if stall is not None:
+                    await asyncio.sleep(stall.get("ms", 100) / 1e3)
             if co is not None:
                 if isinstance(msg, StreamChunk):
                     for out in co.push(msg):

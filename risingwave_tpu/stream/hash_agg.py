@@ -873,7 +873,8 @@ class HashAggExecutor(Executor):
 
         st.store.defer_flush(barrier.epoch.prev,
                              (wait_counts, cont_prepare),
-                             (wait_flat, cont_apply))
+                             (wait_flat, cont_apply),
+                             table_id=st.table_id)
 
     def _apply_evict_deletes(self, keys_np, n: int) -> None:
         width = sum(self._call_persist_width(j)

@@ -528,7 +528,8 @@ class HashJoinExecutor(Executor):
 
         jobs[0][0].store.defer_flush(barrier.epoch.prev,
                                      (wait_counts, cont_prepare),
-                                     (wait_flat, cont_apply))
+                                     (wait_flat, cont_apply),
+                                     table_id=jobs[0][0].table_id)
 
     def _evict_rows_impl(self, side_state: JoinSideState, wm, side: int):
         col = self.clean_cols[side]

@@ -402,7 +402,8 @@ class ShardedHashAggExecutor(HashAggExecutor):
 
         st.store.defer_flush(barrier.epoch.prev,
                              (wait_counts, cont_prepare),
-                             (wait_flat, cont_apply))
+                             (wait_flat, cont_apply),
+                             table_id=st.table_id)
 
     def recover(self, barrier_epoch: int) -> None:
         """Rebuild SHARDED device state: rows partition by

@@ -301,7 +301,8 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
 
         tables[0].store.defer_flush(barrier.epoch.prev,
                                     (wait_counts, cont_prepare),
-                                    (wait_flat, cont_apply))
+                                    (wait_flat, cont_apply),
+                                    table_id=tables[0].table_id)
 
     def _recover_reset(self, s: int, rows: list) -> None:
         """Per-shard capacity is sized by the WORST shard's row count

@@ -1,0 +1,172 @@
+"""Deterministic fault injection — the chaos harness's control surface.
+
+Reference: the reference engine gates its recovery tests on failpoints
+(`fail::fail_point!` sites compiled into meta/compute, armed per test by
+name — e.g. the barrier-recovery suite in meta/src/barrier/recovery.rs
+drives injected actor panics and storage errors). Same shape here: a
+process-global `FAULTS` injector with NAMED fault points compiled into
+the few places a real failure enters the system, armed from SQL
+(`SET fault_injection = '...'`) and consumed by `scripts/chaos_profile.py`
+plus the recovery tests.
+
+Fault points (site → effect when the rule fires):
+
+  actor_crash     stream/actor.py, at barrier receipt — the actor raises
+                  before dispatching the barrier (an executor exception
+                  at epoch N; filter `actor=`/`epoch=`)
+  poison_chunk    stream/exchange.py ChannelInput — the CONSUMER raises
+                  on the matching received chunk (a corrupt payload
+                  kills the fragment that read it, not the producer)
+  channel_stall   stream/exchange.py ChannelInput — the consumer parks
+                  `ms=` milliseconds on the matching chunk (exercises
+                  the stuck-barrier watchdog without a crash)
+  upload_fail     meta/barrier_manager.py uploader — the checkpoint
+                  upload raises (fail-stop parks, next injection
+                  triggers full recovery from the committed epoch)
+  upload_delay    same site, sleeps `ms=` before the upload
+  recovery_crash  frontend/session.py — a crash DURING recovery itself
+                  (mid DDL replay on the full path, mid rebuild on the
+                  partial path; `phase=` filters full|partial)
+
+Spec grammar (one statement, deterministic by construction — rules fire
+on exact occurrence counts, never on wall clock):
+
+    SET fault_injection = 'point[:k=v[,k=v ...]][;point ...]'
+    SET fault_injection = ''                -- disarm
+
+Per-rule keys: `at=N` fires on the Nth MATCHING hit (1-based, default 1);
+`times=M` keeps firing for M consecutive matching hits (default 1);
+`ms=N` the delay for stall/delay points; any other key is a context
+filter — the rule matches only calls whose context carries that exact
+value (e.g. `actor=3`, `epoch=42`, `phase=full`). A global `seed=N` rule
+seeds the RNG used by the optional `prob=P` key (probabilistic faults
+for soak runs; the CI gate uses exact counts only).
+
+Hot-path contract: every site guards with `if FAULTS.active:` — one
+attribute read when disarmed, no allocation, no call.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class FaultInjected(RuntimeError):
+    """Raised at a fault point — deliberately a RuntimeError subclass so
+    the failure takes the exact path a real actor/upload error takes."""
+
+
+@dataclass
+class FaultRule:
+    point: str
+    filters: dict = field(default_factory=dict)   # ctx key -> required value
+    at: int = 1           # fire on the at-th matching hit (1-based)
+    times: int = 1        # keep firing for this many matching hits
+    prob: Optional[float] = None
+    params: dict = field(default_factory=dict)    # ms=... etc.
+    hits: int = 0         # matching hits seen
+    fired: int = 0        # times actually fired
+
+    def matches(self, ctx: dict) -> bool:
+        return all(ctx.get(k) == v for k, v in self.filters.items())
+
+    @property
+    def exhausted(self) -> bool:
+        return self.prob is None and self.fired >= self.times
+
+
+def _parse_value(v: str):
+    try:
+        return int(v)
+    except ValueError:
+        try:
+            return float(v)
+        except ValueError:
+            return v
+
+
+class FaultInjector:
+    """Process-global, armed per session via `SET fault_injection`."""
+
+    # keys consumed by the injector itself; everything else is a filter
+    _CONTROL = ("at", "times", "prob", "ms")
+
+    def __init__(self):
+        self.active = False
+        self.rules: list[FaultRule] = []
+        self.fired_log: list[tuple[str, dict]] = []
+        self._rng = random.Random(0)
+
+    # --------------------------------------------------------------- arm
+    def arm(self, spec: str) -> None:
+        """Parse and install the rule set ('' disarms). Raises ValueError
+        on a malformed spec so `SET` rejects it at statement time."""
+        rules: list[FaultRule] = []
+        seed = 0
+        for part in (spec or "").split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            point, _, argstr = part.partition(":")
+            point = point.strip()
+            kv: dict = {}
+            for item in filter(None,
+                               (s.strip() for s in argstr.split(","))):
+                k, eq, v = item.partition("=")
+                if not eq:
+                    raise ValueError(
+                        f"fault_injection: expected k=v, got {item!r}")
+                kv[k.strip()] = _parse_value(v.strip())
+            if point == "seed":
+                seed = int(kv.get("value", 0))
+                continue
+            rule = FaultRule(
+                point,
+                filters={k: v for k, v in kv.items()
+                         if k not in self._CONTROL},
+                at=int(kv.get("at", 1)),
+                times=int(kv.get("times", 1)),
+                prob=kv.get("prob"),
+                params={k: kv[k] for k in ("ms",) if k in kv})
+            if rule.at < 1 or rule.times < 1:
+                raise ValueError("fault_injection: at/times must be >= 1")
+            rules.append(rule)
+        self._rng = random.Random(seed)
+        self.rules = rules
+        self.fired_log = []
+        self.active = bool(rules)
+
+    def disarm(self) -> None:
+        self.rules = []
+        self.active = False
+
+    # --------------------------------------------------------------- hit
+    def hit(self, point: str, **ctx) -> Optional[dict]:
+        """A fault point reports one occurrence. Returns the firing
+        rule's params (the site raises/sleeps as appropriate) or None.
+        Counting is per rule over MATCHING occurrences, so `at=N` is
+        deterministic for any deterministic call sequence."""
+        if not self.active:
+            return None
+        for r in self.rules:
+            if r.point != point or not r.matches(ctx):
+                continue
+            r.hits += 1
+            if r.prob is not None:
+                if self._rng.random() >= r.prob:
+                    continue
+            elif not (r.at <= r.hits < r.at + r.times):
+                continue
+            r.fired += 1
+            self.fired_log.append((point, dict(ctx)))
+            if all(x.exhausted for x in self.rules):
+                # cheap steady state once every rule has fired out
+                self.active = False
+            return dict(r.params)
+        return None
+
+
+# the process-default injector (sites import this; Session arms it)
+FAULTS = FaultInjector()

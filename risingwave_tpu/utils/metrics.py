@@ -307,6 +307,20 @@ BARRIER_STALLS = GLOBAL_METRICS.counter("barrier_stalls_total")
 MESH_SHUFFLE_DROPPED = GLOBAL_METRICS.counter(
     "mesh_shuffle_dropped_rows_total")
 
+# Recovery plane (frontend/session.py): every auto-recovery increments
+# `recovery_total{scope=fragment|full,cause=...}` (labelled series ride
+# alongside these process totals) and observes its wall-clock duration;
+# tick's exponential backoff between attempts accumulates into the
+# backoff counter. Buckets reach low because a per-fragment rebuild on a
+# warm process is milliseconds while a full DDL replay is seconds.
+RECOVERY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                    5.0, 10.0, 30.0)
+RECOVERY_TOTAL = GLOBAL_METRICS.counter("recovery_total")
+RECOVERY_DURATION = GLOBAL_METRICS.histogram(
+    "recovery_duration_seconds", buckets=RECOVERY_BUCKETS)
+RECOVERY_BACKOFF = GLOBAL_METRICS.counter(
+    "recovery_backoff_seconds_total")
+
 # Changelog log store (logstore/): exactly-once egress + subscriptions.
 # Bytes staged into the durable per-table logs (sink delivery logs + MV
 # changelog logs), epochs/rows the background delivery handed to sink

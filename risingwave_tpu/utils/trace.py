@@ -68,6 +68,26 @@ class EpochTracer:
     def __init__(self, keep: int = 64):
         self._ring: deque[EpochTrace] = deque(maxlen=keep)
         self._open: dict[int, EpochTrace] = {}
+        # recovery spans (frontend/session.py notes one per auto-
+        # recovery): rendered by /debug/traces next to the epoch spans
+        # so a post-mortem shows WHEN recovery ran, at what scope, for
+        # how long, and which actors were rebuilt
+        self.recoveries: deque[dict] = deque(maxlen=keep)
+
+    def note_recovery(self, scope: str, cause: str, duration_ns: int,
+                      actors=()) -> None:
+        self.recoveries.append({
+            "scope": scope, "cause": cause,
+            "duration_ns": int(duration_ns),
+            "actors": list(actors),
+            "at_ns": time.monotonic_ns()})
+
+    def render_recoveries(self) -> list[str]:
+        return [
+            (f"recovery scope={r['scope']} cause={r['cause']} "
+             f"{r['duration_ns'] / 1e6:.1f}ms "
+             f"rebuilt_actors={r['actors']}")
+            for r in self.recoveries]
 
     def begin(self, epoch: int) -> None:
         self._open[epoch] = EpochTrace(epoch, time.monotonic_ns())

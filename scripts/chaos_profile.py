@@ -1,0 +1,261 @@
+"""Chaos gate — deterministic fault injection over a q7-shaped durable run.
+
+Every fault class the FaultInjector models (utils/faults.py) is injected
+into its own fresh durable session running the q7 window aggregation
+(source -> project -> tumble project -> hash_agg -> materialize: four
+fragments, four actors — the same shape the recovery tests and
+logstore gate use):
+
+  mv_actor_crash   actor exception at the TERMINAL (materialize)
+                   fragment -> blast radius is one fragment: partial
+                   recovery rebuilds ONLY that actor; the agg fragment
+                   keeps its device state and the exchange channels
+                   replay the in-flight interval
+  poison_chunk     corrupt payload kills the CONSUMING (materialize)
+                   actor -> same partial scope
+  agg_actor_crash  actor exception UPSTREAM (hash_agg fragment, which
+                   has a downstream consumer) -> full recovery
+  upload_fail      checkpoint upload raises -> fail-stop -> full
+                   recovery from the committed epoch
+  kill_during_recovery  agg crash + a second crash injected MID
+                   DDL-REPLAY inside the first recovery -> the retry
+                   converges (recovery re-entrancy)
+  channel_stall    the consumer parks 400ms on one chunk -> NO recovery,
+                   the barrier just completes late
+  upload_delay     the checkpoint upload sleeps 400ms -> NO recovery,
+                   the pipelined commit just lands late (delivery and
+                   replay-buffer trims follow it)
+
+Exits non-zero unless ALL hold:
+
+  * every run converges BIT-IDENTICAL to the generator-prefix oracle:
+    the MV's rows equal a numpy recount of the bid generator prefix at
+    the committed source offset (window_end -> max(price));
+  * the single-fragment faults recover at scope=fragment and rebuild
+    STRICTLY FEWER actors than the full-recovery runs (asserted on the
+    actor-id sets reported in last_recovery);
+  * fragment-scope recovery p50 beats the full-recovery p50 on the same
+    shape AND stays under the absolute budget (0.5s on CPU — a partial
+    rebuild is host-side re-wiring plus state reload, not a DDL replay);
+  * recovery_total{scope=...,cause=...} and recovery_duration_seconds
+    render in /metrics, and /healthz carries the last-recovery fields
+    (scope/cause/duration) — recovery is observable end to end.
+
+CI usage (CPU backend):
+
+    JAX_PLATFORMS=cpu python scripts/chaos_profile.py
+"""
+
+import asyncio
+import json
+import os
+import sys
+import urllib.request
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from risingwave_tpu.utils.compile_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+WINDOW_US = 1_000_000
+FRAGMENT_P50_BUDGET_S = 0.5
+
+
+def _ddl() -> list:
+    return [
+        "SET streaming_watchdog = 0",
+        ("CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+         "chunk_size=128, inter_event_us=2000, rate_limit=512)"),
+        ("CREATE MATERIALIZED VIEW q7w AS "
+         "SELECT window_end, max(price) AS maxprice "
+         f"FROM TUMBLE(bid, date_time, {WINDOW_US}) GROUP BY window_end"),
+    ]
+
+
+def _oracle(offset: int) -> Counter:
+    """Numpy recount of the generator prefix: window_end -> max(price),
+    the exactly-once convergence target."""
+    import numpy as np
+    from risingwave_tpu.connectors import NexmarkGenerator
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig
+    gen = NexmarkGenerator("bid", chunk_size=max(256, offset),
+                           cfg=NexmarkConfig(inter_event_us=2000))
+    c = gen.next_chunk()
+    price = np.asarray(c.columns[2].data)[:offset]
+    dt = np.asarray(c.columns[5].data)[:offset]
+    we = dt - dt % WINDOW_US + WINDOW_US
+    out: Counter = Counter()
+    for w in np.unique(we):
+        out[(int(w), int(price[we == w].max()))] += 1
+    return out
+
+
+def _committed_offset(session) -> int:
+    from risingwave_tpu.state.storage_table import StorageTable
+    from risingwave_tpu.stream.source import SourceExecutor
+    dep = session.catalog.mvs["q7w"].deployment
+    for roots in dep.roots.values():
+        for root in roots:
+            node = root
+            while node is not None:
+                if isinstance(node, SourceExecutor):
+                    rows = list(StorageTable.for_state_table(
+                        node.state_table).batch_iter())
+                    return int(rows[0][1]) if rows else 0
+                node = getattr(node, "input", None)
+    raise AssertionError("no source executor")
+
+
+async def _run_fault(name: str, tmp: str, arm) -> dict:
+    """One fresh durable session, one injected fault class: warm up,
+    arm the injector, tick through the fault and its recovery, then
+    verify convergence against the oracle. `arm(session) -> spec`."""
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+    store = HummockStateStore(
+        LocalFsObjectStore(os.path.join(tmp, name)))
+    s = Session(store=store)
+    for sql in _ddl():
+        await s.execute(sql)
+    await s.tick(3)
+    spec = arm(s)
+    await s.execute(f"SET fault_injection = '{spec}'")
+    await s.tick(5, max_recoveries=4)
+    await s.execute("SET fault_injection = ''")
+    await s.tick(2)
+
+    offset = _committed_offset(s)
+    got = Counter(s.query("SELECT window_end, maxprice FROM q7w"))
+    expected = _oracle(offset)
+    total_actors = sorted(
+        a.actor_id
+        for f in list(s.catalog.mvs.values()) + list(s.catalog.sinks.values())
+        for a in f.deployment.actors)
+
+    # observability surfaces, scraped over a real socket
+    await s.start_monitor(0)
+    port = s.monitor.port
+
+    def _get(path: str) -> str:
+        # off the loop: the monitor serves ON this loop, so a blocking
+        # urlopen here would deadlock the scrape
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5).read().decode()
+
+    metrics = await asyncio.to_thread(_get, "/metrics")
+    healthz = json.loads(await asyncio.to_thread(_get, "/healthz"))
+    await s.stop_monitor()
+    out = {
+        "fault": name,
+        "converged": got == expected,
+        "offset": offset,
+        "mv_rows": sum(got.values()),
+        "recoveries": s.recoveries,
+        "last_recovery": s.last_recovery,
+        "total_actors": total_actors,
+        "metrics_recovery_total": "recovery_total" in metrics,
+        "metrics_recovery_duration":
+            "recovery_duration_seconds" in metrics,
+        "healthz_last_recovery": healthz.get("last_recovery"),
+    }
+    await s.drop_all()
+    return out
+
+
+def _mv_actor(session) -> int:
+    mv = session.catalog.mvs["q7w"]
+    return mv.deployment.frag_actor_ids[mv.mv_fragment][0]
+
+
+def _agg_actor(session) -> int:
+    """The hash_agg fragment's actor — upstream of the terminal one."""
+    from risingwave_tpu.plan.build import _iter_executor_chain
+    mv = session.catalog.mvs["q7w"]
+    dep = mv.deployment
+    for fid, roots in dep.roots.items():
+        if fid == mv.mv_fragment:
+            continue
+        for root in roots:
+            for ex in _iter_executor_chain(root):
+                if "HashAgg" in getattr(ex, "identity", ""):
+                    return dep.frag_actor_ids[fid][0]
+    raise AssertionError("no hash_agg fragment")
+
+
+async def main() -> int:
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="chaos_profile_")
+    results = []
+
+    results.append(await _run_fault(
+        "mv_actor_crash", tmp,
+        lambda s: f"actor_crash:actor={_mv_actor(s)},at=2"))
+    results.append(await _run_fault(
+        "poison_chunk", tmp,
+        lambda s: f"poison_chunk:actor={_mv_actor(s)},at=3"))
+    results.append(await _run_fault(
+        "agg_actor_crash", tmp,
+        lambda s: f"actor_crash:actor={_agg_actor(s)},at=2"))
+    results.append(await _run_fault(
+        "upload_fail", tmp, lambda s: "upload_fail:at=1"))
+    results.append(await _run_fault(
+        "kill_during_recovery", tmp,
+        lambda s: (f"actor_crash:actor={_agg_actor(s)},at=2;"
+                   "recovery_crash:phase=full,at=1")))
+    results.append(await _run_fault(
+        "channel_stall", tmp,
+        lambda s: f"channel_stall:actor={_mv_actor(s)},at=2,ms=400"))
+    results.append(await _run_fault(
+        "upload_delay", tmp, lambda s: "upload_delay:at=1,ms=400"))
+    for r in results:
+        print(json.dumps(r))
+
+    by_name = {r["fault"]: r for r in results}
+    frag_runs = [by_name["mv_actor_crash"], by_name["poison_chunk"]]
+    full_runs = [by_name["agg_actor_crash"], by_name["upload_fail"],
+                 by_name["kill_during_recovery"]]
+
+    def _p50(runs):
+        xs = sorted(r["last_recovery"]["duration_s"] for r in runs)
+        return xs[len(xs) // 2]
+
+    frag_p50 = _p50(frag_runs)
+    full_p50 = _p50(full_runs)
+    stall = by_name["channel_stall"]
+    delay = by_name["upload_delay"]
+    verdict = {
+        "all_converged": all(r["converged"] for r in results),
+        "delay_no_recovery": delay["recoveries"] == 0,
+        "fragment_scope": all(
+            r["last_recovery"]["scope"] == "fragment" for r in frag_runs),
+        "fragment_rebuilds_strictly_fewer": all(
+            set(r["last_recovery"]["actors"]) < set(r["total_actors"])
+            for r in frag_runs),
+        "full_scope": all(
+            r["last_recovery"]["scope"] == "full"
+            and set(r["last_recovery"]["actors"]) == set(r["total_actors"])
+            for r in full_runs),
+        "stall_no_recovery": stall["recoveries"] == 0,
+        "fragment_recovery_p50_s": round(frag_p50, 5),
+        "full_recovery_p50_s": round(full_p50, 5),
+        "fragment_beats_full": frag_p50 < full_p50,
+        "fragment_under_budget": frag_p50 < FRAGMENT_P50_BUDGET_S,
+        "recovery_metrics_visible": all(
+            r["metrics_recovery_total"] and r["metrics_recovery_duration"]
+            for r in results),
+        "healthz_last_recovery": all(
+            r["healthz_last_recovery"] is not None
+            and "scope" in r["healthz_last_recovery"]
+            for r in frag_runs + full_runs),
+    }
+    print(json.dumps({"verdict": verdict}))
+    ok = all(v for k, v in verdict.items()
+             if isinstance(v, bool))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))
