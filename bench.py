@@ -860,10 +860,41 @@ async def bench_q17(progress: dict) -> None:
     await asyncio.Event().wait()
 
 
+async def bench_broker_ingest(progress: dict) -> None:
+    """External-ingress bench (OPT-IN: `python bench.py broker_ingest`;
+    not in the default round — the broker path is host-bound by design
+    and CI already bounds it at 3x of the datagen path in
+    scripts/broker_profile.py). An in-process broker is preloaded with
+    JSON records; the measured number is broker-source -> sink ingest
+    rows/s through the ordinary barrier loop."""
+    import json as _json
+    import tempfile
+    from risingwave_tpu.broker import Broker, register_inproc
+    tmp = tempfile.mkdtemp(prefix="bench_broker_")
+    broker = Broker(tmp, fsync=False)
+    register_inproc("bench", broker)
+    broker.create_topic("ev", 1)
+    n = 400_000
+    recs = [_json.dumps({"k": i, "v": i * 3}).encode() for i in range(n)]
+    for i in range(0, n, 16384):
+        broker.append("ev", 0, recs[i:i + 16384])
+    ddl = [
+        "SET streaming_durability = 0",
+        "SET streaming_watchdog = 0",
+        ("CREATE SOURCE ev WITH (connector='broker', topic='ev', "
+         "brokers='inproc://bench', columns='k int64, v int64', "
+         "chunk_size=4096, discovery_interval_ms=0, append_only=1)"),
+        ("CREATE SINK bi AS SELECT k, v FROM ev "
+         "WITH (connector='blackhole_device')"),
+    ]
+    await _bench_sql(progress, ddl, interval_s=0.2)
+
+
 QUERIES = {"q1": bench_q1, "q5": bench_q5, "q7": bench_q7,
            "q8": bench_q8, "q17": bench_q17, "q7d": bench_q7d,
            "q7_kill": bench_q7_kill,
-           "q5_8chip": bench_q5_8chip, "q7_8chip": bench_q7_8chip}
+           "q5_8chip": bench_q5_8chip, "q7_8chip": bench_q7_8chip,
+           "broker_ingest": bench_broker_ingest}
 NORTH_STAR = ("q7", "q8")
 
 

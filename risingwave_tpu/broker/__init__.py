@@ -1,0 +1,27 @@
+"""Standalone kafka-alike broker — durable partitioned topics.
+
+The external-streaming half of ROADMAP item 4: a broker process (or
+in-process object, for tests) owning TOPICS of append-only PARTITIONS
+with dense per-partition record offsets, served over the control-plane
+wire (cluster/rpc.py length-prefixed frames). The engine talks to it
+through two connectors:
+
+  * ingress — `connector='broker'` sources (connectors/broker.py):
+    splits ARE broker partitions, per-split offsets checkpoint in
+    barrier state exactly like the generator splits, and a meta-side
+    enumerator picks up newly-added partitions at a barrier
+    (reference: src/meta/src/stream/source_manager.rs).
+  * egress — `BrokerSink` implementing the log-store delivery contract
+    `write(seq, epoch, rows)` / `committed_seq()`, with the sequence
+    number persisted IN the topic (batch metadata), so delivery dedupes
+    across engine crash AND broker restart.
+
+Run standalone:  python -m risingwave_tpu.broker --data DIR --port N
+"""
+
+from .log import PartitionLog
+from .server import Broker, BrokerServer, register_inproc, unregister_inproc
+from .client import BrokerClient
+
+__all__ = ["PartitionLog", "Broker", "BrokerServer", "BrokerClient",
+           "register_inproc", "unregister_inproc"]

@@ -1,0 +1,47 @@
+"""Standalone broker process:
+
+    python -m risingwave_tpu.broker --data DIR [--port N] [--host H]
+
+Prints one JSON line `{"broker": "host:port", "data": DIR}` to stdout
+once listening (scripts parse it to learn the ephemeral port), then
+serves until killed. Durable state lives entirely in --data; restarting
+on the same directory recovers every topic, partition, offset and batch
+metadata (torn trailing frames from a kill mid-append are dropped)."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from .server import Broker, BrokerServer
+
+
+async def _main() -> int:
+    ap = argparse.ArgumentParser(prog="risingwave_tpu.broker")
+    ap.add_argument("--data", required=True,
+                    help="topic/segment root directory")
+    ap.add_argument("--port", type=int, default=0,
+                    help="listen port (0 = ephemeral)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--no-fsync", action="store_true",
+                    help="skip per-append fsync (tests only)")
+    args = ap.parse_args()
+
+    broker = Broker(args.data, fsync=not args.no_fsync)
+    server = await BrokerServer(broker, host=args.host,
+                                port=args.port).start()
+    print(json.dumps({"broker": f"{args.host}:{server.port}",
+                      "data": args.data}), flush=True)
+    try:
+        await asyncio.Event().wait()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(_main()))

@@ -1,0 +1,173 @@
+"""Partition log — append-only segment files with dense record offsets.
+
+Reference: a Kafka partition (log segments + the high watermark) reduced
+to the invariants the engine's exactly-once contracts actually consume:
+
+  * records are opaque bytes with DENSE offsets 0,1,2,... per partition —
+    the offset is the exactly-once resume point a source commits in
+    barrier state;
+  * appends are BATCH-atomic: one `append()` call writes one framed
+    batch (`u32 len ++ u32 crc32 ++ body`) with a single write+fsync. A
+    crash mid-append leaves a torn trailing frame whose length or crc
+    check fails on reopen — the whole batch never existed, exactly like
+    `FileSink`'s torn trailing JSON line. That atomicity is what lets a
+    sink persist its delivery sequence number IN the batch metadata: the
+    last readable batch's meta is always a sequence whose rows are all
+    durable.
+  * segments roll at a size threshold; a segment file is named by the
+    base offset of its first record, so a reader locates any offset from
+    directory listing alone.
+
+Batch body layout (all big-endian):
+
+    u64 base_offset | u32 n_records | u32 meta_len | meta (json bytes)
+    then per record: u32 len | bytes
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import zlib
+from typing import Optional
+
+_FRAME = struct.Struct("!II")          # body_len, crc32(body)
+_HDR = struct.Struct("!QII")           # base_offset, n_records, meta_len
+_REC = struct.Struct("!I")
+
+
+class PartitionLog:
+    """One partition directory of `*.seg` files. Thread-safe: appends
+    serialize on a lock; fetches read immutable prefixes (a batch is
+    visible only after its index entry is published under the lock)."""
+
+    def __init__(self, path: str, segment_bytes: int = 64 << 20,
+                 fsync: bool = True):
+        self.path = path
+        self.segment_bytes = segment_bytes
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        # batch index: (base_offset, n_records, seg_path, file_pos)
+        self._index: list[tuple[int, int, str, int]] = []
+        self.next_offset = 0
+        # metadata of the last readable batch that carried one (the
+        # sink's durable sequence number lives here)
+        self.last_meta: Optional[dict] = None
+        os.makedirs(path, exist_ok=True)
+        self._scan()
+
+    # --------------------------------------------------------------- open
+    def _segments(self) -> list[str]:
+        return sorted(f for f in os.listdir(self.path)
+                      if f.endswith(".seg"))
+
+    def _scan(self) -> None:
+        """Rebuild the batch index from disk. A torn/corrupt trailing
+        frame (crash mid-append) is truncated away — the batch never
+        happened; everything before it is intact by construction
+        (batches are written strictly sequentially)."""
+        for seg in self._segments():
+            seg_path = os.path.join(self.path, seg)
+            size = os.path.getsize(seg_path)
+            with open(seg_path, "rb") as f:
+                pos = 0
+                while pos + _FRAME.size <= size:
+                    body_len, crc = _FRAME.unpack(f.read(_FRAME.size))
+                    body = f.read(body_len)
+                    if len(body) != body_len \
+                            or zlib.crc32(body) != crc:
+                        # torn tail: drop the frame AND anything the
+                        # crashed writer managed to queue after it
+                        with open(seg_path, "ab") as t:
+                            t.truncate(pos)
+                        break
+                    base, n, meta_len = _HDR.unpack_from(body)
+                    meta = (json.loads(body[_HDR.size:
+                                            _HDR.size + meta_len])
+                            if meta_len else None)
+                    if base != self.next_offset:
+                        break               # gap: a lost segment prefix
+                    self._index.append((base, n, seg_path, pos))
+                    self.next_offset = base + n
+                    if meta is not None:
+                        self.last_meta = meta
+                    pos += _FRAME.size + body_len
+
+    # ------------------------------------------------------------- append
+    def append(self, records: list[bytes],
+               meta: Optional[dict] = None) -> int:
+        """Atomically append one batch; returns its base offset. The
+        frame is assembled host-side and lands with ONE write + fsync,
+        so the torn-tail tolerance above makes it all-or-nothing."""
+        with self._lock:
+            base = self.next_offset
+            meta_b = json.dumps(meta).encode() if meta is not None else b""
+            body = bytearray(_HDR.pack(base, len(records), len(meta_b)))
+            body += meta_b
+            for r in records:
+                body += _REC.pack(len(r))
+                body += r
+            frame = _FRAME.pack(len(body), zlib.crc32(bytes(body))) \
+                + bytes(body)
+            seg_path = self._active_segment()
+            pos = os.path.getsize(seg_path)
+            with open(seg_path, "ab") as f:
+                f.write(frame)
+                f.flush()
+                if self.fsync:
+                    os.fsync(f.fileno())
+            self._index.append((base, len(records), seg_path, pos))
+            self.next_offset = base + len(records)
+            if meta is not None:
+                self.last_meta = meta
+            return base
+
+    def _active_segment(self) -> str:
+        segs = self._segments()
+        if segs:
+            p = os.path.join(self.path, segs[-1])
+            if os.path.getsize(p) < self.segment_bytes:
+                return p
+        p = os.path.join(self.path, f"{self.next_offset:020d}.seg")
+        if not os.path.exists(p):
+            open(p, "wb").close()
+        return p
+
+    # -------------------------------------------------------------- fetch
+    def fetch(self, offset: int, max_records: int) -> list[bytes]:
+        """Records [offset, offset + max_records) ∩ [0, high watermark),
+        in offset order."""
+        if offset >= self.next_offset or max_records <= 0:
+            return []
+        # binary search the batch covering `offset`
+        lo, hi = 0, len(self._index)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            base, n, _, _ = self._index[mid]
+            if base + n <= offset:
+                lo = mid + 1
+            else:
+                hi = mid
+        out: list[bytes] = []
+        for base, n, seg_path, pos in self._index[lo:]:
+            if len(out) >= max_records:
+                break
+            with open(seg_path, "rb") as f:
+                f.seek(pos)
+                body_len, _crc = _FRAME.unpack(f.read(_FRAME.size))
+                body = f.read(body_len)
+            _base, _n, meta_len = _HDR.unpack_from(body)
+            p = _HDR.size + meta_len
+            for i in range(n):
+                (ln,) = _REC.unpack_from(body, p)
+                p += _REC.size
+                if base + i >= offset and len(out) < max_records:
+                    out.append(body[p:p + ln])
+                p += ln
+        return out
+
+    @property
+    def high_watermark(self) -> int:
+        return self.next_offset

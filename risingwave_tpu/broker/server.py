@@ -1,0 +1,205 @@
+"""Broker — partitioned topics over `PartitionLog`, plus the RPC server.
+
+`Broker` is the storage/control authority (usable in-process by tests);
+`BrokerServer` exposes it over the cluster control-plane wire
+(cluster/rpc.py length-prefixed pickle frames) so engines in other
+processes reach it at `host:port`. An in-process REGISTRY lets tests run
+the whole engine↔broker pipeline on one event loop with zero sockets:
+`register_inproc('x', broker)` + `brokers='inproc://x'`.
+
+Topic layout on disk:  <root>/<topic>/p<00000>/<base_offset>.seg —
+partition membership IS the directory listing, so a broker restart
+recovers topics, partition counts, offsets and batch metadata by scan
+(torn trailing frames dropped, log.py)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import re
+import threading
+from typing import Optional
+
+from .log import PartitionLog
+
+_NAME_RE = re.compile(r"^[A-Za-z0-9_.\-]+$")
+
+
+class Broker:
+    """Topic/partition authority. All methods are synchronous and
+    thread-safe (the RPC server calls them via worker threads; in-proc
+    clients call them from the loop AND from sink delivery threads)."""
+
+    def __init__(self, root: str, segment_bytes: int = 64 << 20,
+                 fsync: bool = True):
+        self.root = root
+        self.segment_bytes = segment_bytes
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        self._parts: dict[tuple[str, int], PartitionLog] = {}
+        os.makedirs(root, exist_ok=True)
+        for topic in sorted(os.listdir(root)):
+            tdir = os.path.join(root, topic)
+            if not os.path.isdir(tdir):
+                continue
+            for p in sorted(os.listdir(tdir)):
+                if p.startswith("p") and p[1:].isdigit():
+                    self._open(topic, int(p[1:]))
+
+    def _open(self, topic: str, partition: int) -> PartitionLog:
+        key = (topic, partition)
+        if key not in self._parts:
+            self._parts[key] = PartitionLog(
+                os.path.join(self.root, topic, f"p{partition:05d}"),
+                segment_bytes=self.segment_bytes, fsync=self.fsync)
+        return self._parts[key]
+
+    def _part(self, topic: str, partition: int) -> PartitionLog:
+        log = self._parts.get((topic, partition))
+        if log is None:
+            raise KeyError(
+                f"unknown topic/partition {topic!r}/{partition}")
+        return log
+
+    # ------------------------------------------------------------ control
+    def create_topic(self, topic: str, partitions: int = 1) -> int:
+        """Idempotent: an existing topic keeps its (possibly larger)
+        partition count — partitions only ever grow. Returns the live
+        partition count."""
+        if not _NAME_RE.match(topic or ""):
+            raise ValueError(f"bad topic name {topic!r}")
+        with self._lock:
+            have = self._n_partitions(topic)
+            for p in range(have, max(int(partitions), have, 1)):
+                self._open(topic, p)
+            return self._n_partitions(topic)
+
+    def add_partitions(self, topic: str, total: int) -> int:
+        """Grow a topic to `total` partitions (never shrinks) — the live
+        split-discovery trigger: source enumerators poll
+        `list_partitions` and assign the new splits at a barrier."""
+        with self._lock:
+            have = self._n_partitions(topic)
+            if have == 0:
+                raise KeyError(f"unknown topic {topic!r}")
+            for p in range(have, max(int(total), have)):
+                self._open(topic, p)
+            return self._n_partitions(topic)
+
+    def _n_partitions(self, topic: str) -> int:
+        return sum(1 for t, _p in self._parts if t == topic)
+
+    def list_partitions(self, topic: str) -> int:
+        with self._lock:
+            return self._n_partitions(topic)
+
+    def topics(self) -> dict:
+        """topic -> {partitions, high_watermarks: [per partition]}."""
+        with self._lock:
+            out: dict = {}
+            for (t, p), log in sorted(self._parts.items()):
+                ent = out.setdefault(t, {"partitions": 0,
+                                         "high_watermarks": []})
+                ent["partitions"] += 1
+                ent["high_watermarks"].append(log.high_watermark)
+            return out
+
+    # --------------------------------------------------------------- data
+    def append(self, topic: str, partition: int, records: list,
+               meta: Optional[dict] = None) -> int:
+        return self._part(topic, partition).append(
+            [bytes(r) for r in records], meta=meta)
+
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_records: int = 256) -> dict:
+        log = self._part(topic, partition)
+        recs = log.fetch(int(offset), int(max_records))
+        return {"records": recs,
+                "next_offset": int(offset) + len(recs),
+                "high_watermark": log.high_watermark}
+
+    def high_watermark(self, topic: str, partition: int) -> int:
+        return self._part(topic, partition).high_watermark
+
+    def last_meta(self, topic: str, partition: int) -> Optional[dict]:
+        """Metadata of the last durable batch that carried one — where a
+        `BrokerSink` finds its committed delivery sequence after either
+        side restarts."""
+        return self._part(topic, partition).last_meta
+
+    def ping(self) -> dict:
+        return {"ok": True}
+
+
+# --------------------------------------------------------------- in-proc
+# name -> Broker: `brokers='inproc://name'` resolves here at CALL time,
+# so a test can wipe and re-register a broker (restart simulation) while
+# connectors hold the address.
+_INPROC: dict[str, Broker] = {}
+
+
+def register_inproc(name: str, broker: Broker) -> None:
+    _INPROC[name] = broker
+
+
+def unregister_inproc(name: str) -> None:
+    _INPROC.pop(name, None)
+
+
+def resolve_inproc(name: str) -> Broker:
+    b = _INPROC.get(name)
+    if b is None:
+        raise ConnectionRefusedError(
+            f"no in-process broker registered as {name!r}")
+    return b
+
+
+# ---------------------------------------------------------------- server
+class BrokerServer:
+    """RPC front: every request maps 1:1 onto a `Broker` method; disk
+    work runs via `asyncio.to_thread` so one slow fsync never blocks
+    other clients' frames."""
+
+    _METHODS = ("create_topic", "add_partitions", "list_partitions",
+                "topics", "append", "fetch", "high_watermark",
+                "last_meta", "ping")
+
+    def __init__(self, broker: Broker, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.broker = broker
+        self.host = host
+        self.port = port
+        self._server = None
+        self._conns: list = []
+
+    async def start(self) -> "BrokerServer":
+        from ..cluster.rpc import start_rpc_server
+
+        def handler_factory(conn):
+            self._conns.append(conn)
+
+            async def handler(method, args):
+                if method not in self._METHODS:
+                    raise ValueError(f"unknown broker method {method!r}")
+                return await asyncio.to_thread(
+                    getattr(self.broker, method), **args)
+
+            def on_closed(exc):
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+            return handler, on_closed
+
+        self._server = await start_rpc_server(
+            handler_factory, host=self.host, port=self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        for conn in list(self._conns):
+            await conn.close()
+        self._conns.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None

@@ -353,6 +353,14 @@ class ClusterManager:
                     "feed the consumer from a changelog subscription "
                     "(logstore/subscription.py, the serving-replica "
                     "path)")
+            if n.args.get("connector") == "broker":
+                # split discovery assigns LIVE connector objects over an
+                # AddSplitsMutation and the broker sink needs the
+                # meta-local exactly-once log — neither crosses the
+                # worker wire in v1
+                raise ValueError(
+                    "cluster v1: broker sources/sinks are not supported "
+                    "— run the broker pipeline on the meta session")
             if n.kind == "sink" and int(n.args.get("exactly_once", 0)):
                 # a compute node's store handle never owns the manifest,
                 # so it cannot observe meta's commit point — the

@@ -1,0 +1,277 @@
+"""Broker connectors — the engine side of the external-streaming wire.
+
+Reference: src/connector/src/source/base.rs (`SplitEnumerator` /
+`SplitReader`) + src/meta/src/stream/source_manager.rs (split discovery
+and assignment) + src/connector/src/sink/kafka.rs, over the local
+kafka-alike broker (risingwave_tpu/broker/).
+
+  * `BrokerPartitionConnector` — a SplitReader: one split IS one broker
+    partition; `offset` is the dense partition record offset, which is
+    exactly the per-split state the source executor commits in barrier
+    state (exactly-once resume across crash/recovery, same machinery as
+    the generator splits).
+  * `BrokerSplitEnumerator` — the meta-side enumerator: polls partition
+    membership (throttled) from the barrier-injection path; a topic that
+    grew partitions yields an `AddSplitsMutation` so the new splits are
+    assigned to source actors AT a barrier — totally ordered with data,
+    offsets committed from the same barrier on.
+  * `BrokerSink` — the log-store delivery target (`write(seq, epoch,
+    rows)` / `committed_seq()`, stream/sink.py contract): each committed
+    log entry appends as ONE atomic batch whose metadata carries the
+    sequence number. `committed_seq()` recovers from the topic itself
+    (last durable batch meta), so delivery dedupes across engine crash
+    AND broker restart — a torn batch from a kill mid-append reports the
+    previous sequence and re-delivers whole.
+
+Record format: one JSON object per record, column-name keyed with dict-
+encoded VARCHARs DECODED to strings (two engines chained through a
+topic do not share a string dictionary); `__op` carries non-insert
+changelog ops (update pairs are normalized to delete+insert so a batch
+split across fetch chunks never strands half a pair)."""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..broker.client import BrokerClient
+from ..common.chunk import (OP_DELETE, OP_INSERT, OP_UPDATE_DELETE,
+                            OP_UPDATE_INSERT, StreamChunk)
+from ..common.types import DataType, GLOBAL_DICT, Schema
+from ..utils.faults import FAULTS, FaultInjected
+
+
+def _parse_records(schema: Schema, records: list, chunk_size: int
+                   ) -> StreamChunk:
+    """JSON record bytes -> typed StreamChunk (the jsonl parser's rules:
+    malformed record -> all-NULL row so offsets stay record-aligned,
+    type-mismatched cell -> NULL), plus changelog ops via `__op`."""
+    n = len(records)
+    objs = []
+    ops = np.zeros(n, dtype=np.int8)
+    for i, rec in enumerate(records):
+        try:
+            obj = json.loads(rec)
+            if not isinstance(obj, dict):
+                obj = None
+        except ValueError:
+            obj = None
+        objs.append(obj)
+        if obj is not None:
+            op = obj.get("__op", OP_INSERT)
+            if op in (OP_DELETE, OP_UPDATE_DELETE):
+                ops[i] = OP_DELETE
+            elif op == OP_UPDATE_INSERT:
+                ops[i] = OP_INSERT
+    cols: list[np.ndarray] = []
+    valids: list[Optional[np.ndarray]] = []
+    for f in schema:
+        vals = np.zeros(n, dtype=f.data_type.np_dtype)
+        valid = np.zeros(n, dtype=bool)
+        for i, obj in enumerate(objs):
+            v = None if obj is None else obj.get(f.name)
+            if v is None:
+                continue
+            try:
+                if f.data_type is DataType.VARCHAR:
+                    vals[i] = GLOBAL_DICT.get_or_insert(str(v))
+                elif f.data_type in (DataType.FLOAT32, DataType.FLOAT64):
+                    vals[i] = float(v)
+                elif f.data_type is DataType.BOOLEAN:
+                    vals[i] = bool(v)
+                else:
+                    vals[i] = int(v)
+                valid[i] = True
+            except (TypeError, ValueError, OverflowError):
+                continue
+        cols.append(vals)
+        valids.append(valid)
+    return StreamChunk.from_numpy(schema, cols, ops=ops,
+                                  capacity=max(chunk_size, n),
+                                  valids=valids)
+
+
+def encode_row(schema: Schema, op: int, vals) -> bytes:
+    """One changelog row -> one JSON record (the BrokerSink writer and
+    test producers share it). Update ops normalize to delete/insert."""
+    obj = {}
+    for f, v in zip(schema, vals):
+        if v is None:
+            continue
+        if f.data_type is DataType.VARCHAR:
+            obj[f.name] = GLOBAL_DICT.decode(int(v))
+        elif f.data_type in (DataType.FLOAT32, DataType.FLOAT64):
+            obj[f.name] = float(v)
+        elif f.data_type is DataType.BOOLEAN:
+            obj[f.name] = bool(v)
+        else:
+            obj[f.name] = int(v)
+    if op in (OP_DELETE, OP_UPDATE_DELETE):
+        obj["__op"] = OP_DELETE
+    return json.dumps(obj).encode()
+
+
+class BrokerPartitionConnector:
+    """Connector protocol (stream/source.py): next_chunk / seek /
+    offset / exhausted, over one broker partition."""
+
+    def __init__(self, brokers, topic: str, partition: int,
+                 schema: Schema, chunk_size: int = 256):
+        self.brokers = brokers
+        self.topic = topic
+        self.partition = partition
+        self.schema = schema
+        self.chunk_size = chunk_size
+        self.client = BrokerClient(brokers)
+        self.offset = 0
+        self._hwm = 0                 # cached high watermark
+        self._last_rows = 0
+
+    @property
+    def last_chunk_rows(self) -> int:
+        return self._last_rows
+
+    def seek(self, offset: int) -> None:
+        self.offset = int(offset)
+
+    @property
+    def exhausted(self) -> bool:
+        """Caught-up check. Cheap against the cached high watermark
+        (every fetch refreshes it); one RPC only when the cache says
+        caught-up. A vanished broker reads as exhausted — the source
+        then blocks at barrier cadence (no busy-spin, no crash) and
+        resumes when the broker is back, mirroring the jsonl
+        connector's vanished-file contract."""
+        if self.offset < self._hwm:
+            return False
+        try:
+            self._hwm = self.client.high_watermark(
+                topic=self.topic, partition=self.partition)
+        except (OSError, ConnectionError, RuntimeError):
+            return True
+        return self.offset >= self._hwm
+
+    def lag_rows(self) -> int:
+        """Broker high watermark minus consumed offset (the
+        source_lag_rows gauge; cached — no RPC)."""
+        return max(0, self._hwm - self.offset)
+
+    def next_chunk(self) -> StreamChunk:
+        if FAULTS.active and FAULTS.hit(
+                "broker_fetch_fail", topic=self.topic,
+                partition=self.partition) is not None:
+            raise FaultInjected(
+                f"injected broker_fetch_fail {self.topic}/"
+                f"p{self.partition} at offset {self.offset}")
+        res = self.client.fetch(topic=self.topic,
+                                partition=self.partition,
+                                offset=self.offset,
+                                max_records=self.chunk_size)
+        records = res["records"]
+        self._hwm = res["high_watermark"]
+        self.offset = res["next_offset"]
+        self._last_rows = len(records)
+        return _parse_records(self.schema, records, self.chunk_size)
+
+
+class BrokerSplitEnumerator:
+    """Meta-side split discovery for one broker-source fragment. The
+    barrier coordinator polls every registered enumerator at injection
+    (throttled per `poll_interval_s`); growth comes back as
+    {source actor id: ((split_id, connector), ...)} and rides the
+    barrier as an `AddSplitsMutation` — split k goes to actor (k % P),
+    the same deterministic rule the initial build uses."""
+
+    def __init__(self, brokers, topic: str, schema: Schema,
+                 chunk_size: int, parallelism: int,
+                 known_partitions: int, poll_interval_s: float = 1.0):
+        self.brokers = brokers
+        self.topic = topic
+        self.schema = schema
+        self.chunk_size = chunk_size
+        self.parallelism = max(1, int(parallelism))
+        self.known = int(known_partitions)
+        self.poll_interval_s = poll_interval_s
+        self.client = BrokerClient(brokers)
+        self.frag_key = None          # set by the builder (teardown key)
+        self._actors: dict[int, int] = {}    # actor_idx -> source id
+        self._last_poll = 0.0
+
+    def register_actor(self, actor_idx: int, source_id: int) -> None:
+        self._actors[actor_idx] = source_id
+
+    def observe_build(self, n_partitions: int) -> None:
+        """A (re)build constructed connectors for every partition it saw
+        — never re-announce those."""
+        self.known = max(self.known, int(n_partitions))
+
+    def poll(self) -> Optional[dict]:
+        now = time.monotonic()
+        if self.poll_interval_s > 0 \
+                and now - self._last_poll < self.poll_interval_s:
+            return None
+        self._last_poll = now
+        try:
+            n = self.client.list_partitions(topic=self.topic)
+        except (OSError, ConnectionError, RuntimeError):
+            return None               # broker away: retry next barrier
+        if n <= self.known:
+            return None
+        assignments: dict[int, list] = {}
+        for k in range(self.known, n):
+            sid = self._actors.get(k % self.parallelism)
+            if sid is None:
+                continue
+            conn = BrokerPartitionConnector(
+                self.brokers, self.topic, k, self.schema,
+                chunk_size=self.chunk_size)
+            assignments.setdefault(sid, []).append((k, conn))
+        self.known = n
+        if not assignments:
+            return None
+        return {sid: tuple(v) for sid, v in assignments.items()}
+
+
+class BrokerSink:
+    """Log-store delivery target (stream/sink.py SinkTarget contract).
+    One committed log entry = one atomic broker batch (partition
+    `seq % partitions`, metadata `{"seq", "epoch"}`). Sequence numbers
+    are ascending, so the max last-batch meta across partitions is
+    always the last COMPLETE delivery — the recovery read for
+    `committed_seq()` whichever side restarted."""
+
+    def __init__(self, brokers, topic: str, schema=None,
+                 partitions: int = 1):
+        self.brokers = brokers
+        self.topic = topic
+        self.schema = schema
+        self.client = BrokerClient(brokers)
+        self.n_partitions = self.client.create_topic(
+            topic=topic, partitions=partitions)
+        self._committed = 0
+        self.rows_appended = 0
+        for p in range(self.n_partitions):
+            m = self.client.last_meta(topic=topic, partition=p)
+            if m and "seq" in m:
+                self._committed = max(self._committed, int(m["seq"]))
+
+    def write(self, seq: int, epoch: int, rows: list) -> None:
+        if FAULTS.active and FAULTS.hit(
+                "broker_append_fail", topic=self.topic,
+                seq=seq) is not None:
+            raise FaultInjected(
+                f"injected broker_append_fail {self.topic} seq {seq}")
+        records = [encode_row(self.schema, op, vals)
+                   if self.schema is not None
+                   else json.dumps({"__op": op, "vals": list(vals)}).encode()
+                   for op, vals in rows]
+        self.client.append(self.topic, seq % self.n_partitions, records,
+                           meta={"seq": seq, "epoch": epoch})
+        self._committed = seq
+        self.rows_appended += len(records)
+
+    def committed_seq(self) -> int:
+        return self._committed

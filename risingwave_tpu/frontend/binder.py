@@ -187,7 +187,8 @@ class StreamPlanner:
         self.used_sources.add(name)
         if name not in self._source_frags:
             src = self.catalog.source(name)
-            node = Node("nexmark_source", dict(src.options, durable=True))
+            node = Node("nexmark_source", dict(src.options, durable=True,
+                                               source_name=name))
             # split-managed sources scale with the session parallelism,
             # bounded by their split count (source_manager.rs assignment)
             n_splits = int(src.options.get("splits", 1))
@@ -224,8 +225,12 @@ class StreamPlanner:
             if src.options.get("emit_watermarks") and wmcol is not None:
                 wm = frozenset({wmcol})
             pk_opt = src.options.get("primary_key")
+            # generator/file sources only ever insert; a broker topic
+            # can carry changelog ops (`__op`), so it declares
+            # append-only explicitly or plans retract-capable
+            ao = bool(src.options.get("append_only", True))
             return (f.fid, Scope.of(src.schema, rel.alias or rel.name),
-                    RelInfo(None if pk_opt is None else (pk_opt,), True,
+                    RelInfo(None if pk_opt is None else (pk_opt,), ao,
                             wm))
         if isinstance(rel, ast.WindowRel):
             src = self.catalog.source(rel.inner.name)

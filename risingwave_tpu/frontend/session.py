@@ -187,6 +187,13 @@ class Session:
         # subscribe to an MV's changelog with backfill-then-tail, and
         # answer point lookups from their own snapshot cache. 0 = off.
         "subscription_port": (0, int),
+        # durable-cursor lease (logstore/): a NAMED subscription cursor
+        # with no live subscriber renewing it for this long stops
+        # pinning MV changelog retention — resubscribing within the TTL
+        # still resumes the tail; after it, the subscription falls back
+        # to backfill-then-tail. 0 (default) = cursors never expire
+        # (drop_sub_cursor is the only release).
+        "subscription_cursor_ttl_ms": (0, int),
         # stuck-barrier watchdog threshold: an in-flight epoch older
         # than this logs format_stuck_barrier_report once and bumps
         # barrier_stalls_total; 0 disables the watchdog
@@ -268,6 +275,7 @@ class Session:
         self._apply_memory_config()
         self._apply_serving_config()
         self._apply_obs_config()
+        self._apply_logstore_config()
 
     def _apply_memory_config(self) -> None:
         """Plumb the memory session vars to the live coordinator's
@@ -292,6 +300,12 @@ class Session:
         self.coord.stats.configure(self.config["metric_level"])
         thr = self.config["barrier_stall_threshold_ms"]
         self.coord.stall_threshold_ms = float(thr) if thr > 0 else None
+
+    def _apply_logstore_config(self) -> None:
+        """Plumb the log-store session vars to the live hub (re-applied
+        after auto-recovery swaps the coordinator)."""
+        self.coord.logstore.sub_cursor_ttl_ms = self.config.get(
+            "subscription_cursor_ttl_ms", 0)
 
     async def start_monitor(self, port: int = 0):
         """Start (or move) the monitor HTTP endpoint; port 0 binds an
@@ -527,6 +541,11 @@ class Session:
                 self._apply_obs_config()
                 if self.cluster is not None:
                     await self.cluster.push_config()
+            elif stmt.name == "subscription_cursor_ttl_ms":
+                # runtime-mutable on the live LogStoreHub: the next
+                # commit pulse re-evaluates which durable cursors still
+                # pin changelog retention
+                self._apply_logstore_config()
             elif stmt.name == "partial_recovery":
                 # build-time knob: channels allocated after this carry
                 # (or not) the replay buffers; classification also
@@ -792,7 +811,25 @@ class Session:
                      str(r["point_lookups"]))
                     for r in self.coord.serving.report()]
         if what == "sources":
-            return [(n,) for n in sorted(self.catalog.sources)]
+            # one row PER LIVE SPLIT: (source, split, offset, lag) —
+            # lag is broker-high-watermark minus consumed offset for
+            # broker splits, "-" for connectors with no external
+            # watermark; a source with no running executor (no MV/sink
+            # reads it yet) shows a placeholder row
+            rows = []
+            live: dict[str, list] = {}
+            for aid in sorted(self.coord.source_execs):
+                ex = self.coord.source_execs[aid]
+                live.setdefault(ex.source_name, []).extend(
+                    ex.split_report())
+            for n in sorted(self.catalog.sources):
+                if n in live:
+                    for sid, off, lag in sorted(live[n]):
+                        rows.append((n, str(sid), str(off),
+                                     "-" if lag is None else str(lag)))
+                else:
+                    rows.append((n, "-", "-", "-"))
+            return rows
         if what in ("tables", "materialized_views"):
             return [(n,) for n in sorted(self.catalog.mvs)]
         if what == "sinks":
@@ -810,6 +847,65 @@ class Session:
     def _create_source(self, stmt: ast.CreateSource) -> SourceDef:
         opts = dict(stmt.options)
         connector = opts.pop("connector", "nexmark")
+        if connector == "broker":
+            # external broker ingress (connectors/broker.py): splits are
+            # the topic's partitions, offsets are dense record offsets
+            # committed in barrier state, and partition growth is picked
+            # up live by the split enumerator at a barrier
+            from ..broker.client import BrokerClient
+            from ..connectors.file_source import parse_columns
+            topic = opts.pop("topic", None)
+            brokers = opts.pop("brokers", None)
+            colspec = opts.pop("columns", None)
+            if not topic or not brokers or not colspec:
+                raise BindError(
+                    "broker connector needs topic=..., brokers=... and "
+                    "columns='name type, ...'")
+            try:
+                schema = parse_columns(colspec)
+            except ValueError as e:
+                raise BindError(str(e))
+            args = {"connector": "broker", "topic": topic,
+                    "brokers": brokers, "columns": colspec,
+                    "chunk_size": int(opts.pop("chunk_size", 256)),
+                    "partitions": int(opts.pop("partitions", 1)),
+                    "discovery_interval_ms":
+                        int(opts.pop("discovery_interval_ms", 1000)),
+                    # topics can carry changelog ops (engine->engine
+                    # pipelines ship retractions as `__op` records);
+                    # append_only=1 opts into the insert-only fast paths
+                    "append_only": bool(int(opts.pop("append_only", 0)))}
+            for k in ("rate_limit",):
+                if k in opts:
+                    args[k] = int(opts.pop(k))
+            if "primary_key" in opts:
+                pk_name = opts.pop("primary_key")
+                if pk_name not in schema.names:
+                    raise BindError(
+                        f"primary_key {pk_name!r} not a column")
+                args["primary_key"] = list(schema.names).index(pk_name)
+            if opts:
+                raise BindError(f"unknown broker options {sorted(opts)}")
+            if not args["append_only"] and "primary_key" not in args:
+                # changelog records (`__op` deletes) must address rows:
+                # a keyless retracting stream cannot plan. Insert-only
+                # topics opt into the fast paths explicitly.
+                raise BindError(
+                    "broker source needs primary_key=... (changelog "
+                    "topics) or append_only=1 (insert-only topics)")
+            # ensure the topic + bind the CURRENT partition count (the
+            # binder's parallelism bound; the count only ever grows, and
+            # the build re-reads the live count)
+            try:
+                client = BrokerClient(brokers)
+                args["splits"] = client.create_topic(
+                    topic=topic, partitions=args["partitions"])
+                client.close()
+            except (OSError, ConnectionError, RuntimeError) as e:
+                raise BindError(f"broker {brokers!r} unreachable: {e}")
+            src = SourceDef(stmt.name, schema, args)
+            self.catalog.sources[stmt.name] = src
+            return src
         if connector == "jsonl":
             # external file-tailing source (connectors/file_source.py):
             # a split = one append-only JSONL file, offset = line number
@@ -1002,7 +1098,35 @@ class Session:
         return mv
 
     # ------------------------------------------------------------ runtime
+    def _check_sink_options(self, opts: dict) -> None:
+        """Reject invalid sink options BEFORE the graph builds: a
+        builder exception mid-build leaves half-registered actors on
+        the coordinator (they never collect -> every later barrier
+        hangs), so anything checkable from the options alone must fail
+        here, at bind time."""
+        if opts.get("connector") != "broker":
+            return
+        if not opts.get("topic") or not opts.get("brokers"):
+            raise BindError("broker sink needs topic=... and brokers=...")
+        force = opts.get("type") == "append-only" or str(
+            opts.get("force_append_only", "")).lower() in ("true", "1")
+        if int(opts.get("partitions", 1)) > 1 and not force:
+            raise BindError(
+                "broker sink with partitions > 1 requires an "
+                "append-only changelog (WITH type='append-only'): one "
+                "delivery batch lands whole in one partition, and "
+                "retractions need the single-partition total order")
+        try:
+            from ..broker.client import BrokerClient
+            client = BrokerClient(opts["brokers"])
+            client.ping()
+            client.close()
+        except (OSError, ConnectionError, RuntimeError) as e:
+            raise BindError(
+                f"broker {opts['brokers']!r} unreachable: {e}")
+
     async def _create_sink(self, stmt, sql_text: str = "") -> "SinkDef":
+        self._check_sink_options(dict(stmt.options))
         if self.cluster is not None:
             return await self._create_sink_cluster(stmt, sql_text)
         planner = StreamPlanner(self.catalog, config=self.config)
@@ -1117,11 +1241,23 @@ class Session:
         jitter (`recovery_backoff_ms`) so a persistent fault cannot
         hot-loop through `max_recoveries`; a crash DURING recovery
         (mid DDL replay) counts as an attempt and is retried too."""
-        if not self.catalog.mvs and not self.catalog.sinks:
+        flows_logged = any(e["kind"] in ("mv", "sink")
+                           for e in self._ddl_log)
+        if not self.catalog.mvs and not self.catalog.sinks \
+                and not flows_logged:
             return
         attempts = 0
         while True:
             try:
+                if flows_logged and not self.catalog.mvs \
+                        and not self.catalog.sinks:
+                    # a prior recovery died mid-DDL-replay (catalog
+                    # cleared, log intact — e.g. the broker a sink
+                    # targets was still down): resume recovering
+                    # instead of silently no-opping the tick
+                    raise RuntimeError(
+                        "catalog empty with flows in the DDL log; "
+                        "resuming interrupted recovery")
                 await self.coord.run_rounds(rounds, interval_s=interval_s)
                 return
             except RuntimeError:
@@ -1433,6 +1569,7 @@ class Session:
         # monitor endpoint (if any) reads `self.coord` live, so it keeps
         # serving across the swap
         self._apply_obs_config()
+        self._apply_logstore_config()
         if self.cluster is not None:
             # prune dead workers, reset survivors (reopen their store
             # handles at the committed manifest, fresh SST blocks) and

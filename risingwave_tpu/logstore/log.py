@@ -436,6 +436,14 @@ class LogStoreHub:
         self._commit_event = asyncio.Event()
         self.failure: Optional[tuple[str, BaseException]] = None
         self.aborted = False
+        # durable-cursor lease (SET subscription_cursor_ttl_ms): a named
+        # cursor with NO live pump renewing its lease for this long
+        # stops pinning changelog retention — the abandoned-replica
+        # escape hatch. 0 = never expire. `_cursor_seen` is the lease
+        # clock: (mv, cursor) -> monotonic time last renewed (a live
+        # pump renews; an orphan's clock starts at first observation).
+        self.sub_cursor_ttl_ms = 0
+        self._cursor_seen: dict[tuple[str, str], float] = {}
 
     # ------------------------------------------------------ registration
     def register_sink(self, name: str, log: SinkChangelog,
@@ -481,22 +489,68 @@ class LogStoreHub:
             pump.stop()
 
     # ----------------------------------------------------------- commits
+    def pinning_sub_cursors(self, name: str, log: MvChangelog) -> dict:
+        """The durable named cursors still HOLDING `log`'s retention: a
+        cursor whose lease lapsed (no live pump under that name within
+        `sub_cursor_ttl_ms`) is excluded — retention advances past it,
+        and a later resubscribe under the name falls back to
+        backfill-then-tail instead of resuming. Renewals happen here:
+        every call stamps cursors with a live pump, so the TTL clock
+        only runs while the subscriber is actually away."""
+        import time
+        durable = log.committed_sub_cursors()
+        if not durable:
+            return {}
+        now = time.monotonic()
+        live = {p.cursor_name for p in self.subscriptions
+                if p.mv == name and p.cursor_name is not None}
+        ttl_s = self.sub_cursor_ttl_ms / 1e3
+        out = {}
+        for cname, cur in durable.items():
+            key = (name, cname)
+            if cname in live:
+                self._cursor_seen[key] = now
+            seen = self._cursor_seen.setdefault(key, now)
+            if ttl_s <= 0 or cname in live or (now - seen) < ttl_s:
+                out[cname] = cur
+        return out
+
     def on_commit(self, epoch: int) -> None:
         """Pulsed by the coordinator at every checkpoint commit (inline
         sync, background uploader, and cluster commit_remote paths).
         Also the MV-changelog retention point: entries below every
-        subscriber's cursor (live pumps AND durable named cursors) are
-        tombstoned, staged at the current open epoch so the truncation
-        rides the next checkpoint."""
+        subscriber's cursor (live pumps AND durable named cursors whose
+        lease has not lapsed) are tombstoned, staged at the current open
+        epoch so the truncation rides the next checkpoint."""
         self.commit_seq += 1
         self._commit_event.set()
         for name, log in self.mv_logs.items():
             if not log.active:
                 continue
+            durable = log.committed_sub_cursors()
+            pinning = self.pinning_sub_cursors(name, log)
+            live_names = {p.cursor_name for p in self.subscriptions
+                          if p.mv == name}
+            # a lapsed lease is released DURABLY: the cursor tombstone
+            # rides the next checkpoint, so expiry survives restart
+            # (register_mv would otherwise resurrect retention from the
+            # stale cursor) and a later resubscribe under the name
+            # deterministically backfills instead of resuming
+            for cname in set(durable) - set(pinning) - live_names:
+                log.drop_sub_cursor(cname, self.collected_epoch)
+                self._cursor_seen.pop((name, cname), None)
             cursors = [p.cursor_epoch for p in self.subscriptions
                        if p.mv == name]
-            cursors.extend(log.committed_sub_cursors().values())
+            cursors.extend(pinning.values())
             if not cursors:
+                if durable:
+                    # every holder was an expired cursor: stop paying
+                    # the log entirely — truncate to the sealed floor
+                    # and deactivate (a resubscribe re-activates with a
+                    # fresh backfill handoff)
+                    log.truncate_below(self.collected_epoch,
+                                       self.collected_epoch)
+                    log.deactivate()
                 continue
             floor = min(cursors)
             if floor > log.truncated_below:
@@ -531,10 +585,19 @@ class LogStoreHub:
     async def drain(self) -> None:
         """Deliver everything committed (quiesce point; NOT part of the
         barrier path). Raises a parked delivery failure like
-        drain_uploads raises an upload failure."""
+        drain_uploads raises an upload failure — a failure DURING this
+        drain parks the same way (wrapped in the standard fail-stop
+        RuntimeError), so tick's auto-recovery owns the retry instead
+        of a raw connector error escaping to the caller."""
         self.check_failure()
         for d in list(self.sinks.values()):
-            await d.deliver_pending()
+            try:
+                await d.deliver_pending()
+            except asyncio.CancelledError:
+                raise
+            except BaseException as e:  # noqa: BLE001 — park it
+                self.fail(d.name, e)
+                break
         for pump in list(self.subscriptions):
             try:
                 await pump.pump_pending()

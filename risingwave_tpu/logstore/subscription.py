@@ -113,11 +113,12 @@ class _SubscriptionPump:
         GLOBAL_METRICS.remove("logstore_subscription_lag_epochs",
                               subscription=f"{self.mv}/{self.sub_id}")
         # last LIVE consumer gone -> stop paying the log writes — unless
-        # a durable named cursor is parked on the log: the whole point
-        # of the cursor is that a reconnect resumes the tail, which
-        # needs the log to keep accumulating while nobody is connected
+        # a durable named cursor still pins the log (lease not lapsed,
+        # hub.pinning_sub_cursors): the whole point of the cursor is
+        # that a reconnect resumes the tail, which needs the log to
+        # keep accumulating while nobody is connected
         if not any(p.mv == self.mv for p in self.hub.subscriptions) \
-                and not self.log.committed_sub_cursors():
+                and not self.hub.pinning_sub_cursors(self.mv, self.log):
             self.log.deactivate()
 
 
@@ -152,7 +153,8 @@ async def open_subscription(hub: LogStoreHub, mv: str, sink,
     if cursor_name is not None and allow_resume and log.active:
         cur = log.read_sub_cursor(cursor_name)
         if cur is not None and cur >= log.active_from \
-                and cur >= log.truncated_below:
+                and cur >= log.truncated_below \
+                and cursor_name in hub.pinning_sub_cursors(mv, log):
             # resume: entries > cur are all retained (retention floors
             # at the minimum cursor, which includes this one) and the
             # log has been active since before the cursor — the tail

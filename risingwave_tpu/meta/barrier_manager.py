@@ -178,6 +178,16 @@ class BarrierCoordinator:
         # process's store finished seal+upload+local-install for an epoch
         # — the worker's "sealed" report to meta rides it
         self.commit_listener = None
+        # ---- split discovery (connectors/broker.py) ----
+        # enumerators polled at barrier injection: membership growth in
+        # an external source (a topic gaining partitions) comes back as
+        # an AddSplitsMutation riding the injected barrier — assignment
+        # is totally ordered with data, the source_manager.rs discipline
+        self.split_enumerators: list = []
+        self._enum_by_frag: dict[int, object] = {}
+        # live source executors by actor id (SHOW sources: splits,
+        # offsets, lag); builders register, Deployment.stop removes
+        self.source_execs: dict[int, object] = {}
         self.checkpoint_max_inflight = checkpoint_max_inflight
 
     # ------------------------------------------------- checkpoint pipeline
@@ -228,6 +238,54 @@ class BarrierCoordinator:
             # per-actor streaming series)
             GLOBAL_METRICS.remove("mesh_fragment_shards",
                                   actor=str(actor_id))
+
+    def split_enumerator(self, frag_key: int, factory):
+        """One enumerator per source fragment, shared by its actors and
+        surviving per-fragment rebuilds (keyed by the retained fragment
+        object): the first builder call creates+registers it, later
+        calls — other actors, a rebuild — reuse it so already-announced
+        splits are never re-assigned."""
+        en = self._enum_by_frag.get(frag_key)
+        if en is None:
+            en = factory()
+            en.frag_key = frag_key
+            self._enum_by_frag[frag_key] = en
+            self.split_enumerators.append(en)
+        return en
+
+    def unregister_split_enumerator(self, en) -> None:
+        if en in self.split_enumerators:
+            self.split_enumerators.remove(en)
+        if en.frag_key is not None:
+            self._enum_by_frag.pop(en.frag_key, None)
+
+    def register_source_exec(self, ex) -> None:
+        self.source_execs[ex.source_id] = ex
+
+    def unregister_source_exec(self, actor_id: int) -> None:
+        ex = self.source_execs.pop(actor_id, None)
+        if ex is not None:
+            ex.remove_split_metrics()
+
+    def _poll_split_enumerators(self):
+        """Merge every enumerator's newly-discovered splits into one
+        mutation (None when nothing changed). Polls are throttled inside
+        each enumerator; a poll failure (broker away) skips this round
+        — discovery must never fail injection."""
+        adds: dict[int, list] = {}
+        for en in list(self.split_enumerators):
+            try:
+                a = en.poll()
+            except Exception:  # noqa: BLE001 — discovery is best-effort
+                a = None
+            if a:
+                for sid, sp in a.items():
+                    adds.setdefault(sid, []).extend(sp)
+        if not adds:
+            return None
+        from ..stream.message import AddSplitsMutation
+        return AddSplitsMutation(
+            {sid: tuple(v) for sid, v in adds.items()})
 
     def register_worker(self, handle) -> None:
         """Attach a compute node (cluster mode): it participates in every
@@ -330,6 +388,10 @@ class BarrierCoordinator:
         # the committed epoch and delivery resumes after the durable
         # cursor — exactly-once either way)
         self.logstore.check_failure()
+        # split discovery rides otherwise-unadorned barriers (a Pause/
+        # Stop/Throttle keeps its own mutation; growth waits one round)
+        if mutation is None and self.split_enumerators:
+            mutation = self._poll_split_enumerators()
         if kind is None:
             self._barrier_count += 1
             is_ckpt = (self._barrier_count % self.checkpoint_frequency) == 0

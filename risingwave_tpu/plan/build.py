@@ -76,6 +76,7 @@ class BuildEnv:
         self.session = None
         self.pending_taps: list = []          # (upstream MvDef, Channel)
         self.pending_source_queues: list = []
+        self.pending_enumerators: list = []    # broker split enumerators
         # label prefix for memory-manager registration — the Session sets
         # this to the MV/sink name around build_graph so EXPLAIN and
         # \metrics attribute HBM to the flow that owns it
@@ -129,6 +130,9 @@ class Deployment:
     source_queues: list = field(default_factory=list)
     memory_names: list = field(default_factory=list)
     mesh_actor_ids: list = field(default_factory=list)
+    # split enumerators created by this deployment's source builders
+    # (broker discovery, connectors/broker.py) — unregistered on stop
+    enumerators: list = field(default_factory=list)
     # ---- per-fragment recovery bookkeeping (frontend/session.py) ----
     actor_fragment: dict = field(default_factory=dict)   # actor_id -> fid
     frag_actor_ids: dict = field(default_factory=dict)   # fid -> [ids]
@@ -171,6 +175,15 @@ class Deployment:
             for q in self.source_queues:
                 if q in self.coord.source_queues:
                     self.coord.source_queues.remove(q)
+            unreg_src = getattr(self.coord, "unregister_source_exec", None)
+            if unreg_src is not None:
+                for a in self.actors:
+                    unreg_src(a.actor_id)
+            unreg_en = getattr(self.coord,
+                               "unregister_split_enumerator", None)
+            if unreg_en is not None:
+                for en in self.enumerators:
+                    unreg_en(en)
             for n in self.memory_names:
                 self.coord.memory.unregister(n)
             for a in self.mesh_actor_ids:
@@ -291,6 +304,7 @@ def _build_fragment_actor(graph, env, dep, channels, built_schema,
 
 def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
     env.pending_source_queues = []
+    env.pending_enumerators = []
     dep = Deployment(coord=env.coord)
     # channels[(up_fid, down_fid, edge_k)][u_actor][d_actor] — one matrix
     # PER EXCHANGE EDGE, so a fragment consuming the same upstream twice
@@ -383,6 +397,7 @@ def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
         dep.frag_source_queues[fid] = list(
             env.pending_source_queues[q_before:])
     dep.source_queues = list(env.pending_source_queues)
+    dep.enumerators = list(env.pending_enumerators)
     dep.rebuild_info = {"graph": graph, "env": env, "channels": channels,
                         "built_schema": built_schema,
                         "consumers": consumers}
@@ -528,6 +543,24 @@ def _build_source(args, inputs, ctx: ActorCtx, key):
     from ..connectors.nexmark import NexmarkConfig
     from ..connectors.split import BlockSplitConnector
 
+    barrier_q: asyncio.Queue = asyncio.Queue()
+    ctx.env.coord.register_source(barrier_q)
+    ctx.env.pending_source_queues.append(barrier_q)
+    st = None
+    if args.get("durable"):
+        tid = ctx.table_id(key)
+        st = ctx.env.state_table(
+            tid, Schema((SchemaField("split_id", DataType.INT64),
+                         SchemaField("offset", DataType.INT64))), (0,))
+    P = ctx.fragment.parallelism
+    name = args.get("source_name")
+    rate = args.get("rate_limit")
+
+    if args.get("connector") == "broker":
+        ex = _build_broker_source(args, ctx, barrier_q, st, name, P, rate)
+        ctx.env.coord.register_source_exec(ex)
+        return ex
+
     def make_gen():
         if args.get("connector") == "jsonl":
             from ..connectors.file_source import (JsonlFileConnector,
@@ -546,37 +579,79 @@ def _build_source(args, inputs, ctx: ActorCtx, key):
                                 **({"cfg": cfg} if cfg else {}))
 
     n_splits = int(args.get("splits", 1))
-    P = ctx.fragment.parallelism
     assert n_splits >= P, \
         f"source parallelism {P} exceeds its {n_splits} split(s)"
-    barrier_q: asyncio.Queue = asyncio.Queue()
-    ctx.env.coord.register_source(barrier_q)
-    ctx.env.pending_source_queues.append(barrier_q)
-    st = None
-    if args.get("durable"):
-        tid = ctx.table_id(key)
-        st = ctx.env.state_table(
-            tid, Schema((SchemaField("split_id", DataType.INT64),
-                         SchemaField("offset", DataType.INT64))), (0,))
     if n_splits == 1 and P == 1:
-        return SourceExecutor(
+        ex = SourceExecutor(
             ctx.actor_id, make_gen(), barrier_q, state_table=st,
             emit_watermarks=args.get("emit_watermarks", False),
             watermark_lag_us=args.get("watermark_lag_us", 0),
-            rate_limit_rows_per_barrier=args.get("rate_limit"))
+            rate_limit_rows_per_barrier=args.get("rate_limit"),
+            name=name)
+        ctx.env.coord.register_source_exec(ex)
+        return ex
     # split assignment: split k -> actor (k % P); a re-assigned split
     # recovers its committed offset wherever it lands (reference:
     # source_manager.rs split (re)assignment)
     my_splits = [(k, BlockSplitConnector(make_gen(), k, n_splits))
                  for k in range(n_splits) if k % P == ctx.actor_idx]
-    rate = args.get("rate_limit")
-    return SourceExecutor(
+    ex = SourceExecutor(
         ctx.actor_id, barrier_queue=barrier_q, state_table=st,
         splits=my_splits,
         emit_watermarks=args.get("emit_watermarks", False),
         watermark_lag_us=args.get("watermark_lag_us", 0),
         rate_limit_rows_per_barrier=(None if rate is None
-                                     else max(1, rate // P)))
+                                     else max(1, rate // P)),
+        name=name)
+    ctx.env.coord.register_source_exec(ex)
+    return ex
+
+
+def _build_broker_source(args, ctx: ActorCtx, barrier_q, st, name, P,
+                         rate):
+    """Broker-partition source (connectors/broker.py): splits ARE the
+    topic's partitions as of build time (split k -> actor k % P, the
+    standard rule), and ONE shared enumerator per fragment watches for
+    partition growth — new splits arrive at a barrier via
+    AddSplitsMutation, with offsets committed from that barrier on."""
+    from ..connectors.broker import (BrokerPartitionConnector,
+                                     BrokerSplitEnumerator)
+    from ..connectors.file_source import parse_columns
+    from ..broker.client import BrokerClient
+
+    schema = parse_columns(args["columns"])
+    brokers, topic = args["brokers"], args["topic"]
+    chunk_size = int(args.get("chunk_size", 256))
+    client = BrokerClient(brokers)
+    # idempotent ensure: partition count only ever grows, so the live
+    # count is >= the count the DDL was bound against
+    n_parts = client.create_topic(topic=topic,
+                                  partitions=int(args.get("partitions",
+                                                          1)))
+    client.close()
+    assert n_parts >= P, \
+        f"source parallelism {P} exceeds topic {topic!r}'s " \
+        f"{n_parts} partition(s)"
+    my_splits = [(k, BrokerPartitionConnector(brokers, topic, k, schema,
+                                              chunk_size=chunk_size))
+                 for k in range(n_parts) if k % P == ctx.actor_idx]
+    interval_s = int(args.get("discovery_interval_ms", 1000)) / 1e3
+    en = ctx.env.coord.split_enumerator(
+        id(ctx.fragment),
+        lambda: BrokerSplitEnumerator(
+            brokers, topic, schema, chunk_size, P, n_parts,
+            poll_interval_s=interval_s))
+    en.register_actor(ctx.actor_idx, ctx.actor_id)
+    en.observe_build(n_parts)
+    pend = getattr(ctx.env, "pending_enumerators", None)
+    if pend is not None and en not in pend:
+        pend.append(en)
+    return SourceExecutor(
+        ctx.actor_id, barrier_queue=barrier_q, state_table=st,
+        splits=my_splits,
+        rate_limit_rows_per_barrier=(None if rate is None
+                                     else max(1, int(rate) // P)),
+        name=name)
 
 
 @register_builder("project")
@@ -935,6 +1010,8 @@ def _build_sink(args, inputs, ctx: ActorCtx, key):
                                DeviceBlackholeSinkExecutor, FileSink,
                                SinkExecutor)
     connector = args.get("connector", "blackhole")
+    force = args.get("type") == "append-only" or str(
+        args.get("force_append_only", "")).lower() in ("true", "1")
     if connector == "blackhole_device":
         return DeviceBlackholeSinkExecutor(inputs[0])
     if connector == "blackhole":
@@ -943,10 +1020,23 @@ def _build_sink(args, inputs, ctx: ActorCtx, key):
         target = FileSink(args["path"], schema=inputs[0].schema)
     elif connector == "callback":
         target = CallbackSink(args["callback"])
+    elif connector == "broker":
+        from ..connectors.broker import BrokerSink
+        parts = int(args.get("partitions", 1))
+        if parts > 1 and not force:
+            # one delivery batch lands WHOLE in one partition (the
+            # atomicity the seq-in-topic dedupe rests on), and a
+            # consumer interleaves partitions arbitrarily — a
+            # retraction in p0 racing its re-insert in p1 would make
+            # the downstream state order-dependent. Inserts commute;
+            # retractions need the single-partition total order.
+            raise ValueError(
+                "broker sink with partitions > 1 requires an "
+                "append-only changelog (WITH type='append-only')")
+        target = BrokerSink(args["brokers"], args["topic"],
+                            schema=inputs[0].schema, partitions=parts)
     else:
         raise ValueError(f"unknown sink connector {connector!r}")
-    force = args.get("type") == "append-only" or str(
-        args.get("force_append_only", "")).lower() in ("true", "1")
     # Exactly-once via the changelog log store (logstore/): default for
     # file/callback targets on a meta-local (manifest-owning) store —
     # the epoch batch persists WITH the checkpoint and a background
@@ -958,7 +1048,7 @@ def _build_sink(args, inputs, ctx: ActorCtx, key):
     # commit point), so cluster sinks stay on the direct path — the
     # deploy-time guard in cluster/meta_service.py rejects an explicit
     # exactly_once request loudly instead of degrading silently.
-    default_eo = connector in ("file", "callback")
+    default_eo = connector in ("file", "callback", "broker")
     exactly_once = bool(int(args.get("exactly_once", default_eo)))
     log = hub = None
     if exactly_once and getattr(ctx.env.store, "manifest_owner", True):

@@ -64,8 +64,21 @@ class UpdateMutation:
     dropped_actors: frozenset[int] = frozenset()
 
 
+@dataclass(frozen=True)
+class AddSplitsMutation:
+    """Split discovery (reference: SourceManager split assignment riding
+    a barrier, source_manager.rs): newly-discovered source splits reach
+    their assigned actors totally ordered with data — the actor adopts
+    them at barrier receipt and commits their offsets from the SAME
+    barrier on. In-process only (live connector objects ride along;
+    cluster deploys reject discovery-managed sources in v1)."""
+    # source actor id -> ((split_id, connector), ...)
+    assignments: dict = field(default_factory=dict)
+
+
 Mutation = Union[StopMutation, PauseMutation, ResumeMutation,
-                 ThrottleMutation, AddMutation, UpdateMutation]
+                 ThrottleMutation, AddMutation, UpdateMutation,
+                 AddSplitsMutation]
 
 
 @dataclass(frozen=True)

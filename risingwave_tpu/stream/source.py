@@ -37,11 +37,14 @@ class SourceExecutor(Executor):
                  emit_watermarks: bool = False,
                  watermark_lag_us: int = 0,
                  max_inflight_chunks: int = 16,
-                 splits: Optional[list] = None):
+                 splits: Optional[list] = None,
+                 name: Optional[str] = None):
         """Single-connector form (connector=...) or split-assigned form
         (splits=[(split_id, connector), ...] — reference: the actor's
         split assignment from SourceManager)."""
         self.source_id = source_id
+        # catalog name for labelled per-split series + SHOW sources
+        self.source_name = name or f"src{source_id}"
         if splits is None:
             splits = [(0, connector)]
         assert splits and all(c is not None for _, c in splits)
@@ -124,6 +127,7 @@ class SourceExecutor(Executor):
                 conn.seek(row[1])
 
     def _commit_offset(self, barrier: Barrier) -> None:
+        self._update_split_metrics()
         if self.state_table is None:
             return
         # upsert (split_id, next_offset) per owned split; offsets ride
@@ -131,6 +135,63 @@ class SourceExecutor(Executor):
         self.state_table.write_chunk_rows(
             [(0, (sid, conn.offset)) for sid, conn in self.splits])
         self.state_table.commit(barrier.epoch.curr)
+
+    # ------------------------------------------------- split observability
+    def _update_split_metrics(self) -> None:
+        """Per-split offset/lag gauges, refreshed at barrier cadence
+        (host-known values only — lag reads the connector's CACHED
+        broker high watermark, never an RPC on the barrier path)."""
+        from ..utils.metrics import GLOBAL_METRICS
+        for sid, conn in self.splits:
+            GLOBAL_METRICS.gauge(
+                "source_split_offset", source=self.source_name,
+                split=str(sid)).set(float(conn.offset))
+            lag = getattr(conn, "lag_rows", None)
+            if lag is not None:
+                GLOBAL_METRICS.gauge(
+                    "source_lag_rows", source=self.source_name,
+                    split=str(sid)).set(float(lag()))
+
+    def remove_split_metrics(self) -> None:
+        """Deployment teardown: labelled per-split series die with the
+        executor (the per-actor streaming-series rule)."""
+        from ..utils.metrics import GLOBAL_METRICS
+        for sid, _conn in self.splits:
+            GLOBAL_METRICS.remove("source_split_offset",
+                                  source=self.source_name, split=str(sid))
+            GLOBAL_METRICS.remove("source_lag_rows",
+                                  source=self.source_name, split=str(sid))
+
+    def split_report(self) -> list[tuple]:
+        """SHOW sources rows: (split_id, offset, lag-or-None)."""
+        out = []
+        for sid, conn in self.splits:
+            lag = getattr(conn, "lag_rows", None)
+            out.append((sid, conn.offset,
+                        lag() if lag is not None else None))
+        return out
+
+    def _adopt_splits(self, assigned) -> None:
+        """AddSplitsMutation arrival (a barrier): take ownership of
+        newly-discovered splits. A split already owned is skipped
+        (mutation replay across recovery); a split with a committed
+        offset resumes there (a re-assigned split finds its state
+        wherever it lands, the `_recover_offset` rule). Offsets for the
+        new splits commit from THIS barrier on."""
+        for sid, conn in assigned:
+            if any(s == sid for s, _ in self.splits):
+                continue
+            if self.state_table is not None:
+                row = self.state_table.get_row((sid,))
+                if row is not None:
+                    conn.seek(row[1])
+            self.splits.append((sid, conn))
+            # watermark safety: the frontier is a MIN over owned splits,
+            # so a split that cannot report one disables emission rather
+            # than silently over-advancing it
+            if self.emit_watermarks and not hasattr(
+                    getattr(conn, "inner", conn), "current_watermark"):
+                self.emit_watermarks = False
 
     async def execute(self):
         # First message is always the Initial barrier (reference: actors are
@@ -142,7 +203,10 @@ class SourceExecutor(Executor):
         # rescale/MV-on-MV rebuild joins a running epoch stream where the
         # Initial barrier happened long ago
         self._recover_offset()
-        self.paused = barrier.is_pause()
+        # the first barrier can already carry mutations (a split
+        # discovered between build and the first injection must not be
+        # dropped — the enumerator will never re-announce it)
+        self._apply_mutation(barrier)
         yield barrier
 
         sent_this_interval = 0
@@ -187,10 +251,17 @@ class SourceExecutor(Executor):
                 continue
             await self._acquire_credit()
             # round-robin across owned splits (reference: the reader
-            # stream interleaves its assigned splits)
+            # stream interleaves its assigned splits), skipping splits
+            # with nothing to read — a lagging split must not starve the
+            # rest behind empty chunks (all-exhausted was handled above)
             self._rr = getattr(self, "_rr", 0)
             conn = self.splits[self._rr % len(self.splits)][1]
             self._rr += 1
+            for _ in range(len(self.splits) - 1):
+                if not getattr(conn, "exhausted", False):
+                    break
+                conn = self.splits[self._rr % len(self.splits)][1]
+                self._rr += 1
             chunk = conn.next_chunk()
             self._tokens.append(chunk.columns[0].data)
             # Visible rows come from HOST knowledge only: a d2h sync per
@@ -224,10 +295,13 @@ class SourceExecutor(Executor):
     def _apply_mutation(self, barrier: Barrier) -> None:
         if barrier.is_pause():
             self.paused = True
-        from .message import ResumeMutation
+        from .message import AddSplitsMutation, ResumeMutation
         if isinstance(barrier.mutation, ResumeMutation):
             self.paused = False
         if isinstance(barrier.mutation, ThrottleMutation):
             for actor_id, limit in barrier.mutation.limits:
                 if actor_id == self.source_id:
                     self.rate_limit = limit
+        if isinstance(barrier.mutation, AddSplitsMutation):
+            self._adopt_splits(
+                barrier.mutation.assignments.get(self.source_id, ()))

@@ -27,6 +27,15 @@ Fault points (site → effect when the rule fires):
   recovery_crash  frontend/session.py — a crash DURING recovery itself
                   (mid DDL replay on the full path, mid rebuild on the
                   partial path; `phase=` filters full|partial)
+  broker_fetch_fail   connectors/broker.py BrokerPartitionConnector —
+                  the source's partition fetch raises (the consuming
+                  actor dies -> fail-stop -> auto-recovery reseeks the
+                  committed offset; filter `topic=`/`partition=`)
+  broker_append_fail  connectors/broker.py BrokerSink — the sink's
+                  topic append raises (delivery parks on the hub,
+                  fail-stops the next injection exactly like an upload
+                  failure; the re-delivered batch dedupes on the seq
+                  persisted in the topic; filter `topic=`/`seq=`)
 
 Spec grammar (one statement, deterministic by construction — rules fire
 on exact occurrence counts, never on wall clock):

@@ -328,5 +328,12 @@ RECOVERY_BACKOFF = GLOBAL_METRICS.counter(
 # (`logstore_subscription_lag_epochs{subscription=...}`) ride alongside
 # once flows register.
 LOGSTORE_APPEND_BYTES = GLOBAL_METRICS.counter("logstore_append_bytes_total")
+
+# Source split observability (stream/source.py): per-split labelled
+# gauges `source_split_offset{source,split}` (rows consumed by the
+# split, refreshed at barrier cadence) and `source_lag_rows{source,
+# split}` (broker high watermark minus consumed offset, from the
+# connector's CACHED watermark — external-ingress backlog). Labelled
+# series ride the registry on demand; they die with the executor.
 SINK_DELIVERED_EPOCHS = GLOBAL_METRICS.counter("sink_delivered_epochs_total")
 SINK_DELIVERED_ROWS = GLOBAL_METRICS.counter("sink_delivered_rows_total")

@@ -1,0 +1,346 @@
+"""Broker gate — engine↔engine exactly-once pipelines through a real
+broker process (ISSUE 10 capstone).
+
+A standalone broker (`python -m risingwave_tpu.broker`, real socket)
+carries a two-engine pipeline:
+
+    engine A:  nexmark bid -> TUMBLE window MAX(price) -> BrokerSink
+    engine B:  BrokerSource (primary_key=window_end) -> MV `out`
+
+run four times: clean, kill engine A mid-stream (crash + catalog
+recovery on its durable store), kill engine B the same way, and kill
+the BROKER mid-stream (SIGKILL the process, restart on the same data
+dir + port). After each run the pipeline quiesces and must satisfy:
+
+  * BIT-IDENTITY: B's MV equals the numpy generator-prefix oracle
+    (window_end -> max price) at A's COMMITTED source offset — the
+    one-engine answer, end to end through the broker;
+  * EXACTLY-ONCE EGRESS: the topic's batch metadata holds DENSE,
+    duplicate-free delivery sequence numbers and no re-delivered epoch
+    (a duplicated epoch would double a batch, a dropped one would break
+    density);
+  * the kill runs actually recovered (>= 1 recovery / restart).
+
+Plus the ingest-latency bound: with identical per-barrier rate limits,
+the broker-sourced ingest barrier p50 must stay within 3x of the
+in-process generator (datagen) path — external ingress is a connector,
+not a new bottleneck.
+
+CI usage (CPU backend):
+
+    JAX_PLATFORMS=cpu python scripts/broker_profile.py
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from risingwave_tpu.utils.compile_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+WINDOW_US = 1_000_000
+RATE = 512
+INGEST_RATE = 2048
+INGEST_ROUNDS = 30
+P50_RATIO_BOUND = 3.0
+
+
+# ------------------------------------------------------------ broker proc
+class BrokerProc:
+    """The real thing: a subprocess serving the broker wire; kill() +
+    start() on the same data dir is the broker-restart scenario."""
+
+    def __init__(self, data: str, port: int = 0):
+        self.data = data
+        self.port = port
+        self.proc = None
+        self.addr = None
+
+    def start(self) -> str:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "risingwave_tpu.broker",
+             "--data", self.data, "--port", str(self.port)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, text=True)
+        line = self.proc.stdout.readline()
+        info = json.loads(line)
+        self.addr = info["broker"]
+        self.port = int(self.addr.rsplit(":", 1)[1])
+        return self.addr
+
+    def kill(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGKILL)
+            self.proc.wait()
+        self.proc = None
+
+
+# ---------------------------------------------------------------- oracle
+def _oracle(offset: int) -> Counter:
+    """Numpy recount of the bid generator prefix at `offset`:
+    window_end -> max(price) — the one-engine answer."""
+    import numpy as np
+    from risingwave_tpu.connectors import NexmarkGenerator
+    from risingwave_tpu.connectors.nexmark import NexmarkConfig
+    gen = NexmarkGenerator("bid", chunk_size=max(256, offset),
+                           cfg=NexmarkConfig(inter_event_us=2000))
+    c = gen.next_chunk()
+    price = np.asarray(c.columns[2].data)[:offset]
+    dt = np.asarray(c.columns[5].data)[:offset]
+    we = dt - dt % WINDOW_US + WINDOW_US
+    out: Counter = Counter()
+    for w in np.unique(we):
+        out[(int(w), int(price[we == w].max()))] += 1
+    return out
+
+
+def _committed_offset(session) -> int:
+    from risingwave_tpu.state.storage_table import StorageTable
+    from risingwave_tpu.stream.source import SourceExecutor
+    flows = (list(session.catalog.mvs.values())
+             + list(session.catalog.sinks.values()))
+    for flow in flows:
+        for roots in flow.deployment.roots.values():
+            for root in roots:
+                node = root
+                while node is not None:
+                    if isinstance(node, SourceExecutor):
+                        rows = list(StorageTable.for_state_table(
+                            node.state_table).batch_iter())
+                        return int(rows[0][1]) if rows else 0
+                    node = getattr(node, "input", None)
+    raise AssertionError("no source executor")
+
+
+def _topic_seqs_epochs(data: str, topic: str):
+    """Delivery (seq, epoch) pairs straight from the broker's durable
+    batch metadata — read offline (the broker process may be dead)."""
+    import struct
+    from risingwave_tpu.broker.log import PartitionLog
+    pairs = []
+    tdir = os.path.join(data, topic)
+    for p in sorted(os.listdir(tdir)):
+        pl = PartitionLog(os.path.join(tdir, p), fsync=False)
+        for _base, _n, seg, pos in pl._index:
+            with open(seg, "rb") as f:
+                f.seek(pos)
+                ln, _crc = struct.unpack("!II", f.read(8))
+                body = f.read(ln)
+            _b, _nr, ml = struct.unpack_from("!QII", body)
+            if ml:
+                m = json.loads(body[16:16 + ml])
+                pairs.append((m["seq"], m["epoch"]))
+    return sorted(pairs)
+
+
+# -------------------------------------------------------------- pipeline
+def _session(path: str):
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+    return Session(store=HummockStateStore(LocalFsObjectStore(path)))
+
+
+async def _engine_a(path: str, addr: str, topic: str):
+    a = _session(path)
+    await a.execute("SET streaming_watchdog = 0")
+    if not a.catalog.sinks:
+        await a.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+            f"chunk_size=128, inter_event_us=2000, rate_limit={RATE})")
+        await a.execute(
+            "CREATE SINK q7w AS SELECT window_end, max(price) AS mp "
+            f"FROM TUMBLE(bid, date_time, {WINDOW_US}) "
+            "GROUP BY window_end "
+            f"WITH (connector='broker', topic='{topic}', "
+            f"brokers='{addr}')")
+    return a
+
+
+async def _engine_b(path: str, addr: str, topic: str):
+    b = _session(path)
+    if not b.catalog.mvs:
+        await b.execute(
+            f"CREATE SOURCE q7 WITH (connector='broker', "
+            f"topic='{topic}', brokers='{addr}', "
+            "columns='window_end timestamp, mp int64', "
+            "primary_key='window_end', chunk_size=64, "
+            "discovery_interval_ms=0)")
+        await b.execute("CREATE MATERIALIZED VIEW out AS "
+                        "SELECT window_end, mp FROM q7")
+    return b
+
+
+async def _recover(path: str):
+    s = _session(path)
+    await s.recover()
+    return s
+
+
+async def _run_scenario(name: str, tmp: str, broker: BrokerProc) -> dict:
+    topic = f"q7w_{name}"
+    a_dir = os.path.join(tmp, f"a_{name}")
+    b_dir = os.path.join(tmp, f"b_{name}")
+    a = await _engine_a(a_dir, broker.addr, topic)
+    b = await _engine_b(b_dir, broker.addr, topic)
+    recoveries = 0
+
+    await a.tick(3)
+    await b.tick(2)
+
+    if name == "kill_a":
+        await a.crash()                 # process-kill simulation
+        a = await _recover(a_dir)
+        recoveries += 1
+    elif name == "kill_b":
+        await b.tick(1)
+        await b.crash()
+        b = await _recover(b_dir)
+        recoveries += 1
+    elif name == "kill_broker":
+        broker.kill()                   # SIGKILL mid-stream
+        # A's delivery fails against the dead broker -> parks ->
+        # fail-stop; recovery cannot complete until the broker is back,
+        # so this tick is EXPECTED to fail (that is the scenario)
+        try:
+            await a.tick(1, max_recoveries=1)
+        except RuntimeError:
+            pass
+        await b.tick(1, max_recoveries=2)   # B just parks (exhausted)
+        broker.start()                  # same data dir, same port
+        recoveries += 1
+
+    # more traffic THROUGH the recovered topology, then quiesce A
+    # (ticks drain sink delivery), then B until its consumed offsets
+    # reach the broker's TRUE high watermark (the connector's cached
+    # watermark can lag freshly-delivered entries) + a settle tick so
+    # the last fetch commits into the MV
+    await a.tick(4, max_recoveries=4)
+    await b.tick(2, max_recoveries=4)
+    await a.tick(1, max_recoveries=4)
+    from risingwave_tpu.broker.client import BrokerClient
+    c = BrokerClient(broker.addr)
+    for _ in range(20):
+        await b.tick(1, max_recoveries=4)
+        hwm = sum(c.high_watermark(topic=topic, partition=p)
+                  for p in range(c.list_partitions(topic=topic)))
+        consumed = sum(t[1] for aid in b.coord.source_execs
+                       for t in b.coord.source_execs[aid].split_report())
+        if consumed >= hwm:
+            break
+    c.close()
+    await b.tick(2, max_recoveries=4)
+
+    offset = _committed_offset(a)
+    got = Counter(b.query("SELECT window_end, mp FROM out"))
+    expected = _oracle(offset)
+    pairs = _topic_seqs_epochs(broker.data, topic)
+    seqs = [s for s, _e in pairs]
+    epochs = [e for _s, e in pairs]
+    out = {
+        "scenario": name,
+        "offset": offset,
+        "mv_rows": sum(got.values()),
+        "bit_identical": got == expected,
+        "delivered_batches": len(pairs),
+        "seqs_dense_unique": seqs == list(range(1, len(seqs) + 1))
+        and len(seqs) > 0,
+        "no_redelivered_epoch": len(epochs) == len(set(epochs)),
+        "killed": bool(recoveries),
+    }
+    await a.drop_all()
+    await b.drop_all()
+    return out
+
+
+# ------------------------------------------------------------- ingest p50
+async def _ingest_p50_broker(tmp: str, addr: str) -> float:
+    from risingwave_tpu.broker.client import BrokerClient
+    c = BrokerClient(addr)
+    c.create_topic(topic="ingest", partitions=1)
+    rows = [json.dumps({"k": i, "v": i * 3}).encode()
+            for i in range(INGEST_RATE * (INGEST_ROUNDS + 8))]
+    for i in range(0, len(rows), 8192):
+        c.append("ingest", 0, rows[i:i + 8192])
+    c.close()
+    s = _session(os.path.join(tmp, "ingest_broker"))
+    await s.execute("SET streaming_watchdog = 0")
+    await s.execute(
+        f"CREATE SOURCE ev WITH (connector='broker', topic='ingest', "
+        f"brokers='{addr}', columns='k int64, v int64', chunk_size=256, "
+        f"rate_limit={INGEST_RATE}, discovery_interval_ms=0, "
+        "append_only=1)")
+    await s.execute("CREATE MATERIALIZED VIEW m AS SELECT k, v FROM ev")
+    p50 = await _measure(s)
+    assert len(s.query("SELECT k, v FROM m")) > INGEST_RATE
+    await s.drop_all()
+    return p50
+
+
+async def _ingest_p50_datagen(tmp: str) -> float:
+    s = _session(os.path.join(tmp, "ingest_datagen"))
+    await s.execute("SET streaming_watchdog = 0")
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        f"chunk_size=256, rate_limit={INGEST_RATE})")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW m AS SELECT auction, price FROM bid")
+    p50 = await _measure(s)
+    await s.drop_all()
+    return p50
+
+
+async def _measure(s) -> float:
+    coord = s.coord
+    await s.tick(4)                      # warmup (compiles)
+    n_warm = len(coord.latencies_ns)
+    for _ in range(INGEST_ROUNDS):
+        await asyncio.sleep(0.002)
+        bar = await coord.inject_barrier()
+        await coord.wait_collected(bar)
+    xs = sorted(coord.latencies_ns[n_warm:])
+    return xs[len(xs) // 2] / 1e9
+
+
+async def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="broker_profile_")
+    broker = BrokerProc(os.path.join(tmp, "broker"))
+    broker.start()
+    results = []
+    try:
+        for name in ("clean", "kill_a", "kill_b", "kill_broker"):
+            results.append(await _run_scenario(name, tmp, broker))
+            print(json.dumps(results[-1]))
+        p50_broker = await _ingest_p50_broker(tmp, broker.addr)
+        p50_datagen = await _ingest_p50_datagen(tmp)
+    finally:
+        broker.kill()
+    ratio = p50_broker / max(p50_datagen, 1e-9)
+    verdict = {
+        "all_bit_identical": all(r["bit_identical"] for r in results),
+        "all_seqs_dense_unique": all(r["seqs_dense_unique"]
+                                     for r in results),
+        "no_redelivered_epochs": all(r["no_redelivered_epoch"]
+                                     for r in results),
+        "kills_injected": sum(1 for r in results if r["killed"]) == 3,
+        "ingest_p50_broker_s": round(p50_broker, 5),
+        "ingest_p50_datagen_s": round(p50_datagen, 5),
+        "ingest_p50_ratio": round(ratio, 3),
+        "ingest_within_bound": ratio <= P50_RATIO_BOUND,
+    }
+    print(json.dumps({"verdict": verdict}))
+    return 0 if all(v for v in verdict.values()
+                    if isinstance(v, bool)) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

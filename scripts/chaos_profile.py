@@ -26,6 +26,19 @@ logstore gate use):
                    the pipelined commit just lands late (delivery and
                    replay-buffer trims follow it)
 
+plus the external-ingress/egress classes over an in-process broker
+(connectors/broker.py — the fail-stop -> auto-recovery path, never a
+hang):
+
+  broker_fetch_fail   the source's partition fetch raises -> the
+                   consuming actor dies -> recovery reseeks the
+                   committed offsets; the MV converges to exactly the
+                   produced rows (no loss, no duplication)
+  broker_append_fail  the sink's topic append raises -> delivery parks
+                   and fail-stops the next injection; after recovery
+                   the topic holds dense, duplicate-free delivery
+                   sequence numbers and exactly the MV's changelog
+
 Exits non-zero unless ALL hold:
 
   * every run converges BIT-IDENTICAL to the generator-prefix oracle:
@@ -185,6 +198,106 @@ def _agg_actor(session) -> int:
     raise AssertionError("no hash_agg fragment")
 
 
+async def _run_broker_faults(tmp: str) -> list:
+    """The ingress/egress fault classes need a broker in the loop: a
+    fresh session per class over an in-process broker (tests cover the
+    socket transport; the fault path is transport-independent)."""
+    import json as _json
+    from risingwave_tpu.broker import Broker, register_inproc
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+
+    out = []
+
+    # ---- broker_fetch_fail: source fetch dies mid-ingest ----
+    broker = Broker(os.path.join(tmp, "broker_in"), fsync=False)
+    register_inproc("chaos_in", broker)
+    broker.create_topic("ev", 1)
+    rows = [_json.dumps({"k": i, "v": i * 7}).encode() for i in range(400)]
+    broker.append("ev", 0, rows[:250])
+    s = Session(store=HummockStateStore(
+        LocalFsObjectStore(os.path.join(tmp, "broker_fetch_fail"))))
+    await s.execute("SET streaming_watchdog = 0")
+    await s.execute(
+        "CREATE SOURCE ev WITH (connector='broker', topic='ev', "
+        "brokers='inproc://chaos_in', columns='k int64, v int64', "
+        "chunk_size=64, discovery_interval_ms=0, append_only=1)")
+    await s.execute("CREATE MATERIALIZED VIEW bm AS SELECT k, v FROM ev")
+    await s.tick(2)
+    await s.execute("SET fault_injection = 'broker_fetch_fail:at=2'")
+    broker.append("ev", 0, rows[250:])
+    await s.tick(5, max_recoveries=4)
+    await s.execute("SET fault_injection = ''")
+    await s.tick(2)
+    got = Counter(s.query("SELECT k, v FROM bm"))
+    expected = Counter((i, i * 7) for i in range(400))
+    out.append({"fault": "broker_fetch_fail",
+                "converged": got == expected,
+                "mv_rows": sum(got.values()),
+                "recoveries": s.recoveries,
+                "last_recovery": s.last_recovery})
+    await s.drop_all()
+
+    # ---- broker_append_fail: sink delivery dies mid-append ----
+    s = Session(store=HummockStateStore(
+        LocalFsObjectStore(os.path.join(tmp, "broker_append_fail"))))
+    await s.execute("SET streaming_watchdog = 0")
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=128, inter_event_us=2000, rate_limit=512)")
+    await s.execute("SET fault_injection = 'broker_append_fail:at=2'")
+    await s.execute(
+        "CREATE SINK q7b AS SELECT window_end, max(price) AS maxprice "
+        f"FROM TUMBLE(bid, date_time, {WINDOW_US}) GROUP BY window_end "
+        "WITH (connector='broker', topic='q7b', "
+        "brokers='inproc://chaos_in')")
+    await s.tick(5, max_recoveries=4)
+    await s.execute("SET fault_injection = ''")
+    await s.tick(3)
+    # topic-side exactly-once: dense unique seqs, replay-consistent rows
+    seqs = []
+    live: Counter = Counter()
+    dangling = 0
+    from risingwave_tpu.broker.log import PartitionLog
+    for p in range(broker.list_partitions("q7b")):
+        pl = PartitionLog(os.path.join(tmp, "broker_in", "q7b",
+                                       f"p{p:05d}"), fsync=False)
+        for rec in pl.fetch(0, 1_000_000):
+            o = _json.loads(rec)
+            key = (o.get("window_end"), o.get("maxprice"))
+            if o.get("__op") == 1:
+                if live[key] <= 0:
+                    dangling += 1
+                else:
+                    live[key] -= 1
+            else:
+                live[key] += 1
+    # batch metas carry the delivery seqs — walk them via the log index
+    for p in range(broker.list_partitions("q7b")):
+        pl = broker._parts[("q7b", p)]
+        for base, _n, seg, pos in pl._index:
+            import struct as _struct
+            import zlib as _zlib
+            with open(seg, "rb") as f:
+                f.seek(pos)
+                ln, _crc = _struct.unpack("!II", f.read(8))
+                body = f.read(ln)
+            _b, _nr, ml = _struct.unpack_from("!QII", body)
+            if ml:
+                seqs.append(_json.loads(body[16:16 + ml])["seq"])
+    seqs.sort()
+    windows = [k[0] for k, c in live.items() for _ in range(c)]
+    out.append({"fault": "broker_append_fail",
+                "converged": (seqs == list(range(1, len(seqs) + 1))
+                              and len(seqs) > 0 and dangling == 0
+                              and len(windows) == len(set(windows))),
+                "delivered_seqs": len(seqs),
+                "recoveries": s.recoveries,
+                "last_recovery": s.last_recovery})
+    await s.drop_all()
+    return out
+
+
 async def main() -> int:
     import tempfile
     tmp = tempfile.mkdtemp(prefix="chaos_profile_")
@@ -210,7 +323,8 @@ async def main() -> int:
         lambda s: f"channel_stall:actor={_mv_actor(s)},at=2,ms=400"))
     results.append(await _run_fault(
         "upload_delay", tmp, lambda s: "upload_delay:at=1,ms=400"))
-    for r in results:
+    broker_results = await _run_broker_faults(tmp)
+    for r in results + broker_results:
         print(json.dumps(r))
 
     by_name = {r["fault"]: r for r in results}
@@ -250,6 +364,11 @@ async def main() -> int:
             r["healthz_last_recovery"] is not None
             and "scope" in r["healthz_last_recovery"]
             for r in frag_runs + full_runs),
+        # external ingress/egress faults take the fail-stop -> recovery
+        # path (never a hang) and converge exactly-once
+        "broker_faults_converged": all(
+            r["converged"] and r["recoveries"] >= 1
+            for r in broker_results),
     }
     print(json.dumps({"verdict": verdict}))
     ok = all(v for k, v in verdict.items()

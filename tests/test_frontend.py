@@ -175,7 +175,11 @@ async def test_explain_and_show():
     assert "m" not in s.catalog.mvs, "EXPLAIN must not deploy"
     await s.execute("CREATE MATERIALIZED VIEW m AS SELECT auction "
                     "FROM bid")
-    assert s.show("sources") == [("bid",)]
+    # one row per live split: (source, split, offset, lag)
+    src_rows = s.show("sources")
+    assert [r[0] for r in src_rows] == ["bid"]
+    assert src_rows[0][1] == "0"          # split id
+    assert src_rows[0][2].isdigit()       # committed offset
     assert s.show("materialized_views") == [("m",)]
     rows = await s.execute("SHOW streaming_durability")
     assert rows == [("1",)]

@@ -721,3 +721,76 @@ async def test_replica_resubscribe_resumes_over_socket(tmp_path):
     await s.stop_subscription_server()
     await s.drop_all()
     await s.shutdown()
+
+
+async def test_cursor_ttl_lease_releases_retention(tmp_path):
+    """A durable named cursor with NO live subscriber for longer than
+    `subscription_cursor_ttl_ms` stops holding the MV changelog: the
+    cursor is tombstoned durably, retention advances, the log
+    deactivates when nothing else pins it, and a resubscribe under the
+    same name falls back to backfill-then-tail instead of resuming."""
+    store = HummockStateStore(LocalFsObjectStore(str(tmp_path / "d")))
+    s = Session(store=store)
+    await s.execute("SET subscription_cursor_ttl_ms = 150")
+    await s.execute("CREATE SOURCE bid WITH (connector='nexmark', "
+                    "table='bid', chunk_size=64, rate_limit=128)")
+    await s.execute("CREATE MATERIALIZED VIEW mv AS "
+                    "SELECT auction, price FROM bid "
+                    "WHERE price > 1000000")
+    await s.tick(2)
+    sub = ChangelogSubscription(s.coord.logstore, "mv", cursor_name="r1")
+    start = asyncio.create_task(sub.start())
+    await s.tick(1)
+    backfill = await start
+    await s.tick(2)
+    sub.close()                 # subscriber abandons its cursor
+    log = s.coord.logstore.mv_logs["mv"]
+    assert log.active           # still pinned: lease not lapsed yet
+    # resubscribe WITHIN the TTL still resumes
+    sub2 = ChangelogSubscription(s.coord.logstore, "mv",
+                                 cursor_name="r1")
+    assert (await sub2.start()).get("resume") is True
+    sub2.close()
+    # lease lapses: the next commit pulse drops the cursor durably and
+    # the log stops holding anything
+    await asyncio.sleep(0.25)
+    await s.tick(2)
+    assert log.read_sub_cursor("r1") is None, \
+        "expired cursor must be tombstoned durably"
+    assert not log.active, "nothing pins the log once the lease lapsed"
+    # after the TTL a resubscribe under the name is a FRESH backfill
+    sub3 = ChangelogSubscription(s.coord.logstore, "mv",
+                                 cursor_name="r1")
+    start3 = asyncio.create_task(sub3.start())
+    await s.tick(1)
+    backfill3 = await start3
+    assert not backfill3.get("resume")
+    assert "rows" in backfill3
+    sub3.close()
+    await s.drop_all()
+
+
+async def test_cursor_ttl_zero_never_expires(tmp_path):
+    """Default TTL (0): an abandoned cursor pins the log indefinitely —
+    the pre-TTL behavior stays the default (drop_sub_cursor is the only
+    release)."""
+    store = HummockStateStore(LocalFsObjectStore(str(tmp_path / "d")))
+    s = Session(store=store)
+    await s.execute("CREATE SOURCE bid WITH (connector='nexmark', "
+                    "table='bid', chunk_size=64, rate_limit=128)")
+    await s.execute("CREATE MATERIALIZED VIEW mv AS "
+                    "SELECT auction, price FROM bid "
+                    "WHERE price > 1000000")
+    await s.tick(2)
+    sub = ChangelogSubscription(s.coord.logstore, "mv", cursor_name="r1")
+    start = asyncio.create_task(sub.start())
+    await s.tick(1)
+    await start
+    await s.tick(2)
+    sub.close()
+    await asyncio.sleep(0.15)
+    await s.tick(2)
+    log = s.coord.logstore.mv_logs["mv"]
+    assert log.active
+    assert log.read_sub_cursor("r1") is not None
+    await s.drop_all()
