@@ -61,6 +61,7 @@ DEVICE_PROBE_TIMEOUT_S = 120.0
 # Per-query subprocess budgets (compile + measure + baseline), seconds.
 QUERY_BUDGET_S = {"q1": 60.0, "q5": 150.0, "q7": 150.0, "q8": 170.0,
                   "q17": 150.0, "q7d": 150.0, "q7_kill": 150.0,
+                  "q7_kill_interior": 150.0, "q7_kill_worker": 200.0,
                   "q5_8chip": 150.0, "q7_8chip": 150.0}
 # Baseline inputs are fixed (they don't depend on the device run), so the
 # orchestrator computes all four baselines in PARALLEL CPU subprocesses
@@ -591,17 +592,30 @@ async def bench_q7d(progress: dict) -> None:
 
 
 async def bench_q7_kill(progress: dict) -> None:
-    """Recovery-time SLO (ROADMAP item 5): the durable q7 shape run as a
-    MATERIALIZED VIEW, with an actor killed mid-measure through the
-    deterministic fault injector (utils/faults.py). The victim is the
-    MV's terminal materialize actor, so the tick-path auto-recovery
-    classifies the blast radius as ONE fragment and rebuilds just that
-    actor from the last committed epoch — the sorted-join/agg fragments
-    keep their device state and the exchange buffers replay the
-    in-flight interval. Emits `recovery_ms` (the SLO number),
-    `recovery_scope`/`rebuilt_actors` (proof it stayed partial), and
+    """Recovery-time SLO (ROADMAP item 5 + the recovery-radius PR): the
+    durable q7 shape run as a MATERIALIZED VIEW, with a victim killed
+    mid-measure through the deterministic fault injector
+    (utils/faults.py). The BENCH_Q7_KILL_VICTIM knob picks the radius
+    (registered as the q7_kill_interior / q7_kill_worker variants):
+
+      terminal (default)  the MV's materialize actor -> scope=fragment
+                          (one actor rebuilt from the committed epoch)
+      interior            an interior join/agg actor -> scope=cone
+                          (the victim + its downstream consumers
+                          rebuild; upstream keeps device state)
+      worker              a 2-worker cluster run with one compute-node
+                          PROCESS killed -> scope=worker (its actors
+                          re-place onto the survivor, whose store stays
+                          open at the committed manifest)
+
+    Emits `recovery_ms` (the SLO number), `recovery_scope`/
+    `rebuilt_actors` (proof the radius stayed contained), and
     `post_recovery_rows_per_sec` (the pipeline keeps earning after the
     fault)."""
+    victim_kind = os.environ.get("BENCH_Q7_KILL_VICTIM", "terminal")
+    if victim_kind == "worker":
+        await _bench_q7_kill_worker(progress)
+        return
     import glob
     import shutil
     import tempfile
@@ -653,7 +667,20 @@ async def bench_q7_kill(progress: dict) -> None:
     t_c0 = time.perf_counter()
     await s.tick(2)
     progress["compile_s"] = round(time.perf_counter() - t_c0, 1)
-    victim = mv.deployment.frag_actor_ids[mv.mv_fragment][0]
+    if victim_kind == "interior":
+        # an interior fragment (has downstream consumers, no source):
+        # its crash exercises the downstream-cone radius
+        from risingwave_tpu.frontend.session import _fragment_node_kinds
+        dep = mv.deployment
+        graph = dep.rebuild_info["graph"]
+        fid = next(f for f in graph.topo_order()
+                   if dep.fragment_consumers.get(f)
+                   and not any(n.kind == "nexmark_source"
+                               for n in _fragment_node_kinds(
+                                   graph.fragments[f])))
+        victim = dep.frag_actor_ids[fid][0]
+    else:
+        victim = mv.deployment.frag_actor_ids[mv.mv_fragment][0]
     start_offset = sum(g.offset for g in gens)
     _phase(progress, "measure")
     t0 = time.perf_counter()
@@ -712,6 +739,115 @@ async def bench_q7_kill(progress: dict) -> None:
     b = await s.coord.inject_barrier(mutation=PauseMutation())
     await s.coord.wait_collected(b)
     _phase(progress, "teardown")
+    progress["teardown"] = "skipped by design (isolated subprocess)"
+    progress["clean_exit"] = True
+    progress["pipeline_done"] = True
+    await asyncio.Event().wait()
+
+
+async def _bench_q7_kill_worker(progress: dict) -> None:
+    """q7_kill with victim=worker: the durable q7 MV deployed over a
+    2-worker cluster, one compute-node PROCESS killed mid-measure. The
+    per-worker recovery radius re-places only the dead node's actors
+    (plus their downstream closure) onto the survivor — whose store
+    stays open at the committed manifest — and emits recovery_scope=
+    worker with the recovery_ms SLO for that radius."""
+    import glob
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+    for old in glob.glob(os.path.join(tempfile.gettempdir(),
+                                      "bench_q7kw_*")):
+        shutil.rmtree(old, ignore_errors=True)
+    tmp = tempfile.mkdtemp(prefix="bench_q7kw_")
+    _phase(progress, "setup_ddl")
+    ports = []
+    for _ in range(2):
+        sk = socket.socket()
+        sk.bind(("127.0.0.1", 0))
+        ports.append(sk.getsockname()[1])
+        sk.close()
+    procs = []
+    env = dict(os.environ)
+    for port in ports:
+        p = subprocess.Popen(
+            [sys.executable, "-m", "risingwave_tpu.worker", str(port)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port),
+                                         timeout=1).close()
+                break
+            except OSError:
+                time.sleep(0.2)
+        procs.append(p)
+    s = Session(store=HummockStateStore(
+        LocalFsObjectStore(os.path.join(tmp, "c"))))
+    await s.execute("SET barrier_stall_threshold_ms = 15000")
+    await s.execute(
+        "SET cluster = '" + ",".join(f"127.0.0.1:{p}"
+                                     for p in ports) + "'")
+    for stmt in [
+        f"SET streaming_join_capacity = {1 << 18}",
+        "SET streaming_join_match_factor = 2",
+        f"SET streaming_agg_capacity = {1 << 13}",
+        ("CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+         f"chunk_size=4096, splits=2, inter_event_us=250, "
+         f"emit_watermarks=1, watermark_lag_us={2 * W}, "
+         "rate_limit=65536)"),
+        ("CREATE MATERIALIZED VIEW q7 AS "
+         "SELECT B.auction, B.price, B.bidder, B.date_time "
+         "FROM bid B JOIN ("
+         "  SELECT max(price) AS maxprice, window_end "
+         f"  FROM TUMBLE(bid, date_time, {W}) GROUP BY window_end) B1 "
+         "ON B.price = B1.maxprice "
+         f"AND B.date_time > B1.window_end - {W} "
+         "AND B.date_time <= B1.window_end"),
+    ]:
+        await s.execute(stmt)
+    _phase(progress, "warmup_compile")
+    t_c0 = time.perf_counter()
+    await s.tick(2)
+    progress["compile_s"] = round(time.perf_counter() - t_c0, 1)
+    _phase(progress, "measure")
+    t0 = time.perf_counter()
+    killed = False
+    t_post = None
+    rounds = rounds_at_post = 0
+    while True:
+        await asyncio.sleep(0.05)
+        await s.tick(1, max_recoveries=4)
+        rounds += 1
+        dt = time.perf_counter() - t0
+        progress["seconds"] = dt
+        progress["barrier_p50_s"] = s.coord.barrier_latency_percentile(0.5)
+        if not killed:
+            killed = True
+            procs[1].kill()
+        elif s.last_recovery is not None and t_post is None:
+            t_post = time.perf_counter()
+            rounds_at_post = rounds
+            progress["recovery_ms"] = round(
+                s.last_recovery["duration_s"] * 1e3, 2)
+            progress["recovery_scope"] = s.last_recovery["scope"]
+            progress["rebuilt_actors"] = s.last_recovery["actors"]
+        if dt >= MEASURE_S and (
+                (t_post is not None and rounds >= rounds_at_post + 2)
+                or dt >= 5 * MEASURE_S):
+            break
+    progress["recoveries"] = s.recoveries
+    # rows stay 0 on purpose: this variant's headline is recovery_ms at
+    # scope=worker, not throughput (the sources live in the workers)
+    progress["seconds"] = time.perf_counter() - t0
+    _phase(progress, "teardown")
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
     progress["teardown"] = "skipped by design (isolated subprocess)"
     progress["clean_exit"] = True
     progress["pipeline_done"] = True
@@ -890,9 +1026,23 @@ async def bench_broker_ingest(progress: dict) -> None:
     await _bench_sql(progress, ddl, interval_s=0.2)
 
 
+def _q7_kill_victim(victim: str):
+    """Registered q7_kill variants: same harness, different recovery
+    radius (BENCH_Q7_KILL_VICTIM rides the env into the child)."""
+    async def run(progress: dict) -> None:
+        os.environ["BENCH_Q7_KILL_VICTIM"] = victim
+        try:
+            await bench_q7_kill(progress)
+        finally:
+            os.environ.pop("BENCH_Q7_KILL_VICTIM", None)
+    return run
+
+
 QUERIES = {"q1": bench_q1, "q5": bench_q5, "q7": bench_q7,
            "q8": bench_q8, "q17": bench_q17, "q7d": bench_q7d,
            "q7_kill": bench_q7_kill,
+           "q7_kill_interior": _q7_kill_victim("interior"),
+           "q7_kill_worker": _q7_kill_victim("worker"),
            "q5_8chip": bench_q5_8chip, "q7_8chip": bench_q7_8chip,
            "broker_ingest": bench_broker_ingest}
 NORTH_STAR = ("q7", "q8")

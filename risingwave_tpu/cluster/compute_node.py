@@ -66,11 +66,18 @@ class ComputeNode:
         self.store = None
         self.coord = None
         self.config: dict = {}
-        # deploy_id -> {dep, remote_ins, remote_outs}
+        # deploy_id -> {dep, remote_ins, remote_outs, info}
         self.deployments: dict[int, dict] = {}
         self._pending: dict[int, dict] = {}
         self.monitor = None
         self._monitor_port = 0
+        # recent injected barriers keyed by epoch.prev — the base for a
+        # partial rebuild's synthetic INITIAL (the barrier with
+        # epoch.prev == committed sealed the committed epoch)
+        self._barriers_by_prev: dict[int, object] = {}
+        # sealed reports pushed so far (the worker_crash_partial fault
+        # point counts these)
+        self._sealed_reports = 0
 
     # --------------------------------------------------------- RPC surface
     async def handle(self, method: str, args: dict):
@@ -82,13 +89,18 @@ class ComputeNode:
     async def rpc_ping(self):
         return {"worker_id": self.worker_id,
                 "actors": sum(len(d["dep"].actors)
-                              for d in self.deployments.values())}
+                              for d in self.deployments.values()),
+                # store identity: a partial recovery must NOT reopen a
+                # survivor's store (tests assert this stays stable)
+                "store_id": id(self.store)}
 
     async def rpc_hello(self, worker_id: int, store: dict,
                         sst_id_base: int, config: dict,
                         monitor_port: int = 0):
         import jax
         self.worker_id = worker_id
+        from ..stream import remote_exchange
+        remote_exchange.WORKER_ID = worker_id   # dcn_drop worker= filter
         self._open_store(store, sst_id_base)
         # the CLI's --monitor-port wins over meta's (operator-pinned)
         monitor_port = self.config.pop("__monitor_port", 0) or monitor_port
@@ -131,6 +143,15 @@ class ComputeNode:
         if "checkpoint_max_inflight" in cfg:
             self.coord.checkpoint_max_inflight = \
                 cfg["checkpoint_max_inflight"]
+        if "fault_injection" in cfg:
+            # cluster fault points fire in THIS process (dcn_drop in
+            # the DCN send path, worker_crash_partial below); meta
+            # forwards the SET spec with the config push
+            from ..utils.faults import FAULTS
+            try:
+                FAULTS.arm(cfg["fault_injection"])
+            except ValueError:
+                pass            # meta already validated at SET time
 
     async def rpc_set_config(self, config: dict):
         self.config.update(config)
@@ -141,6 +162,17 @@ class ComputeNode:
         """Local seal+upload+L0-install finished for `epoch`: report the
         SSTs so meta can commit once every worker reported (runs on the
         loop from the coordinator's uploader)."""
+        from ..utils.faults import FAULTS
+        self._sealed_reports += 1
+        if FAULTS.active and FAULTS.hit(
+                "worker_crash_partial", worker=self.worker_id,
+                seals=self._sealed_reports) is not None:
+            # deterministic worker death at the k-th sealed report
+            # (SET fault_injection='worker_crash_partial:worker=W,at=k'
+            # on the meta session; the spec rides the config push —
+            # EVERY node arms it, so the worker= filter picks the one
+            # victim) — a hard exit, exactly a kill -9 mid-epoch
+            os._exit(43)
         asyncio.get_running_loop().create_task(
             self.conn.push("sealed", worker_id=self.worker_id,
                            epoch=epoch, sst_ids=list(sst_ids)))
@@ -179,17 +211,19 @@ class ComputeNode:
                                   cluster_remote_edges)
         from ..stream.remote_exchange import RemoteOutput
         p = self._pending.pop(deploy_id)
+        replay = bool(p["ddl_config"].get("partial_recovery", 1))
         remote_outs: dict = {}
         for edge_key, uw, _dw in cluster_remote_edges(p["graph"],
                                                       p["placement"]):
             if uw != self.worker_id:
                 continue
             host, port = ports[edge_key]
-            remote_outs[edge_key] = await RemoteOutput(host,
-                                                       port).connect()
+            remote_outs[edge_key] = await RemoteOutput(
+                host, port, replay=replay).connect()
         env = BuildEnv(self.store, self.coord,
                        chunk_coalesce_max=p["ddl_config"].get(
-                           "streaming_chunk_coalesce", 0))
+                           "streaming_chunk_coalesce", 0),
+                       partial_recovery=replay)
         env.memory_scope = p["scope"]
         dep = build_partial_graph(
             p["graph"], env, p["placement"], self.worker_id,
@@ -197,8 +231,14 @@ class ComputeNode:
             remote_outs)
         env.memory_scope = None
         dep.spawn()
+        # everything a per-worker partial rebuild needs rides with the
+        # deployment record (graph/ids/schemas + the live edge objects)
         self.deployments[deploy_id] = dict(
-            dep=dep, remote_ins=p["remote_ins"], remote_outs=remote_outs)
+            dep=dep, remote_ins=p["remote_ins"], remote_outs=remote_outs,
+            info=dict(graph=p["graph"], placement=p["placement"],
+                      actors=p["actors"], tables=p["tables"],
+                      schemas=p["schemas"], scope=p["scope"],
+                      ddl_config=p["ddl_config"]))
         return {"actors": sorted(a.actor_id for a in dep.actors)}
 
     # ------------------------------------------------------------ barriers
@@ -206,6 +246,27 @@ class ComputeNode:
         """Meta's per-worker barrier injection (push): fan into local
         source queues NOW (ordering with the next inject rides the
         connection's frame order), collect + report in the background."""
+        # remember recent barriers by the epoch they seal: a partial
+        # rebuild synthesizes its INITIAL from the committed one
+        self._barriers_by_prev[barrier.epoch.prev] = barrier
+        while len(self._barriers_by_prev) > 64:
+            del self._barriers_by_prev[min(self._barriers_by_prev)]
+        # dead-actor sweep BEFORE injecting: a failure whose report was
+        # lost (e.g. it raced a concurrent partial recovery, whose
+        # quiesce cleared this node's local marker) would otherwise
+        # hang every future epoch silently — the actor's task is done,
+        # nobody re-reports, meta waits forever. Self-heal by
+        # re-reporting instead of injecting into a broken topology.
+        dead = sorted(
+            a.actor_id
+            for d in self.deployments.values()
+            for a, t in zip(d["dep"].actors, d["dep"].tasks)
+            if t.done() and a.actor_id in self.coord.actor_ids)
+        if dead:
+            await self.conn.push(
+                "failed", worker_id=self.worker_id,
+                error=f"actors {dead} dead at inject", actors=dead)
+            return
         b = await self.coord.inject_remote(barrier)
         asyncio.get_running_loop().create_task(self._collect_one(b))
 
@@ -218,10 +279,318 @@ class ComputeNode:
             pass                      # meta gone; process will be reset
         except Exception as e:  # noqa: BLE001 — local actor death
             try:
-                await self.conn.push("failed", worker_id=self.worker_id,
-                                     error=f"{type(e).__name__}: {e}")
+                # the failed actor ids let meta scope the radius to
+                # their downstream closure (worker-partial recovery)
+                # instead of resetting the whole cluster
+                await self.conn.push(
+                    "failed", worker_id=self.worker_id,
+                    error=f"{type(e).__name__}: {e}",
+                    actors=sorted(a for a in self.coord.failed_actors
+                                  if a > 0))
             except ConnectionResetError:
                 pass
+
+    async def rpc_committed(self, epoch: int):
+        """Meta's cluster commit covered `epoch`: drop the retained
+        sealed batches (state/hummock.py) and trim every replay buffer
+        — local channels, DCN output legs, mesh ingest logs — to the
+        uncommitted suffix."""
+        if self.store is not None:
+            confirm = getattr(self.store, "confirm_committed", None)
+            if confirm is not None:
+                confirm(epoch)
+        if self.coord is not None:
+            self.coord._trim_replay_buffers(epoch)
+        for d in self.deployments.values():
+            for out in d["remote_outs"].values():
+                if out.replay_enabled:
+                    out.trim_replay(epoch)
+        return {}
+
+    # ---------------------------------------- per-worker partial recovery
+    async def _teardown_actors(self, d: dict, actor_ids: list) -> None:
+        """Cancel + deregister a subset of one deployment's actors (the
+        closure members this worker hosted) without touching anything
+        else — the surviving actors, their channels, and the store stay
+        live."""
+        dep = d["dep"]
+        ids = set(actor_ids)
+        for i, a in enumerate(dep.actors):
+            if a.actor_id not in ids:
+                continue
+            t = dep.tasks[i] if i < len(dep.tasks) else None
+            if t is not None and not t.done():
+                t.cancel()
+            if t is not None:
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+        kept = [(a, t) for a, t in zip(dep.actors, dep.tasks)
+                if a.actor_id not in ids]
+        dep.actors = [a for a, _ in kept]
+        dep.tasks = [t for _, t in kept]
+        for aid in sorted(ids):
+            self.coord.actor_ids.discard(aid)
+            self.coord.stats.unregister(aid)
+            for name in dep.actor_memory_names.pop(aid, []):
+                self.coord.memory.unregister(name)
+                if name in dep.memory_names:
+                    dep.memory_names.remove(name)
+            for q in dep.actor_source_queues.pop(aid, []):
+                if q in self.coord.source_queues:
+                    self.coord.source_queues.remove(q)
+                if q in dep.source_queues:
+                    dep.source_queues.remove(q)
+            root = dep.actor_root.pop(aid, None)
+            fid = dep.actor_fragment.pop(aid, None)
+            if fid is not None:
+                if aid in dep.frag_actor_ids.get(fid, ()):
+                    dep.frag_actor_ids[fid].remove(aid)
+                if root is not None and root in dep.roots.get(fid, ()):
+                    dep.roots[fid].remove(root)
+            if aid in dep.mesh_actor_ids:
+                self.coord.unregister_mesh_fragment(aid)
+                dep.mesh_actor_ids.remove(aid)
+            for obj in (dep.frag_ingest_logs.pop(fid, [])
+                        if fid is not None else []):
+                self.coord.unregister_replay_channels([obj])
+                dep.replay_channels = [c for c in dep.replay_channels
+                                       if c is not obj]
+
+    def _drop_local_channel(self, d: dict, edge) -> None:
+        """Remove a (now intra-closure) local channel so a fresh one
+        replaces it — queued leftovers belong to dead incarnations."""
+        fid, d_fid, k, u, di = edge
+        mat = d["dep"].rebuild_info["channels"].get((fid, d_fid, k), {})
+        ch = mat.pop((u, di), None)
+        if ch is not None:
+            self.coord.unregister_replay_channels([ch])
+            d["dep"].replay_channels = [
+                c for c in d["dep"].replay_channels if c is not ch]
+
+    async def rpc_partial_prepare(self, dead_worker, plans: dict,
+                                  committed_epoch: int,
+                                  stale_ceiling=None):
+        """Phase 1 of the per-worker partial recovery: quiesce this
+        worker's closure actors, RESTAGE the sealed-but-unconfirmed
+        batches (epochs the dead worker kept from committing), discard
+        the closure's staged writes, tear down the legs being replaced,
+        and open fresh RemoteInput servers for edges whose consumer
+        lands here. The store handle stays OPEN at the committed
+        manifest — no reopen, no manifest reload."""
+        from ..stream.remote_exchange import RemoteInput
+        # finished local seals land in the unconfirmed retention first
+        await self.coord.drain_uploads()
+        restage = getattr(self.store, "restage_unconfirmed", None)
+        if restage is not None:
+            restage()
+        # keep the store OPEN but re-point it at the CURRENT committed
+        # manifest: re-placed actors recover the dead worker's vnode
+        # ranges through this handle, and the deploy-time manifest
+        # snapshot predates everything the cluster committed since
+        refresh = getattr(self.store, "refresh_manifest", None)
+        if refresh is not None:
+            refresh()
+        out_ports: dict = {}
+        for did, dplan in plans.items():
+            d = self.deployments.get(did)
+            if d is None:
+                raise RuntimeError(
+                    f"partial recovery: unknown deployment {did}")
+            info = d["info"]
+            old_placement = info["placement"]
+            actors = info["actors"]
+            closure = {(fid, idx)
+                       for fid, idxs in dplan["closure"].items()
+                       for idx in idxs}
+            mine_old = [(fid, idx) for (fid, idx) in closure
+                        if old_placement[fid][idx] == self.worker_id]
+            await self._teardown_actors(
+                d, [actors[fid][idx] for fid, idx in mine_old])
+            # drop exactly the closure's staged uncommitted writes on
+            # this worker (vnode-disjoint: the discard never touches a
+            # surviving actor's rows — the planner refused mixed
+            # fragments)
+            discard_tables = set()
+            for fid, _idx in mine_old:
+                discard_tables.update(info["tables"][fid].values())
+            if discard_tables:
+                self.store.discard_staged_tables(discard_tables)
+            # edge legs being replaced/reused
+            for e in dplan["edges"]:
+                fid, d_fid, k, u, di = edge = tuple(e["key"])
+                kind = e["kind"]
+                wc_new = dplan["new_placement"][d_fid][di]
+                if kind == "frontier_rewind":
+                    if wc_new == self.worker_id:
+                        d["remote_ins"][edge].expect_rewind(
+                            stale_ceiling=stale_ceiling)
+                    continue
+                if kind == "frontier_local":
+                    continue            # reused; armed in phase 2
+                # intra_* and frontier_reconnect: fresh resources — the
+                # old leg objects (if this worker held either end) die
+                old_rx = d["remote_ins"].pop(edge, None)
+                if old_rx is not None:
+                    await old_rx.stop()
+                self._drop_local_channel(d, edge)
+                if kind != "intra_local" and wc_new == self.worker_id:
+                    rx = await RemoteInput(info["schemas"][fid],
+                                           host="0.0.0.0",
+                                           queue_depth=8).start()
+                    rx.stale_ceiling = stale_ceiling
+                    d.setdefault("fresh_ins", {})[edge] = rx
+                    out_ports[(did,) + edge] = rx.port
+            # close output legs whose producer was a closure actor here
+            for ek, out in list(d["remote_outs"].items()):
+                fid2, _dfid2, _k2, u2, _di2 = ek
+                if (fid2, u2) in closure \
+                        and old_placement[fid2][u2] == self.worker_id:
+                    await out.close()
+                    d["remote_outs"].pop(ek)
+        self.coord.clear_failure()
+        return out_ports
+
+    async def rpc_partial_start(self, plans: dict, ports: dict,
+                                committed_epoch: int,
+                                stale_ceiling=None):
+        """Phase 2: rebuild the closure actors assigned here (same
+        global ids/tables), wire fresh legs, arm frontier replay, spawn,
+        then rewind surviving output legs into the rebuilt consumers."""
+        from ..plan.build import BuildEnv, build_closure_actors
+        from ..stream.exchange import Channel
+        from ..stream.message import Barrier, BarrierKind
+        from ..stream.remote_exchange import RemoteOutput
+        base = self._barriers_by_prev.get(committed_epoch)
+        if base is None:
+            raise RuntimeError(
+                f"partial recovery: no barrier on record sealing "
+                f"committed epoch {committed_epoch}")
+        init_barrier = Barrier(base.epoch, BarrierKind.INITIAL, None, (),
+                               base.inject_time_ns)
+        rewinds = []
+        spawned: list[int] = []
+        for did, dplan in plans.items():
+            d = self.deployments.get(did)
+            if d is None:
+                raise RuntimeError(
+                    f"partial recovery: unknown deployment {did}")
+            info = d["info"]
+            graph = info["graph"]
+            new_placement = dplan["new_placement"]
+            replay = bool(info["ddl_config"].get("partial_recovery", 1))
+            kinds = {tuple(e["key"]): e["kind"] for e in dplan["edges"]}
+            dep = d["dep"]
+            channels = dep.rebuild_info["channels"]
+            # fresh intra-closure legs (producer side pre-connects)
+            fresh_local: dict = {}
+            for edge, kind in kinds.items():
+                fid, d_fid, k, u, di = edge
+                if kind == "intra_local" \
+                        and new_placement[fid][u] == self.worker_id:
+                    ch = Channel(64)
+                    if replay:
+                        ch.enable_replay()
+                        dep.replay_channels.append(ch)
+                        self.coord.register_replay_channels([ch])
+                    fresh_local[edge] = ch
+                    channels.setdefault((fid, d_fid, k), {})[(u, di)] = ch
+                elif kind == "intra_remote" \
+                        and new_placement[fid][u] == self.worker_id:
+                    host, port = ports[(did,) + edge]
+                    out = await RemoteOutput(host, port,
+                                             replay=replay).connect()
+                    d["remote_outs"][edge] = out
+            d["remote_ins"].update(d.pop("fresh_ins", {}))
+
+            def in_leg(up_fid, fid2, k, u, di, _d=d, _kinds=kinds,
+                       _fresh=fresh_local, _chans=channels):
+                edge = (up_fid, fid2, k, u, di)
+                kind = _kinds.get(edge)
+                if kind == "intra_local":
+                    return _fresh[edge]
+                if kind == "frontier_local":
+                    return _chans[(up_fid, fid2, k)][(u, di)]
+                return _d["remote_ins"][edge]
+
+            def out_leg(fid2, d_fid, k, u, di, _d=d, _kinds=kinds,
+                        _fresh=fresh_local):
+                edge = (fid2, d_fid, k, u, di)
+                if _kinds.get(edge) == "intra_local":
+                    return _fresh[edge]
+                return _d["remote_outs"][edge]
+
+            env = BuildEnv(self.store, self.coord,
+                           chunk_coalesce_max=info["ddl_config"].get(
+                               "streaming_chunk_coalesce", 0),
+                           partial_recovery=replay)
+            env.memory_scope = info["scope"]
+            new_actors = build_closure_actors(
+                graph, env, dep, new_placement, self.worker_id,
+                info["actors"], info["tables"], info["schemas"],
+                dplan["closure"], in_leg, out_leg)
+            env.memory_scope = None
+            # arm frontier replay on reused local channels feeding
+            # rebuilt consumers here
+            for edge, kind in kinds.items():
+                fid, d_fid, k, u, di = edge
+                if kind == "frontier_local" \
+                        and new_placement[d_fid][di] == self.worker_id:
+                    channels[(fid, d_fid, k)][(u, di)].begin_replay(
+                        stale_ceiling=stale_ceiling)
+            # rebuilt SOURCE actors have no inbound frontier: preload
+            # the synthetic INITIAL (committed base) so they re-seek
+            # committed offsets and propagate it down the intra legs
+            for a in new_actors:
+                for q in dep.actor_source_queues.get(a.actor_id, []):
+                    q.put_nowait(init_barrier)
+            # install + spawn (replace old slots, append re-placed ones)
+            by_id = {a.actor_id: i for i, a in enumerate(dep.actors)}
+            for a in new_actors:
+                i = by_id.get(a.actor_id)
+                if i is None:
+                    dep.actors.append(a)
+                    dep.tasks.append(a.spawn())
+                else:
+                    dep.actors[i] = a
+                    dep.tasks[i] = a.spawn()
+                spawned.append(a.actor_id)
+            # surviving producers on this worker hold legs into REBUILT
+            # consumers — queue the rewinds for phase 3: a rewind can
+            # only stream once EVERY worker's consumers are live (a
+            # suffix longer than the credit window would otherwise
+            # deadlock two workers rewinding into each other's
+            # not-yet-spawned actors)
+            for edge, kind in kinds.items():
+                fid, d_fid, k, u, di = edge
+                if kind not in ("frontier_rewind", "frontier_reconnect"):
+                    continue
+                if new_placement[fid][u] != self.worker_id:
+                    continue
+                out = d["remote_outs"][edge]
+                if kind == "frontier_reconnect":
+                    host, port = ports[(did,) + edge]
+                    rewinds.append((out, host, port))
+                else:
+                    rewinds.append((out, None, None))
+            # new placement is authoritative for later recoveries
+            info["placement"] = new_placement
+        self._pending_rewinds = rewinds
+        return {"spawned": sorted(spawned)}
+
+    async def rpc_partial_rewind(self):
+        """Phase 3: stream the uncommitted suffix from every surviving
+        producer leg into its rebuilt consumer (all workers' actors are
+        live by now; live sends on a rewinding leg park until the
+        suffix is through, so the consumer sees committed-base INITIAL,
+        suffix, live — in order)."""
+        rewinds, self._pending_rewinds = \
+            getattr(self, "_pending_rewinds", []), []
+        replayed = 0
+        for out, host, port in rewinds:
+            replayed += await out.rewind_replay(host, port)
+        return {"replayed": replayed}
 
     # ------------------------------------------------------------ teardown
     async def rpc_stop_deployment(self, deploy_id: int):

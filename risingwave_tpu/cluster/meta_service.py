@@ -97,6 +97,12 @@ class WorkerHandle:
     async def inject(self, barrier) -> None:
         await self.conn.push("inject", barrier=barrier)
 
+    async def notify_committed(self, epoch: int) -> None:
+        """Meta committed `epoch` cluster-wide: the worker drops its
+        retained sealed batches and trims its replay buffers (local
+        channels + DCN legs) to the uncommitted suffix."""
+        await self.conn.push("committed", epoch=epoch)
+
     # ------------------------------------------------------ sealed reports
     def on_sealed(self, epoch: int, sst_ids: list) -> None:
         cur = self._sealed.get(epoch)
@@ -146,7 +152,8 @@ class ClusterDeployment:
 
     def __init__(self, manager: "ClusterManager", deploy_id: int,
                  coord, all_actor_ids: frozenset,
-                 roots: Optional[dict] = None):
+                 roots: Optional[dict] = None,
+                 rebuild_info: Optional[dict] = None):
         self.manager = manager
         self.deploy_id = deploy_id
         self.coord = coord
@@ -156,6 +163,17 @@ class ClusterDeployment:
         self.tasks: list = []
         self.source_queues: list = []
         self.memory_names: list = []
+        # everything per-worker partial recovery needs to re-place the
+        # dead worker's actors: {"graph","placement","actors","tables",
+        # "schemas","scope","ddl_config"} (plan/build.assign_graph_ids
+        # derived the same ids on every process)
+        self.rebuild_info = rebuild_info
+        # actor id -> fragment id, for failure classification
+        self.actor_fragment = {}
+        if rebuild_info is not None:
+            for fid, ids in rebuild_info["actors"].items():
+                for aid in ids:
+                    self.actor_fragment[aid] = fid
 
     def spawn(self) -> "ClusterDeployment":
         return self
@@ -167,6 +185,7 @@ class ClusterDeployment:
         try:
             await self.coord.stop_all(self.all_actor_ids)
         finally:
+            self.manager.deployments.pop(self.deploy_id, None)
             for h in self.manager.live_workers():
                 try:
                     await h.call("stop_deployment", timeout=30,
@@ -205,6 +224,9 @@ class ClusterManager:
         self.generation = 0
         self._next_deploy = 1
         self._hb_task: Optional[asyncio.Task] = None
+        # live ClusterDeployments by deploy id (partial recovery walks
+        # them to compute the rebuild closure)
+        self.deployments: dict[int, ClusterDeployment] = {}
 
     # ------------------------------------------------------------ registry
     def live_workers(self) -> list[WorkerHandle]:
@@ -267,6 +289,12 @@ class ClusterManager:
                                                2),
             "streaming_chunk_coalesce": cfg.get(
                 "streaming_chunk_coalesce", 0),
+            # chaos harness: cluster fault points (dcn_drop,
+            # worker_crash_partial) live in WORKER processes — arming
+            # rides the config push so `SET fault_injection` on the
+            # meta session reaches every node's process-global injector
+            "fault_injection": cfg.get("fault_injection", ""),
+            "partial_recovery": cfg.get("partial_recovery", 1),
         }
 
     def _register_with_coord(self) -> None:
@@ -317,12 +345,19 @@ class ClusterManager:
             # reset + re-placed onto; only connection loss / lease expiry
             # marks the handle itself dead. Stale reports racing an
             # in-progress rebuild are dropped (their actors are already
-            # being torn down).
+            # being torn down). The report carries the worker's failed
+            # actor IDS (globally unique) so the classifier can scope
+            # the radius to their downstream closure instead of the
+            # whole cluster.
             if not getattr(self.session, "_recovering", False):
-                self.session.coord.worker_failed(
-                    handle.worker_id,
-                    RuntimeError(args.get("error",
-                                          "worker actor failure")))
+                err = RuntimeError(args.get("error",
+                                            "worker actor failure"))
+                actors = args.get("actors") or []
+                for aid in actors:
+                    self.session.coord.actor_failed(aid, err)
+                if not actors:
+                    self.session.coord.worker_failed(
+                        handle.worker_id, err)
 
     # -------------------------------------------------------------- deploy
     def _check_supported(self, graph) -> None:
@@ -429,6 +464,8 @@ class ClusterManager:
         ddl_config = {k: session.config[k]
                       for k in ("streaming_chunk_coalesce",)
                       if k in session.config}
+        ddl_config["partial_recovery"] = bool(
+            session.config.get("partial_recovery", 1))
         live = self.live_workers()
         ports: dict = {}
         for h in live:
@@ -460,8 +497,199 @@ class ClusterManager:
             table = StateTable(session.store, table_id=tid, schema=sch,
                                pk_indices=tuple(mat.args["pk_indices"]))
             roots[mv_fragment] = [_ShadowRoot(table, sch)]
-        return ClusterDeployment(self, deploy_id, session.coord,
-                                 all_ids, roots)
+        from ..plan.build import infer_fragment_schemas as _schemas
+        dep = ClusterDeployment(
+            self, deploy_id, session.coord, all_ids, roots,
+            rebuild_info={"graph": graph, "placement": placement,
+                          "actors": actors, "tables": tables,
+                          "schemas": _schemas(graph), "scope": scope,
+                          "ddl_config": ddl_config})
+        self.deployments[deploy_id] = dep
+        return dep
+
+    # ------------------------------------------ per-worker partial recovery
+    @staticmethod
+    def _actor_pairs(graph, fid, d_fid):
+        up, d = graph.fragments[fid], graph.fragments[d_fid]
+        for u in range(up.parallelism):
+            for di in range(d.parallelism):
+                if up.dispatch == "simple" and up.parallelism > 1 \
+                        and u != di:
+                    continue          # NoShuffle pairs 1:1
+                yield u, di
+
+    def plan_partial(self, dead_wid, failed_actor_ids):
+        """Worker-radius feasibility + closure computation. The rebuild
+        set per deployment is {the dead worker's actors (re-placed onto
+        survivors, minimal movement, original parallelism) plus the
+        reported failed actors} closed over downstream consumption —
+        every consumer of a dead producer holds a partial prefix of the
+        aborted interval and rebuilds with it. Survivors' actors
+        outside the closure keep running; their stores stay open at the
+        committed manifest. Returns the plan shipped to the workers, or
+        None when the radius cannot be proven contained (-> full)."""
+        live = self.live_workers()
+        if not live:
+            return None
+        committed = self.session.store.committed_epoch()
+        if committed <= 0:
+            return None       # no committed base barrier to rebuild from
+        failed = set(failed_actor_ids or ())
+        rr = 0
+        per_dep: dict = {}
+        rebuilt_ids: list[int] = []
+        for did, dep in self.deployments.items():
+            info = dep.rebuild_info
+            if info is None:
+                return None
+            graph, placement = info["graph"], info["placement"]
+            actors = info["actors"]
+            seed = set()
+            for fid, ws in placement.items():
+                for idx, w in enumerate(ws):
+                    if (dead_wid is not None and w == dead_wid) \
+                            or actors[fid][idx] in failed:
+                        seed.add((fid, idx))
+            if not seed:
+                continue
+            edges = graph.edges()
+            closure = set(seed)
+            changed = True
+            while changed:
+                changed = False
+                for (fid, d_fid, _k) in edges:
+                    for u, di in self._actor_pairs(graph, fid, d_fid):
+                        if (fid, u) in closure \
+                                and (d_fid, di) not in closure:
+                            closure.add((d_fid, di))
+                            changed = True
+            # feasibility: a fragment must not mix closure and
+            # non-closure actors on ONE worker — the staged-write
+            # discard is per (worker, table), and mixed ownership would
+            # drop a surviving actor's uncommitted rows with the dead
+            # one's
+            for fid, ws in placement.items():
+                by_w: dict = {}
+                for idx, w in enumerate(ws):
+                    by_w.setdefault(w, []).append((fid, idx) in closure)
+                for flags in by_w.values():
+                    if any(flags) and not all(flags):
+                        return None
+            # new placement: ONLY the dead worker's slots move
+            live_ids = sorted(h.worker_id for h in live)
+            new_placement: dict = {}
+            for fid, ws in placement.items():
+                row = list(ws)
+                for idx, w in enumerate(ws):
+                    if dead_wid is not None and w == dead_wid:
+                        row[idx] = live_ids[rr % len(live_ids)]
+                        rr += 1
+                new_placement[fid] = row
+            # edge dispositions for the rebuild (cluster/compute_node.py
+            # routes each leg by kind):
+            #   frontier_local     surviving producer, same worker,
+            #                      consumer in place -> begin_replay
+            #   frontier_rewind    surviving producer, consumer rebuilt
+            #                      in place behind its server -> in-band
+            #                      'R' rewind over the (re)connected leg
+            #   frontier_reconnect surviving producer, consumer
+            #                      re-placed -> fresh server + rewind
+            #   intra_local        both rebuilt, co-located -> fresh
+            #                      channel
+            #   intra_remote       both rebuilt, split -> fresh pair
+            edge_plan = []
+            for (fid, d_fid, k) in edges:
+                for u, di in self._actor_pairs(graph, fid, d_fid):
+                    if (d_fid, di) not in closure:
+                        continue
+                    p_in = (fid, u) in closure
+                    wp_new = new_placement[fid][u]
+                    wc_new = new_placement[d_fid][di]
+                    wc_old = placement[d_fid][di]
+                    if p_in:
+                        kind = ("intra_local" if wp_new == wc_new
+                                else "intra_remote")
+                    elif wc_old == wc_new:
+                        kind = ("frontier_local" if wp_new == wc_new
+                                else "frontier_rewind")
+                    else:
+                        kind = "frontier_reconnect"
+                    edge_plan.append({"key": (fid, d_fid, k, u, di),
+                                      "kind": kind})
+            closure_map: dict = {}
+            for fid, idx in sorted(closure):
+                closure_map.setdefault(fid, []).append(idx)
+            per_dep[did] = {"closure": closure_map,
+                            "new_placement": new_placement,
+                            "edges": edge_plan}
+            for fid, idxs in closure_map.items():
+                rebuilt_ids.extend(actors[fid][i] for i in idxs)
+        if not per_dep:
+            return None           # nothing maps — a stale report
+        return {"dead_worker": dead_wid, "deployments": per_dep,
+                "committed_epoch": committed,
+                # every epoch injected so far that is not committed is
+                # DEAD (never re-injected); rebuilt consumer legs filter
+                # its barriers so merges with live-joining rebuilt
+                # sources stay aligned
+                "stale_ceiling": self.session.coord._prev_epoch,
+                "rebuilt_actors": sorted(rebuilt_ids)}
+
+    async def partial_recover(self, plan) -> list[int]:
+        """Execute the worker radius: prune the dead worker, two-phase
+        partial rebuild on the survivors (quiesce/restage/fresh servers,
+        then build/reconnect/rewind/spawn), resume the epoch stream on
+        the SAME coordinator. Any exception propagates — the session
+        falls back to the full cluster rebuild."""
+        session = self.session
+        coord = session.coord
+        # 1. abort the in-flight commit queue: an epoch the dead worker
+        # never sealed can never commit; survivors RESTAGE their share
+        # (state/hummock.py restage_unconfirmed) so nothing durable is
+        # lost, and the parked wait_sealed error is subsumed
+        await coord.abort_uploads()
+        coord.clear_upload_failure()
+        dead_wid = plan["dead_worker"]
+        if dead_wid is not None:
+            h = self.workers.pop(dead_wid, None)
+            coord.remove_worker(dead_wid)
+            if h is not None:
+                await h.close()
+        live = self.live_workers()
+        if not live:
+            raise RuntimeError("cluster: no live workers")
+        # 2. phase 1: every survivor quiesces its closure actors,
+        # restages unconfirmed seals, discards the closure's staged
+        # writes, and opens fresh inbound servers for re-placed legs
+        ports: dict = {}
+        for h in live:
+            r = await h.call("partial_prepare", timeout=120,
+                             dead_worker=dead_wid,
+                             plans=plan["deployments"],
+                             committed_epoch=plan["committed_epoch"],
+                             stale_ceiling=plan["stale_ceiling"])
+            for ek, port in r.items():
+                ports[ek] = (h.host, port)
+        # 3. phase 2: rebuild the closure actors (same global ids),
+        # connect fresh legs, rewind surviving producers into the
+        # rebuilt consumers, spawn
+        for h in live:
+            await h.call("partial_start", timeout=300,
+                         plans=plan["deployments"], ports=ports,
+                         committed_epoch=plan["committed_epoch"],
+                         stale_ceiling=plan["stale_ceiling"])
+        # 4. phase 3: with EVERY worker's rebuilt consumers live, the
+        # surviving producer legs stream their uncommitted suffix (a
+        # rewind before all spawns could deadlock on the credit window)
+        for h in live:
+            await h.call("partial_rewind", timeout=300)
+        # the new placement is authoritative for any LATER recovery
+        for did, dplan in plan["deployments"].items():
+            dep = self.deployments.get(did)
+            if dep is not None and dep.rebuild_info is not None:
+                dep.rebuild_info["placement"] = dplan["new_placement"]
+        coord.clear_failure()
+        return plan["rebuilt_actors"]
 
     # ------------------------------------------------------------ recovery
     async def reset_all(self) -> None:

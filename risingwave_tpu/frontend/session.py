@@ -213,6 +213,14 @@ class Session:
         # 0 disables the backoff. recovery_backoff_seconds_total counts
         # the waited seconds.
         "recovery_backoff_ms": (50, int),
+        # flap detection: more than this many recoveries of the SAME
+        # cause within the trailing window (utils/metrics.py
+        # RECOVERY_FLAP_WINDOW_S) marks that cause FLAPPING — the
+        # backoff base escalates toward the 5s cap even on the first
+        # attempt of a tick (a fault that keeps coming back must stop
+        # hammering rebuilds), `recovery_flapping{cause}` flips to 1 in
+        # /metrics, and /healthz reports `degraded`. 0 disables.
+        "recovery_flap_threshold": (3, int),
         # deterministic fault injection (utils/faults.py): named fault
         # points armed by spec, e.g.
         #   SET fault_injection = 'actor_crash:actor=4,at=2'
@@ -262,6 +270,10 @@ class Session:
         # most recent auto-recovery: {"scope","cause","duration_s",
         # "actors"} — surfaced by /healthz (meta/monitor_service.py)
         self.last_recovery = None
+        # (monotonic time, cause) of recent recoveries — the flap
+        # detector's window (recovery_flap_threshold)
+        from collections import deque as _deque
+        self._recovery_log = _deque(maxlen=256)
         self.env.partial_recovery = bool(self.config["partial_recovery"])
         # monitor HTTP endpoint (SET monitor_port / start_monitor)
         self.monitor = None
@@ -551,12 +563,19 @@ class Session:
                 # (or not) the replay buffers; classification also
                 # re-checks it at failure time
                 self.env.partial_recovery = bool(self.config[stmt.name])
+                if self.cluster is not None:
+                    await self.cluster.push_config()
             elif stmt.name == "fault_injection":
                 from ..utils.faults import FAULTS
                 try:
                     FAULTS.arm(self.config[stmt.name])
                 except ValueError as e:
                     raise BindError(str(e))
+                if self.cluster is not None:
+                    # cluster fault points (dcn_drop, worker_crash_
+                    # partial) fire inside WORKER processes — forward
+                    # the spec so their process-global injectors arm too
+                    await self.cluster.push_config()
             elif stmt.name == "cluster":
                 await self._configure_cluster(self.config[stmt.name])
             elif stmt.name == "monitor_port":
@@ -1280,83 +1299,198 @@ class Session:
                         # replays it from scratch
                         continue
 
+    def flapping_causes(self) -> list[str]:
+        """Causes whose recovery rate exceeds `recovery_flap_threshold`
+        within the trailing flap window — non-empty means the session is
+        DEGRADED (recoveries keep converging but the fault keeps coming
+        back; /healthz surfaces it, the backoff escalates on it)."""
+        import time as _time
+        from ..utils.metrics import RECOVERY_FLAP_WINDOW_S
+        thr = self.config.get("recovery_flap_threshold", 3)
+        if thr <= 0 or not self._recovery_log:
+            return []
+        now = _time.monotonic()
+        counts: dict[str, int] = {}
+        for t, cause in self._recovery_log:
+            if now - t <= RECOVERY_FLAP_WINDOW_S:
+                counts[cause] = counts.get(cause, 0) + 1
+        return sorted(c for c, n in counts.items() if n > thr)
+
+    def _flap_excess(self) -> int:
+        """How far past the flap threshold the worst cause is — feeds
+        the backoff exponent so a flapping fault escalates toward the
+        5s cap instead of hammering immediate rebuilds."""
+        import time as _time
+        from ..utils.metrics import RECOVERY_FLAP_WINDOW_S
+        thr = self.config.get("recovery_flap_threshold", 3)
+        if thr <= 0 or not self._recovery_log:
+            return 0
+        now = _time.monotonic()
+        counts: dict[str, int] = {}
+        for t, cause in self._recovery_log:
+            if now - t <= RECOVERY_FLAP_WINDOW_S:
+                counts[cause] = counts.get(cause, 0) + 1
+        return max((n - thr for n in counts.values()), default=0)
+
     async def _recovery_backoff(self, attempt: int) -> None:
         """Exponential backoff with +-50% jitter between consecutive
         recovery attempts; the FIRST recovery of a tick is immediate
-        (fast path for the common one-shot fault)."""
+        (fast path for the common one-shot fault) UNLESS the flap
+        detector says this fault keeps coming back — then even the
+        first attempt waits, with the excess recovery rate feeding the
+        exponent (recovery_total{cause} rates -> backoff base)."""
         base = self.config.get("recovery_backoff_ms", 50) / 1000.0
-        if attempt < 2 or base <= 0:
+        effective = attempt + self._flap_excess()
+        if effective < 2 or base <= 0:
             return
         import random
         from ..utils.metrics import RECOVERY_BACKOFF
-        delay = min(base * (2 ** (attempt - 2)), 5.0) \
+        delay = min(base * (2 ** (effective - 2)), 5.0) \
             * (0.5 + random.random())
         RECOVERY_BACKOFF.inc(delay)
         await asyncio.sleep(delay)
 
     # ------------------------------------------------------------ recovery
+    @staticmethod
+    def _terminal_fid(flow):
+        return (flow.mv_fragment if isinstance(flow, MvDef)
+                else flow.sink_fragment)
+
     def _classify_failure(self):
         """Blast-radius classification (reference: the recovery scope
         decision in meta/src/barrier/recovery.rs — regional vs global).
-        Returns (scope, cause, flow, fid): scope "fragment" means every
-        reported failure maps into ONE terminal, replay-covered fragment
-        of one non-cluster deployment, so rebuilding just that fragment
-        from the committed epoch is exactly as correct as the full
-        rebuild; anything else is "full" with the cause named."""
+        Returns a LIST of recovery units, one per independently
+        recoverable radius:
+
+            ("fragment", cause, flow, {terminal_fid})   terminal only
+            ("cone",     cause, flow, cone_fids)        {failed + its
+                                                        downstream cone}
+            ("mesh",     cause, flow, cone_fids)        a fused mesh
+                                                        fragment failed
+            ("worker",   cause, None, plan)             cluster radius
+            ("full",     cause, None, None)             stop-the-world
+
+        Failures spanning SEVERAL deployments classify per deployment —
+        two simultaneous contained faults recover independently instead
+        of collapsing to one global full recovery. Any radius the
+        classifier cannot prove contained is a single "full" unit with
+        the cause named; correctness never weakens."""
         coord = self.coord
+        if self.cluster is not None:
+            return [self._classify_cluster_failure()]
         if coord._upload_failure is not None:
-            return "full", "upload_failure", None, None
+            return [("full", "upload_failure", None, None)]
         if coord.logstore.failure is not None:
-            return "full", "sink_delivery", None, None
+            return [("full", "sink_delivery", None, None)]
         failed = dict(coord.failed_actors)
         if not failed:
-            return "full", "unknown", None, None
+            return [("full", "unknown", None, None)]
         if any(aid < 0 for aid in failed):
-            return "full", "worker_death", None, None
-        if self.cluster is not None:
-            return "full", "cluster", None, None
+            return [("full", "worker_death", None, None)]
         if not bool(self.config.get("partial_recovery", 1)):
-            return "full", "partial_recovery_off", None, None
-        # locate the owning (flow, fragment) of every failed actor
-        sites = set()
-        flow = None
+            return [("full", "partial_recovery_off", None, None)]
+        # group the failed actors by owning deployment: the coordinator
+        # records ALL failed actors, and each affected flow classifies
+        # (and recovers) on its own
+        by_dep: dict[int, tuple] = {}
         for aid in failed:
             for f in (list(self.catalog.mvs.values())
                       + list(self.catalog.sinks.values())):
                 fid = getattr(f.deployment, "actor_fragment",
                               {}).get(aid)
                 if fid is not None:
-                    sites.add((id(f.deployment), fid))
-                    flow = f
+                    ent = by_dep.setdefault(id(f.deployment), (f, set()))
+                    ent[1].add(fid)
                     break
             else:
-                return "full", "unknown_actor", None, None
-        if len(sites) > 1:
-            return "full", "multi_fragment", None, None
-        fid = next(iter(sites))[1]
+                return [("full", "unknown_actor", None, None)]
+        units = [self._classify_flow(f, fids)
+                 for f, fids in by_dep.values()]
+        for u in units:
+            if u[0] == "full":
+                return [u]        # one global rebuild covers everything
+        return units
+
+    def _classify_cluster_failure(self):
+        """Cluster radius: a single worker's death (lease/connection
+        loss) or a contained worker-reported actor failure (e.g. a
+        severed DCN leg) rebuilds the affected actors — re-placed onto
+        survivors when their worker died — plus their downstream
+        closure; surviving workers keep their stores open at the
+        committed manifest and every actor outside the closure keeps
+        running. Anything wider is a full cluster recovery with the
+        cause named."""
+        coord = self.coord
+        mgr = self.cluster
+        if not bool(self.config.get("partial_recovery", 1)):
+            return ("full", "partial_recovery_off", None, None)
+        dead = sorted(wid for wid, h in mgr.workers.items()
+                      if not h.info.alive)
+        if len(dead) > 1:
+            return ("full", "multi_worker", None, None)
+        if coord.logstore.failure is not None:
+            return ("full", "sink_delivery", None, None)
+        # an upload failure raised by the dead worker's vanished sealed
+        # report is subsumed by the worker radius (the aborted epochs
+        # replay from the committed manifest); any OTHER upload failure
+        # is a real store error
+        if coord._upload_failure is not None and not dead:
+            return ("full", "upload_failure", None, None)
+        failed = dict(coord.failed_actors)
+        # positive ids are worker-REPORTED actor failures (the worker
+        # process itself is alive); negative ids are worker pseudo-
+        # actors whose epochs failed
+        actor_ids = sorted(aid for aid in failed if aid > 0)
+        if not dead and not actor_ids:
+            return ("full", "unknown", None, None)
+        plan = mgr.plan_partial(dead[0] if dead else None, actor_ids)
+        if plan is None:
+            return ("full", "cluster", None, None)
+        return ("worker", "worker_death" if dead else "dcn_failure",
+                None, plan)
+
+    def _classify_flow(self, flow, failed_fids):
+        """One deployment's radius: the failed fragments plus their
+        transitive downstream consumers (the CONE — every consumer saw
+        part of the aborted interval's output, so its uncommitted state
+        is tainted and it rebuilds with the failure). The cone's inbound
+        frontier must be fully replay-buffered; upstream producers keep
+        their device state."""
         dep = flow.deployment
         if dep.rebuild_info is None:
-            return "full", "unsupported_deployment", None, None
-        if dep.fragment_consumers.get(fid):
-            # a downstream fragment consumed part of the in-flight
-            # interval's output — its uncommitted state is tainted, so
-            # the radius is not one fragment
-            return "full", "downstream_fragments", None, None
+            return ("full", "unsupported_deployment", None, None)
         graph = dep.rebuild_info["graph"]
-        frag = graph.fragments[fid]
-        if getattr(frag, "remote_worker", None):
-            return "full", "remote_fragment", None, None
-        if any(aid in coord.mesh_fragments
-               for aid in dep.frag_actor_ids.get(fid, ())):
-            return "full", "mesh_fragment", None, None
-        kinds = {n.kind for n in _fragment_node_kinds(frag)}
-        if "stream_scan" in kinds:
-            return "full", "backfill_fragment", None, None
+        cone = set(failed_fids)
+        changed = True
+        while changed:
+            changed = False
+            for fid in list(cone):
+                for d, _k in dep.fragment_consumers.get(fid, ()):
+                    if d not in cone:
+                        cone.add(d)
+                        changed = True
+        mesh = any(aid in self.coord.mesh_fragments
+                   for fid in cone
+                   for aid in dep.frag_actor_ids.get(fid, ()))
+        for fid in cone:
+            frag = graph.fragments[fid]
+            if getattr(frag, "remote_worker", None):
+                return ("full", "remote_fragment", None, None)
+            kinds = {n.kind for n in _fragment_node_kinds(frag)}
+            if "stream_scan" in kinds:
+                return ("full", "backfill_fragment", None, None)
+            if fid in failed_fids and "nexmark_source" in kinds:
+                # a source fragment has no inbound replay frontier to
+                # re-drive it — its cone is the whole deployment with
+                # nothing buffered upstream of the failure
+                return ("full", "source_fragment", None, None)
+        terminal = self._terminal_fid(flow)
         tap = getattr(flow, "tap", None)
-        if tap is not None and tap.channels:
-            # a live MV-on-MV consumer taps this fragment's output — it
-            # saw part of the in-flight interval
-            return "full", "downstream_tap", None, None
+        if terminal in cone and tap is not None and tap.channels:
+            # a live MV-on-MV consumer taps the terminal — it saw part
+            # of the aborted interval through a channel outside the
+            # deployment's rebuild scope
+            return ("full", "downstream_tap", None, None)
         # the flow must be durable: a volatile fragment has no committed
         # state to rebuild from
         entry = next((e for e in self._ddl_log
@@ -1364,33 +1498,47 @@ class Session:
                       and e["kind"] in ("mv", "sink")), None)
         if entry is None or entry.get("config", {}).get(
                 "streaming_durability", 1) == 0:
-            return "full", "volatile", None, None
-        # every inbound edge must carry a replay buffer
+            return ("full", "volatile", None, None)
+        # every edge ENTERING the cone (the inbound frontier) must carry
+        # a replay buffer; intra-cone edges are reset and re-driven by
+        # the rebuilt producers themselves
         for (u, d, k), mat in dep.rebuild_info["channels"].items():
-            if d != fid:
+            if d not in cone or u in cone:
                 continue
             for row in mat:
                 for ch in row:
                     if not ch.replay_enabled:
-                        return "full", "unbuffered_edge", None, None
-        return "fragment", "actor_exception", flow, fid
+                        return ("full", "unbuffered_edge", None, None)
+        scope = ("mesh" if mesh
+                 else "fragment" if cone == {terminal}
+                 else "cone")
+        return (scope, "actor_exception", flow, cone)
 
     async def _recover_auto(self, cause_hint=None) -> None:
-        """Classify, then recover at the narrowest correct scope. Any
-        exception during the partial path falls back to the full
-        rebuild — partial recovery is an optimization, never a weaker
-        correctness mode."""
+        """Classify, then recover every unit at its narrowest correct
+        scope. Any exception during a partial path falls back to ONE
+        full rebuild — partial recovery is an optimization, never a
+        weaker correctness mode."""
         import time as _time
         t0 = _time.monotonic_ns()
-        scope, cause, flow, fid = self._classify_failure()
+        units = self._classify_failure()
+        cause = units[0][1]
         if cause == "unknown" and cause_hint:
             # a retry after a crashed recovery starts from a fresh
             # coordinator with no failure marker — name it honestly
             cause = cause_hint
-        if scope == "fragment":
+        if units[0][0] != "full":
             try:
-                rebuilt = await self._partial_recover(flow, fid)
-                self._note_recovery("fragment", cause, t0, rebuilt)
+                # independent radii recover one after another; each
+                # notes its own scope/duration/actors so the metrics
+                # and /healthz reflect every contained rebuild
+                for scope, u_cause, flow, plan in units:
+                    t_u = _time.monotonic_ns()
+                    if scope == "worker":
+                        rebuilt = await self._worker_partial_recover(plan)
+                    else:
+                        rebuilt = await self._partial_recover(flow, plan)
+                    self._note_recovery(scope, u_cause, t_u, rebuilt)
                 return
             except asyncio.CancelledError:
                 raise
@@ -1421,34 +1569,57 @@ class Session:
                               "duration_s": round(dur_ns / 1e9, 6),
                               "actors": list(actors)}
         self.coord.tracer.note_recovery(scope, cause, dur_ns, actors)
+        # flap detection: the recovery RATE per cause feeds the backoff
+        # base and the degraded surface (recovery_flapping{cause})
+        self._recovery_log.append((_time.monotonic(), cause))
+        flapping = set(self.flapping_causes())
+        seen = {c for _, c in self._recovery_log}
+        for c in seen:
+            GLOBAL_METRICS.gauge("recovery_flapping", cause=c).set(
+                1.0 if c in flapping else 0.0)
 
-    async def _partial_recover(self, flow, fid: int) -> list[int]:
-        """Rebuild ONE terminal fragment in place (the narrow scope the
-        classifier proved safe): cancel its actors, discard its staged
-        uncommitted writes, rebuild the same actor/table ids from the
-        committed epoch, re-attach the terminal plumbing (tap, serving
-        hooks, changelog writers), arm channel replay, respawn. The
-        coordinator, every OTHER fragment's actors, and their device
-        state are untouched — upstream never re-backfills. Returns the
-        rebuilt actor ids (the chaos gate asserts this set is strictly
-        smaller than the full topology's)."""
+    async def _partial_recover(self, flow, cone) -> list[int]:
+        """Rebuild one deployment's failure CONE in place (the narrow
+        scope the classifier proved safe): cancel the cone's actors,
+        discard exactly its staged uncommitted writes, reset the
+        intra-cone channels, rebuild the same actor/table ids from the
+        committed epoch in topo order, re-attach the terminal plumbing
+        (tap, serving hooks, changelog writers) when the cone includes
+        the terminal, arm replay on every edge entering the cone (the
+        inbound frontier), respawn. The coordinator, every fragment
+        UPSTREAM of the cone, and their device state are untouched —
+        upstream never re-backfills. `cone` may be a single terminal
+        fragment (PR 9's scope), an interior fragment plus its
+        downstream consumers, or a cone containing a fused mesh
+        fragment. Returns the rebuilt actor ids (the chaos gate asserts
+        this set is strictly smaller than the full topology's)."""
         from ..plan.build import rebuild_fragment
         from ..utils.faults import FAULTS, FaultInjected
         coord = self.coord
         dep = flow.deployment
+        cone = set(cone) if not isinstance(cone, set) else cone
+        terminal = self._terminal_fid(flow)
         self.recoveries += 1
         async with coord._rounds_lock:
             # 1. let fully-collected checkpoints finish committing: after
             # this the ONLY uncommitted staged state belongs to the
             # failed (never-collected) epoch(s). Raises on a parked
             # upload failure -> caller falls back to full recovery.
+            # Sink DELIVERY drains too: a rebuilt sink target recovers
+            # its committed seq from the target itself (e.g. the
+            # FileSink file scan), so an in-flight delivery write racing
+            # the rebuild would make the crash-window entry deliver
+            # twice.
             await coord.drain_uploads()
+            await coord.logstore.drain()
             if FAULTS.active and FAULTS.hit(
                     "recovery_crash", phase="partial") is not None:
                 raise FaultInjected("injected crash during partial "
                                     "recovery")
-            # 2. cancel the fragment's actor tasks (dead and siblings)
-            ids = set(dep.frag_actor_ids[fid])
+            # 2. cancel every cone fragment's actor tasks (dead + kin)
+            ids = set()
+            for fid in cone:
+                ids.update(dep.frag_actor_ids[fid])
             by_id = {a.actor_id: i for i, a in enumerate(dep.actors)}
             for aid in sorted(ids):
                 t = dep.tasks[by_id[aid]]
@@ -1458,13 +1629,16 @@ class Session:
                     await t
                 except (asyncio.CancelledError, Exception):
                     pass
-            # 3. drop the fragment's staged uncommitted writes + pending
-            # deferred flushes; survivors' partial-epoch writes stay and
-            # commit with the next checkpoint (their dirty tracking
-            # already cleared at the failed barrier)
-            table_ids = set(dep.frag_tables.get(fid, {}).values())
+            # 3. drop the cone's staged uncommitted writes + pending
+            # deferred flushes; fragments upstream of the cone keep
+            # their partial-epoch writes, which commit with the next
+            # checkpoint (their dirty tracking already cleared at the
+            # failed barrier)
+            table_ids = set()
+            for fid in cone:
+                table_ids.update(dep.frag_tables.get(fid, {}).values())
             clog = coord.logstore.mv_logs.get(flow.name)
-            if isinstance(flow, MvDef) and fid == flow.mv_fragment \
+            if isinstance(flow, MvDef) and terminal in cone \
                     and clog is not None:
                 table_ids.add(clog.table_id)
             discard = getattr(self.store, "discard_staged_tables", None)
@@ -1474,15 +1648,31 @@ class Session:
             # the never-collected epochs; injection resumes at the same
             # epoch stream every surviving actor already follows
             coord.clear_failure()
-            # 5. rebuild the fragment's actors (same ids, same tables)
+            # 5. reset INTRA-cone channels: both ends are rebuilt, so
+            # queued leftovers and the buffered suffix belong to dead
+            # incarnations — the rebuilt producers re-derive and
+            # re-emit the suffix themselves (starting with the
+            # synthetic INITIAL they receive from the frontier)
+            for (u, d, k), mat in dep.rebuild_info["channels"].items():
+                if d in cone and u in cone:
+                    for row in mat:
+                        for ch in row:
+                            ch.reset_for_rebuild()
+            # 6. rebuild the cone's actors in topo order (same ids,
+            # same tables — producers exist before their consumers
+            # poll, exactly like the initial build)
+            graph = dep.rebuild_info["graph"]
+            order = [f for f in graph.topo_order() if f in cone]
+            new_actors = []
             self.env.memory_scope = flow.name
             try:
-                new_actors = rebuild_fragment(dep, fid)
+                for fid in order:
+                    new_actors.extend(rebuild_fragment(dep, fid))
             finally:
                 self.env.memory_scope = None
-            # 6. re-attach terminal plumbing
-            roots = dep.roots[fid]
-            if isinstance(flow, MvDef) and fid == flow.mv_fragment:
+            # 7. re-attach terminal plumbing when the cone includes it
+            if isinstance(flow, MvDef) and terminal in cone:
+                roots = dep.roots[terminal]
                 root_actor = next(a for a in new_actors
                                   if a.consumer is roots[0])
                 assert root_actor.dispatcher is None
@@ -1502,12 +1692,15 @@ class Session:
                                     for i in range(len(roots))]
                     for r, w in zip(roots, clog.writers):
                         r.changelog_log = w
-            # 7. arm replay on every inbound edge, THEN spawn: the
-            # rebuilt consumers see a synthetic INITIAL barrier at the
-            # committed point, the buffered uncommitted suffix, then the
-            # live stream (queue duplicates skipped by sequence number)
+            # 8. arm replay on every FRONTIER edge (entering the cone),
+            # THEN spawn: the rebuilt consumers see a synthetic INITIAL
+            # barrier at the committed point, the buffered uncommitted
+            # suffix, then the live stream (queue duplicates skipped by
+            # sequence number); interior rebuilt fragments propagate
+            # that INITIAL + their recomputed output through the reset
+            # intra-cone channels
             for (u, d, k), mat in dep.rebuild_info["channels"].items():
-                if d != fid:
+                if d not in cone or u in cone:
                     continue
                 for row in mat:
                     for ch in row:
@@ -1515,6 +1708,23 @@ class Session:
             for a in new_actors:
                 dep.tasks[by_id[a.actor_id]] = a.spawn()
         return sorted(ids)
+
+    async def _worker_partial_recover(self, plan) -> list[int]:
+        """Cluster radius (cluster/meta_service.py owns the protocol):
+        re-place the dead worker's actors onto survivors and rebuild
+        their downstream closure in place — surviving workers keep
+        their stores open at the committed manifest and every actor
+        outside the closure keeps running."""
+        self.recoveries += 1
+        async with self.coord._rounds_lock:
+            # stale worker failure reports racing the rebuild are
+            # dropped by the push handler while this is set (their
+            # actors are already being torn down)
+            self._recovering = True
+            try:
+                return await self.cluster.partial_recover(plan)
+            finally:
+                self._recovering = False
 
     async def _auto_recover(self) -> None:
         """Tear down every actor, drop uncommitted store state, rebuild

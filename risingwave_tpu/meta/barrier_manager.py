@@ -372,6 +372,23 @@ class BarrierCoordinator:
         for ch in self.replay_channels:
             ch.trim_replay(committed_epoch)
 
+    def _trim_at_local_commit(self, epoch: int) -> None:
+        """Trim pulse at a LOCAL commit: on a compute node the local
+        commit_sealed only installs read-through state — the epoch is
+        durable only when META's manifest swap covers it (the
+        `committed` push, cluster/compute_node.py rpc_committed). A
+        worker trimming at its own seal would throw away exactly the
+        suffix per-worker recovery must replay."""
+        if getattr(self.store, "manifest_owner", True):
+            self._trim_replay_buffers(epoch)
+
+    def clear_upload_failure(self) -> None:
+        """Worker-partial recovery subsumes an upload failure caused by
+        the dead worker's vanished sealed report: the aborted epochs
+        replay from the committed manifest, so the parked error must
+        not fail the resumed injection stream."""
+        self._upload_failure = None
+
     # ------------------------------------------------------------ injection
     async def inject_barrier(self, mutation: Optional[Mutation] = None,
                              kind: Optional[BarrierKind] = None) -> Barrier:
@@ -548,7 +565,7 @@ class BarrierCoordinator:
                         barrier.epoch.prev,
                         (res or {}).get("uncommitted_ssts", []))
                 self.logstore.on_commit(barrier.epoch.prev)
-                self._trim_replay_buffers(barrier.epoch.prev)
+                self._trim_at_local_commit(barrier.epoch.prev)
                 self.tracer.end(barrier.epoch.curr,
                                 sync_ns=time.monotonic_ns() - t_sync)
         else:
@@ -675,6 +692,16 @@ class BarrierCoordinator:
                     self.committed_epochs.append(job.prev_epoch)
                     self.logstore.on_commit(job.prev_epoch)
                     self._trim_replay_buffers(job.prev_epoch)
+                    # confirm the commit to every worker: they drop
+                    # their retained sealed batches and trim their
+                    # replay buffers (local channels + DCN legs) to the
+                    # uncommitted suffix — the cluster-wide twin of the
+                    # local trim pulse
+                    for handle in list(self.workers.values()):
+                        try:
+                            await handle.notify_committed(job.prev_epoch)
+                        except Exception:  # noqa: BLE001 — detector owns it
+                            pass
                     self.upload_busy_ns += t3 - t0
                     self._m_upload.observe((t2 - t0) / 1e9)
                     self._m_commit.observe((t3 - t2) / 1e9)
@@ -714,7 +741,7 @@ class BarrierCoordinator:
                         job.prev_epoch,
                         (res or {}).get("uncommitted_ssts", []))
                 self.logstore.on_commit(job.prev_epoch)
-                self._trim_replay_buffers(job.prev_epoch)
+                self._trim_at_local_commit(job.prev_epoch)
                 self.upload_busy_ns += t3 - t0
                 self._m_seal.observe((t1 - t0) / 1e9)
                 self._m_upload.observe((t2 - t1) / 1e9)

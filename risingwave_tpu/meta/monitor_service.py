@@ -93,6 +93,15 @@ class MonitorService:
                                    in coord.mesh_fragments.items()},
                 "recoveries": self._session.recoveries,
             }
+            # flap detector (frontend/session.py flapping_causes): a
+            # cause recovering faster than recovery_flap_threshold per
+            # window marks the session DEGRADED — converging, but the
+            # fault keeps coming back
+            flap = getattr(self._session, "flapping_causes", None)
+            causes = flap() if flap is not None else []
+            payload["degraded"] = bool(causes)
+            if causes:
+                payload["flapping_causes"] = causes
             last = getattr(self._session, "last_recovery", None)
             if last is not None:
                 # cause/scope/duration of the most recent auto-recovery

@@ -141,6 +141,15 @@ class Deployment:
     frag_tables: dict = field(default_factory=dict)      # fid -> table map
     fragment_consumers: dict = field(default_factory=dict)
     replay_channels: list = field(default_factory=list)
+    # fid -> [MeshIngestLog] — the fused fragments' replay points, so a
+    # per-fragment rebuild swaps the old incarnation's log out of the
+    # coordinator's trim pulse (stream/sharded_agg.py)
+    frag_ingest_logs: dict = field(default_factory=dict)
+    # ---- per-ACTOR bookkeeping (cluster worker rebuilds, where a
+    # fragment's actors split across workers and rebuild individually)
+    actor_memory_names: dict = field(default_factory=dict)
+    actor_source_queues: dict = field(default_factory=dict)
+    actor_root: dict = field(default_factory=dict)    # actor_id -> root
     # everything rebuild_fragment needs to re-run one fragment's build:
     # {"graph","env","channels","built_schema","consumers"}; None when
     # the deployment came from a path without rebuild support (cluster)
@@ -222,17 +231,21 @@ def _register_memory(dep: Deployment, env: BuildEnv, root,
             name = env.coord.memory.register(
                 f"{scope}/{ex.identity}@a{actor_id}", ex)
             dep.memory_names.append(name)
+            dep.actor_memory_names.setdefault(actor_id, []).append(name)
             if fid is not None:
                 dep.frag_memory_names.setdefault(fid, []).append(name)
 
 
 def _register_mesh(dep: Deployment, env: BuildEnv, root,
-                   actor_id: int) -> None:
+                   actor_id: int, fid=None) -> None:
     """The fused mesh plane: an exchange -> sharded-executor chain that
     the builders lowered onto the device mesh announces itself to the
     barrier coordinator — the fragment's S shards collect every epoch as
     ONE actor (a single collective boundary), and /healthz + the
-    mesh_profile gate can see the mesh topology."""
+    mesh_profile gate can see the mesh topology. The executor's
+    MeshIngestLog (the mesh-plane replay point) registers next to the
+    exchange replay buffers so the commit pulse trims it to the
+    uncommitted ingest suffix."""
     reg = getattr(env.coord, "register_mesh_fragment", None)
     if reg is None:
         return
@@ -241,6 +254,17 @@ def _register_mesh(dep: Deployment, env: BuildEnv, root,
         if n and getattr(ex, "mesh", None) is not None:
             reg(actor_id, n, getattr(ex, "identity", type(ex).__name__))
             dep.mesh_actor_ids.append(actor_id)
+            ilog = getattr(ex, "ingest_log", None)
+            if ilog is not None and getattr(env, "partial_recovery",
+                                            True):
+                reg2 = getattr(env.coord, "register_replay_channels",
+                               None)
+                if reg2 is not None:
+                    reg2([ilog])
+                    dep.replay_channels.append(ilog)
+                    if fid is not None:
+                        dep.frag_ingest_logs.setdefault(
+                            fid, []).append(ilog)
             return                  # one registration per actor
 
 
@@ -289,7 +313,7 @@ def _build_fragment_actor(graph, env, dep, channels, built_schema,
     root = build_node(f.root)
     dep.roots[fid].append(root)
     _register_memory(dep, env, root, actor_id, fid=fid)
-    _register_mesh(dep, env, root, actor_id)
+    _register_mesh(dep, env, root, actor_id, fid=fid)
     dispatcher = _dispatcher_for(graph, f, consumers[fid], channels, idx)
     env.coord.register_actor(actor_id)
     actor = Actor(actor_id, root, dispatcher, env.coord)
@@ -438,6 +462,15 @@ def rebuild_fragment(dep: Deployment, fid: int) -> list[Actor]:
         if aid in dep.mesh_actor_ids:
             coord.unregister_mesh_fragment(aid)
             dep.mesh_actor_ids.remove(aid)
+    # the old incarnation's mesh replay point leaves the trim pulse —
+    # the rebuilt executor registers a fresh one
+    old_logs = dep.frag_ingest_logs.pop(fid, [])
+    if old_logs:
+        unreg = getattr(coord, "unregister_replay_channels", None)
+        if unreg is not None:
+            unreg(old_logs)
+        dep.replay_channels = [c for c in dep.replay_channels
+                               if not any(c is o for o in old_logs)]
 
     # rebuild with the ORIGINAL ids; builders re-read durable state at
     # their first barrier (the committed epoch — the caller discarded
@@ -1268,7 +1301,11 @@ def build_partial_graph(graph: StreamGraph, env: BuildEnv,
     order = graph.topo_order()
     consumers = {fid: graph.consumers(fid) for fid in order}
 
-    # local-local channel matrix entries only (sparse dict by (u, d))
+    # local-local channel matrix entries only (sparse dict by (u, d));
+    # replay buffers on every local leg, trimmed by meta's `committed`
+    # push — a worker-local frontier edge replays into a rebuilt
+    # consumer exactly like the single-process path
+    replay = getattr(env, "partial_recovery", True)
     for fid in order:
         f = graph.fragments[fid]
         for d_fid, k in consumers[fid]:
@@ -1278,8 +1315,15 @@ def build_partial_graph(graph: StreamGraph, env: BuildEnv,
                 for di in range(d.parallelism):
                     if placement[fid][u] == my_worker \
                             and placement[d_fid][di] == my_worker:
-                        mat[(u, di)] = Channel(env.channel_capacity)
+                        ch = Channel(env.channel_capacity)
+                        if replay:
+                            ch.enable_replay()
+                            dep.replay_channels.append(ch)
+                        mat[(u, di)] = ch
             channels[(fid, d_fid, k)] = mat
+    reg = getattr(env.coord, "register_replay_channels", None)
+    if reg is not None and dep.replay_channels:
+        reg(dep.replay_channels)
 
     def edge_chan(up_fid, fid, k, u, di):
         """Channel-like the consumer (fid actor di, local) reads for
@@ -1328,10 +1372,11 @@ def build_partial_graph(graph: StreamGraph, env: BuildEnv,
                 return BUILDERS[n.kind](dict(n.args), inputs, ctx,
                                         (fid, node_idx[id(n)]))
 
+            q_before = len(env.pending_source_queues)
             root = build_node(f.root)
             dep.roots[fid].append(root)
             _register_memory(dep, env, root, actor_id)
-            _register_mesh(dep, env, root, actor_id)
+            _register_mesh(dep, env, root, actor_id, fid=fid)
             dispatcher = _cluster_dispatcher(graph, f, consumers[fid],
                                              channels, placement,
                                              my_worker, remote_outs, idx)
@@ -1340,8 +1385,122 @@ def build_partial_graph(graph: StreamGraph, env: BuildEnv,
             dep.actors.append(actor)
             env.coord.stats.register(env.memory_scope or "flow",
                                      actor, root)
+            dep.actor_fragment[actor_id] = fid
+            dep.frag_actor_ids.setdefault(fid, []).append(actor_id)
+            dep.actor_source_queues[actor_id] = list(
+                env.pending_source_queues[q_before:])
+            dep.actor_root[actor_id] = root
     dep.source_queues = list(env.pending_source_queues)
+    # worker rebuild support (cluster partial recovery): the channel
+    # dict rides with the deployment so a closure rebuild can reuse the
+    # surviving legs and replace the dead ones
+    dep.rebuild_info = {"graph": graph, "env": env, "channels": channels,
+                        "consumers": consumers}
     return dep
+
+
+def build_closure_actors(graph, env, dep, new_placement, my_worker,
+                         actors, tables, schemas, closure,
+                         in_leg, out_leg) -> list[Actor]:
+    """Per-worker partial recovery, compute-node side: build the
+    CLOSURE actors assigned to `my_worker` under the NEW placement —
+    the dead worker's re-placed actors plus this worker's in-place
+    rebuilds — with the ORIGINAL global ids and table maps (the shared
+    vnode-partitioned state re-binds at the committed view exactly like
+    `rebuild_fragment`). Edge legs resolve through the caller's
+    resolvers, which route each edge per its recovery disposition
+    (reused surviving channel, rewound remote leg, or a fresh pair
+    between two rebuilt actors):
+
+        in_leg(up_fid, fid, k, u, di)  -> recv()-able input leg
+        out_leg(fid, d_fid, k, u, di)  -> awaitable send target
+
+    Returns the new Actor list; the caller tears the old incarnations
+    down first and spawns these after arming replay."""
+    new_actors: list[Actor] = []
+    for fid in graph.topo_order():
+        f = graph.fragments[fid]
+        for idx in sorted(closure.get(fid, ())):
+            if new_placement[fid][idx] != my_worker:
+                continue
+            bitmaps = (shard_vnode_bitmaps(f.parallelism)
+                       if f.parallelism > 1 else [None])
+            actor_id = actors[fid][idx]
+            ctx = ActorCtx(env=env, fragment=f, actor_id=actor_id,
+                           actor_idx=idx, vnode_bitmap=bitmaps[idx],
+                           table_ids=tables[fid])
+            edge_seen: dict[int, int] = {}
+            node_idx = {id(n): i
+                        for i, n in enumerate(fragment_node_order(f))}
+
+            def build_node(n):
+                if isinstance(n, Exchange):
+                    k = edge_seen.get(n.upstream, 0)
+                    edge_seen[n.upstream] = k + 1
+                    up = graph.fragments[n.upstream]
+                    sch = schemas[n.upstream]
+                    stop_on = (lambda b, aid=ctx.actor_id: b.is_stop(aid))
+                    co = env.chunk_coalesce_max
+                    if up.dispatch == "simple" and up.parallelism > 1:
+                        return ChannelInput(
+                            in_leg(n.upstream, fid, k, idx, idx), sch,
+                            stop_on=stop_on, coalesce_max=co,
+                            actor_id=ctx.actor_id)
+                    chans = [in_leg(n.upstream, fid, k, u, idx)
+                             for u in range(up.parallelism)]
+                    if len(chans) == 1:
+                        return ChannelInput(chans[0], sch,
+                                            stop_on=stop_on,
+                                            coalesce_max=co,
+                                            actor_id=ctx.actor_id)
+                    return MergeExecutor(chans, sch, stop_on=stop_on,
+                                         coalesce_max=co)
+                inputs = [build_node(i) for i in n.inputs]
+                return BUILDERS[n.kind](dict(n.args), inputs, ctx,
+                                        (fid, node_idx[id(n)]))
+
+            q_before = len(env.pending_source_queues)
+            root = build_node(f.root)
+            dep.roots.setdefault(fid, []).append(root)
+            _register_memory(dep, env, root, actor_id)
+            _register_mesh(dep, env, root, actor_id, fid=fid)
+            cons = graph.consumers(fid)
+            dispatcher = None
+            if cons:
+                per_consumer = []
+                for d_fid, k in cons:
+                    d = graph.fragments[d_fid]
+                    if f.dispatch == "hash":
+                        if d.parallelism == 1:
+                            per_consumer.append(SimpleDispatcher(
+                                out_leg(fid, d_fid, k, idx, 0)))
+                        else:
+                            per_consumer.append(HashDispatcher(
+                                [out_leg(fid, d_fid, k, idx, di)
+                                 for di in range(d.parallelism)],
+                                f.dist_key_indices,
+                                vnode_to_shard(d.parallelism)))
+                    elif f.dispatch == "broadcast":
+                        per_consumer.append(BroadcastDispatcher(
+                            [out_leg(fid, d_fid, k, idx, di)
+                             for di in range(d.parallelism)]))
+                    else:
+                        per_consumer.append(SimpleDispatcher(
+                            out_leg(fid, d_fid, k, idx, idx)))
+                dispatcher = (per_consumer[0] if len(per_consumer) == 1
+                              else FanoutDispatcher(per_consumer))
+            env.coord.register_actor(actor_id)
+            actor = Actor(actor_id, root, dispatcher, env.coord)
+            env.coord.stats.register(env.memory_scope or "flow",
+                                     actor, root)
+            dep.actor_fragment[actor_id] = fid
+            dep.frag_actor_ids.setdefault(fid, []).append(actor_id)
+            new_queues = list(env.pending_source_queues[q_before:])
+            dep.actor_source_queues[actor_id] = new_queues
+            dep.source_queues.extend(new_queues)
+            dep.actor_root[actor_id] = root
+            new_actors.append(actor)
+    return new_actors
 
 
 def _cluster_dispatcher(graph, f, cons, channels, placement, my_worker,

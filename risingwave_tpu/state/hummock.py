@@ -95,6 +95,15 @@ class HummockStateStore(StateStore):
         # read-through, skips the manifest swap, and never compacts
         # (compaction rewrites + deletes objects the manifest references).
         self.manifest_owner = True
+        # Non-owner handles retain every batch they sealed + uploaded
+        # until META confirms the cluster commit (the `committed` push):
+        # an epoch the dead worker never sealed can NEVER commit, and
+        # without retention the survivors' share of that epoch would
+        # have left the staged model (sealed, locally installed) while
+        # the manifest never learns of it — silent durable loss on the
+        # next crash. Per-worker partial recovery RESTAGES these into
+        # the shared buffer so the next checkpoint re-seals them.
+        self._unconfirmed: list[SealedBatch] = []
         if object_store.exists(MANIFEST_PATH):
             self._load_manifest()
 
@@ -115,6 +124,22 @@ class HummockStateStore(StateStore):
                     for i in m["l0"]]
         self._l1 = (SsTable.parse(m["l1"], self.objects.read(_sst_path(m["l1"])))
                     if m["l1"] is not None else None)
+
+    def refresh_manifest(self) -> None:
+        """Re-point this handle at the CURRENT committed manifest
+        without reopening (per-worker partial recovery: a surviving
+        compute node's manifest snapshot is from deploy time, so reads
+        of the DEAD worker's committed rows — re-placed actors
+        recovering their vnode ranges, source offsets — would otherwise
+        see a stale, possibly empty view). Staged buffers, retained
+        batches and the worker's disjoint SST-id block are untouched;
+        the local L0/L1 are replaced by the manifest's (which includes
+        every worker's committed SSTs — this worker's own confirmed
+        installs are manifest-covered by definition)."""
+        keep_next = self._next_sst_id
+        if self.objects.exists(MANIFEST_PATH):
+            self._load_manifest()
+        self._next_sst_id = max(self._next_sst_id, keep_next)
 
     def _write_manifest(self) -> None:
         m = {
@@ -211,6 +236,41 @@ class HummockStateStore(StateStore):
         self._shared.clear()
         self._sealed.clear()
         self._deferred.clear()
+        self._unconfirmed.clear()
+
+    # ------------------------------------------- worker commit confirmation
+    def confirm_committed(self, epoch: int) -> None:
+        """Meta's `committed` notification reached this worker handle:
+        every retained batch the cluster commit covered is durable in
+        the shared manifest — drop it from the retention list."""
+        self._unconfirmed = [b for b in self._unconfirmed
+                             if b.seal_epoch > epoch]
+
+    def restage_unconfirmed(self) -> None:
+        """Per-worker partial recovery: move every sealed-but-never-
+        confirmed batch BACK into the shared buffer under its original
+        epochs, so the next checkpoint re-seals (and meta re-commits)
+        the survivors' share of the aborted epochs. Their local-L0
+        installs are REMOVED: a rebuilt actor recovers its state by
+        reading this handle, and the uncommitted suffix must be visible
+        through the staged buffer ONLY — where the recovery's
+        discard_staged_tables can drop the rebuilt fragments' share
+        before the exchange replay re-derives it (left in L0 it would
+        double-apply). Restaged epochs are older keys, so the next
+        `seal` sweeps them in exact overlay order."""
+        drop_ids = {b.sst_id for b in self._unconfirmed
+                    if b.sst_id is not None}
+        if drop_ids:
+            self._l0 = [t for t in self._l0 if t.sst_id not in drop_ids]
+        for b in self._unconfirmed:
+            for e in sorted(b.epochs):
+                buf = self._shared.setdefault(e, {})
+                # original staging order preserved; existing (newer)
+                # staged writes for the same epoch overlay the restage
+                merged = dict(b.epochs[e])
+                merged.update(buf)
+                self._shared[e] = merged
+        self._unconfirmed = []
 
     # -------------------------------------------------------------- writes
     def ingest_batch(self, batch: WriteBatch) -> None:
@@ -272,6 +332,9 @@ class HummockStateStore(StateStore):
             # POINT (manifest swap) belongs to meta, which installs these
             # SSTs via commit_remote only after every worker reported
             # sealed. No compaction either — meta owns object lifetime.
+            # Retain the batch until meta's `committed` notification:
+            # see _unconfirmed in __init__ (worker partial recovery).
+            self._unconfirmed.append(batch)
             return {"uncommitted_ssts": new_ids}
         obsolete: list[int] = []
         if len(self._l0) > self.L0_COMPACT_THRESHOLD:

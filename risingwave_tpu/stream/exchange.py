@@ -71,6 +71,7 @@ class Channel:
         self._base_barrier = None         # last trimmed (committed) barrier
         self._replay = None               # deque to deliver before queue
         self._last_seq = 0                # max seq ever delivered
+        self._stale_ceiling = None        # drop dead-epoch barriers below
 
     # ------------------------------------------------------------ replay
     def enable_replay(self) -> None:
@@ -100,13 +101,45 @@ class Channel:
         if base is not None:
             self._base_barrier = base
 
-    def begin_replay(self) -> int:
+    def reset_for_rebuild(self) -> None:
+        """Reset an INTRA-CONE edge: both endpoints of this channel are
+        being rebuilt (downstream-cone recovery), so everything in flight
+        — queued undrained messages, the buffered uncommitted suffix,
+        the sequence counters — belongs to dead incarnations. The
+        rebuilt producer re-derives the suffix from ITS replayed inputs
+        and re-emits it here as fresh messages (starting with the
+        synthetic INITIAL barrier it received from the cone's inbound
+        frontier), so the rebuilt consumer must see an empty stream, not
+        the aborted interval's leftovers."""
+        while not self.queue.empty():
+            self.queue.get_nowait()
+        if self._buf is not None:
+            self._buf = deque()
+        self._seq = 0
+        self._last_seq = 0
+        self._replay = None
+        self._base_barrier = None
+        self._stale_ceiling = None
+
+    def begin_replay(self, stale_ceiling: Optional[int] = None) -> int:
         """Arm re-delivery of the buffered suffix to the next consumer.
         Prepends a synthetic INITIAL barrier at the committed point (the
         rebuilt chain's executors init their state tables and reload
         durable state at their first barrier — which must precede every
-        replayed chunk). Returns the number of messages to replay."""
+        replayed chunk). Returns the number of messages to replay.
+
+        `stale_ceiling` (cluster worker recovery): barriers of the
+        DROPPED epochs — committed < epoch.curr <= ceiling — are
+        filtered out of the replay AND the live stream. In-process cone
+        recovery replays them on every leg (all legs saw the same
+        stream, so merges align); in the cluster radius a rebuilt
+        SOURCE joins straight at the live stream, so a frontier leg
+        replaying dead barriers would leave its merge peer one barrier
+        short forever. A producer that was parked mid-epoch may even
+        dispatch a dead barrier AFTER the rebuild — the ceiling filter
+        catches that too."""
         assert self._buf is not None, "replay not enabled on this channel"
+        self._stale_ceiling = stale_ceiling
         items = deque(self._buf)
         base = self._base_barrier
         if base is not None:
@@ -115,6 +148,12 @@ class Channel:
                 base.inject_time_ns)))
         self._replay = items
         return len(items)
+
+    def _is_stale(self, msg) -> bool:
+        c = getattr(self, "_stale_ceiling", None)
+        return (c is not None and isinstance(msg, Barrier)
+                and msg.kind is not BarrierKind.INITIAL
+                and msg.epoch.curr <= c)
 
     async def send(self, msg: Message) -> None:
         item = msg
@@ -145,10 +184,12 @@ class Channel:
             obs.depth.set(float(self.queue.qsize()))
 
     async def recv(self) -> Message:
-        if self._replay:
+        while self._replay:
             seq, msg = self._replay.popleft()
             if seq is not None and seq > self._last_seq:
                 self._last_seq = seq
+            if self._is_stale(msg):
+                continue
             return msg
         if self._buf is None:
             msg = await self.queue.get()
@@ -162,6 +203,8 @@ class Channel:
             if seq <= self._last_seq:
                 continue            # duplicate of a replayed message
             self._last_seq = seq
+            if self._is_stale(msg):
+                continue            # a dead epoch's barrier, late
             return msg
 
 

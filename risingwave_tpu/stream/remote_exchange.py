@@ -27,6 +27,7 @@ import asyncio
 import io
 import json
 import struct
+from collections import deque
 from typing import Optional
 
 import numpy as np
@@ -102,6 +103,12 @@ def _payload_chunk(payload: bytes, schema: Schema,
                        schema)
 
 
+# this process's cluster worker id (set by cluster/compute_node.py at
+# hello) — fault-rule context so `dcn_drop:worker=N` severs exactly one
+# node's leg even though the spec arms every process
+WORKER_ID = None
+
+
 async def _write_frame(writer, tag: bytes, payload: bytes) -> None:
     writer.write(tag + struct.pack("!I", len(payload)) + payload)
     await writer.drain()
@@ -114,9 +121,23 @@ async def _read_frame(reader):
 
 
 class RemoteOutput:
-    """Sender half (dispatch target, Channel-compatible `send`)."""
+    """Sender half (dispatch target, Channel-compatible `send`).
 
-    def __init__(self, host: str, port: int, credits: int = 0):
+    Replay buffering (per-worker partial recovery, cluster/): with
+    `enable_replay()` every sent message is ALSO retained in an ordered
+    buffer, trimmed by meta's `committed` notification to exactly the
+    not-yet-durable suffix — the DCN twin of the in-process Channel's
+    replay buffer. A vanished receiver then PARKS sends (instead of
+    killing the producer actor): `rewind_replay()` re-establishes the
+    leg — to the same receiver (rebuilt in place), the same endpoint
+    after a severed socket, or a fresh RemoteInput server where the
+    consumer was re-placed — and re-feeds a synthetic-INITIAL 'R' frame
+    plus the buffered suffix before live sends resume. Without replay
+    (the legacy remote-fragment tier), a dead receiver still fails the
+    sender fast."""
+
+    def __init__(self, host: str, port: int, credits: int = 0,
+                 replay: bool = False):
         # credits start at ZERO: the receiver's initial grant (its queue
         # depth) is the ONLY source of permits, exactly like permit.rs
         self.host = host
@@ -126,6 +147,41 @@ class RemoteOutput:
         self._reader = self._writer = None
         self._credit_task = None
         self._dead = False
+        # ---- replay machinery (None/off for legacy senders) ----
+        self._buf = deque() if replay else None    # (seq, msg)
+        self._seq = 0
+        self._sent_through = 0      # highest seq written to the socket
+        self._base_barrier = None   # last trimmed (committed) barrier
+        # live sends park while a rewind streams the suffix — an
+        # interleaved frame would reach the rebuilt consumer ahead of
+        # older suffix messages (order corruption)
+        self._rewinding = False
+
+    # ------------------------------------------------------------ replay
+    def enable_replay(self) -> None:
+        if self._buf is None:
+            self._buf = deque()
+
+    @property
+    def replay_enabled(self) -> bool:
+        return self._buf is not None
+
+    def trim_replay(self, committed_epoch: int) -> None:
+        """Same trim rule as the in-process Channel: drop everything up
+        to and including the LAST barrier whose epoch.prev is covered
+        by the committed checkpoint, remembering it as the replay
+        base."""
+        buf = self._buf
+        if not buf:
+            return
+        cut, base = -1, None
+        for i, (_seq, m) in enumerate(buf):
+            if isinstance(m, Barrier) and m.epoch.prev <= committed_epoch:
+                cut, base = i, m
+        for _ in range(cut + 1):
+            buf.popleft()
+        if base is not None:
+            self._base_barrier = base
 
     async def connect(self) -> "RemoteOutput":
         self._reader, self._writer = await asyncio.open_connection(
@@ -143,17 +199,18 @@ class RemoteOutput:
         except (asyncio.IncompleteReadError, ConnectionResetError,
                 OSError):
             pass
+        except asyncio.CancelledError:
+            return        # rewind replaces the loop without killing the leg
         finally:
-            # a sender parked on the credit wait must FAIL, not hang
-            # forever, once the receiver is gone (recovery teardown
-            # otherwise deadlocks: receiver waits for this socket to
-            # close while we wait for its credits)
+            # a sender parked on the credit wait must WAKE once the
+            # receiver is gone: legacy senders fail fast (recovery
+            # teardown otherwise deadlocks — receiver waits for this
+            # socket to close while we wait for its credits); replay
+            # senders park until rewind_replay re-establishes the leg
             self._dead = True
             self._credit_evt.set()
 
-    async def send(self, msg) -> None:
-        if self._dead:
-            raise ConnectionResetError("remote receiver is gone")
+    async def _write_msg(self, msg) -> None:
         if isinstance(msg, StreamChunk):
             while self._credits <= 0:     # permit-based backpressure
                 if self._dead:
@@ -173,6 +230,93 @@ class RemoteOutput:
                 "val": int(msg.val)}).encode())
         else:
             raise ValueError(f"unsendable message {type(msg)}")
+
+    async def send(self, msg) -> None:
+        from ..utils.faults import FAULTS
+        seq = None
+        if self._buf is not None:
+            self._seq += 1
+            seq = self._seq
+            # buffer BEFORE the (possibly failing) write: a message
+            # parked behind a dead socket is already covered by the
+            # next rewind's replay
+            self._buf.append((seq, msg))
+            if FAULTS.active and FAULTS.hit(
+                    "dcn_drop", port=self.port,
+                    worker=WORKER_ID) is not None:
+                # sever this leg mid-epoch: the write path below sees a
+                # closed socket, parks, and waits for the recovery
+                # rewind — exactly a mid-flight DCN cable pull
+                try:
+                    self._writer.close()
+                except Exception:  # noqa: BLE001
+                    pass
+        while True:
+            if self._dead or self._rewinding:
+                if self._buf is None:
+                    raise ConnectionResetError("remote receiver is gone")
+                # replay mode: park until rewind_replay re-establishes
+                # the leg (recovery teardown cancels parked sends)
+                self._credit_evt.clear()
+                await self._credit_evt.wait()
+                continue
+            if seq is not None and seq <= self._sent_through:
+                return        # a rewind already wrote this message
+            try:
+                await self._write_msg(msg)
+                if seq is not None:
+                    self._sent_through = seq
+                return
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                self._dead = True
+                if self._buf is None:
+                    raise
+
+    async def rewind_replay(self, host=None, port=None) -> int:
+        """Per-worker partial recovery: re-feed the uncommitted suffix
+        to a REBUILT consumer. With host/port the leg reconnects (the
+        consumer was re-placed onto a fresh RemoteInput server —
+        possibly loopback); without, a dead socket reconnects to the
+        SAME endpoint (severed leg, consumer rebuilt in place behind
+        its surviving server) and a live socket is reused in-band. The
+        'R' frame carries the committed base barrier (the consumer
+        synthesizes the INITIAL from it and discards everything queued
+        before it), then the buffered suffix follows, then `send`
+        resumes live. Returns the number of replayed messages."""
+        assert self._buf is not None, "replay not enabled on this leg"
+        self._rewinding = True      # live sends park until the suffix
+        try:                        # has streamed in order
+            if self._credit_task is not None:
+                self._credit_task.cancel()
+            if host is not None or self._dead:
+                try:
+                    self._writer.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                if host is not None:
+                    self.host, self.port = host, port
+                self._reader, self._writer = await asyncio.open_connection(
+                    self.host, self.port)
+            self._credits = 0
+            self._dead = False
+            self._credit_task = asyncio.create_task(self._credit_loop())
+            base = self._base_barrier
+            await _write_frame(self._writer, b"R", json.dumps(
+                {"curr": base.epoch.curr, "prev": base.epoch.prev,
+                 "inject_ns": base.inject_time_ns}
+                if base is not None else {}).encode())
+            n = 0
+            for seq, msg in list(self._buf):
+                await self._write_msg(msg)
+                self._sent_through = max(self._sent_through, seq)
+                n += 1
+            return n
+        finally:
+            self._rewinding = False
+            # wake any send parked across the rewind: either its
+            # message was covered by the replay, or the leg is live
+            # again and it writes in order behind the suffix
+            self._credit_evt.set()
 
     async def close(self) -> None:
         if self._credit_task:
@@ -200,13 +344,30 @@ class RemoteInput(Executor):
         self._queue: asyncio.Queue = asyncio.Queue()
         self._server = None
         self._conn_writer = None
+        # per-worker partial recovery: a rebuilt consumer reading a
+        # SURVIVING server arms this flag — everything queued before
+        # the producer's 'R' rewind frame belongs to the dead
+        # incarnation and is discarded at recv
+        self._await_rewind = False
+        # barriers of the DROPPED epochs (committed < curr <= ceiling)
+        # are filtered: a rebuilt source peer joins the live stream
+        # directly, so replaying dead barriers on this leg would leave
+        # merges misaligned forever (see Channel.begin_replay)
+        self.stale_ceiling = None
+
+    def expect_rewind(self, stale_ceiling=None) -> None:
+        self._await_rewind = True
+        if stale_ceiling is not None:
+            self.stale_ceiling = stale_ceiling
 
     async def start(self) -> "RemoteInput":
         async def handle(reader, writer):
             if self._conn_writer is not None:
                 # one producer per input (fan-in uses one RemoteInput per
-                # upstream edge) — a second connection would steal the
-                # credit channel and deadlock the first sender
+                # upstream edge) — a second LIVE connection would steal
+                # the credit channel and deadlock the first sender; a
+                # dead producer's slot frees below so a rewound or
+                # re-placed producer can re-attach
                 writer.close()
                 return
             self._conn_writer = writer
@@ -216,9 +377,20 @@ class RemoteInput(Executor):
             try:
                 while True:
                     tag, payload = await _read_frame(reader)
+                    if tag == b"R":
+                        # rewind: grant a fresh window HERE (the read
+                        # loop), so the producer's replayed chunks flow
+                        # before the rebuilt consumer even spawns
+                        await _write_frame(
+                            writer, b"K",
+                            struct.pack("!I", self.queue_depth))
                     await self._queue.put((tag, payload))
-            except (asyncio.IncompleteReadError, ConnectionResetError):
+            except (asyncio.IncompleteReadError, ConnectionResetError,
+                    OSError):
                 await self._queue.put((b"X", b""))
+            finally:
+                if self._conn_writer is writer:
+                    self._conn_writer = None
 
         self._server = await asyncio.start_server(handle, self.host,
                                                   self.port)
@@ -248,6 +420,19 @@ class RemoteInput(Executor):
         from ..common.types import DataType
         while True:
             tag, payload = await self._queue.get()
+            if self._await_rewind and tag != b"R":
+                # rebuilt consumer on a surviving server: everything
+                # queued before the producer's rewind frame belongs to
+                # the dead incarnation (incl. its X disconnect marker)
+                continue
+            if tag == b"R":
+                self._await_rewind = False
+                d = json.loads(payload)
+                if not d:
+                    continue    # no committed base: the suffix is whole
+                return Barrier(EpochPair(d["curr"], d["prev"]),
+                               BarrierKind.INITIAL, None, (),
+                               d.get("inject_ns", 0))
             if tag == b"X":
                 raise ConnectionResetError(
                     "remote exchange producer went away")
@@ -262,6 +447,16 @@ class RemoteInput(Executor):
                 return chunk
             if tag == b"B":
                 d = json.loads(payload)
+                if self.stale_ceiling is not None \
+                        and d["curr"] <= self.stale_ceiling \
+                        and BarrierKind(d["kind"]) \
+                        is not BarrierKind.INITIAL:
+                    # a dead epoch's barrier (see above) — but never
+                    # the INITIAL a rebuilt producer propagates at the
+                    # committed base (it necessarily sits below the
+                    # ceiling, and the consumer's chain initializes on
+                    # it before any recomputed chunk)
+                    continue
                 return Barrier(EpochPair(d["curr"], d["prev"]),
                                BarrierKind(d["kind"]),
                                mutation=_de_mutation(d["mutation"]))
@@ -274,6 +469,8 @@ class RemoteInput(Executor):
         from ..common.types import DataType
         while True:
             tag, payload = await self._queue.get()
+            if tag == b"R":
+                continue      # rewinds are a recv()-path (cluster) affair
             if tag == b"X":
                 return
             if tag == b"C":

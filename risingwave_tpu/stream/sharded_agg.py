@@ -65,6 +65,54 @@ from .executor import Executor
 from .hash_agg import AggState, HashAggExecutor
 
 
+class MeshIngestLog:
+    """Host-side per-interval ingest snapshot of a fused mesh fragment —
+    the mesh-plane REPLAY POINT. Every chunk entering the fused
+    shard_map program is also retained here BY REFERENCE (device arrays
+    are immutable and the ingest path never donates them, so holding
+    them moves no data), stamped with the epoch its barrier seals, and
+    dropped when that epoch COMMITS — the coordinator trims this log
+    through the same pulse that trims the exchange replay buffers
+    (plan/build.py registers it next to the fragment's channels). The
+    log therefore always holds exactly the uncommitted ingest suffix,
+    bounded by `checkpoint_max_inflight`; a mesh fragment failure
+    re-runs the fused program from the committed epoch over this
+    suffix (delivered back through the armed frontier channels) instead
+    of tearing down the deployment. A hard cap backstops executors
+    driven without a coordinator (engine-level tests)."""
+
+    HARD_CAP = 8
+    replay_enabled = True
+
+    def __init__(self):
+        from collections import deque
+        self._pending: list = []
+        self._log = deque()
+
+    def note(self, item) -> None:
+        self._pending.append(item)
+
+    def seal(self, epoch: int) -> None:
+        """Stamp the open interval's ingests with the epoch its barrier
+        seals (called from the executor's barrier-time persist)."""
+        if self._pending:
+            self._log.append((epoch, self._pending))
+            self._pending = []
+            while len(self._log) > self.HARD_CAP:
+                self._log.popleft()
+
+    def trim_replay(self, committed_epoch: int) -> None:
+        while self._log and self._log[0][0] <= committed_epoch:
+            self._log.popleft()
+
+    def entries(self) -> list:
+        return list(self._log)
+
+    def chunk_count(self) -> int:
+        return sum(len(chunks) for _, chunks in self._log) \
+            + len(self._pending)
+
+
 class ShardedHashAggExecutor(HashAggExecutor):
     """HashAgg over `mesh`: state sharded on the vnode axis, input routed
     to its owner shard by the fused in-mesh shuffle (or replicated and
@@ -197,6 +245,9 @@ class ShardedHashAggExecutor(HashAggExecutor):
             out_specs=(shard, shard, shard, shard), **mesh_kw),
             name="sharded_agg_persist_view")
 
+        # mesh-plane replay point: the uncommitted ingest suffix, held
+        # host-side by reference (see MeshIngestLog)
+        self.ingest_log = MeshIngestLog()
         # per-shard watchdog accumulators replace the parent's scalars
         sharding = NamedSharding(mesh, P(VNODE_AXIS))
         self._overflow_dev = jax.device_put(
@@ -275,6 +326,11 @@ class ShardedHashAggExecutor(HashAggExecutor):
         if not p:
             return
         self._pending_chunks = []
+        # replay point: retain the interval's ingest BEFORE the fused
+        # program consumes it (references only — chunks are never
+        # donated on the ingest path)
+        for ch in p:
+            self.ingest_log.note(ch)
         if len(p) == 1 or not self._fused_eligible(p[0]):
             self._mem_check_reload(p)
             for ch in p:
@@ -335,6 +391,9 @@ class ShardedHashAggExecutor(HashAggExecutor):
         parent's, the device views dispatch AT the barrier and the
         blocking fetch + writes + commit defer to the store (drained by
         the background uploader in pipelined mode)."""
+        # stamp the interval's replay point with the epoch this barrier
+        # seals; the coordinator drops it when that epoch commits
+        self.ingest_log.seal(barrier.epoch.prev)
         if self.state_table is None:
             return
         from ..utils.d2h import (fetch_flat, finish_prefix_groups,

@@ -74,6 +74,10 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
                 "unchecked — transfer-free pipelines must use slack 0 "
                 "(zero-drop sizing)")
         self.mesh_shuffle_applies = 0
+        # mesh-plane replay point (sharded_agg.py MeshIngestLog): the
+        # uncommitted (side, chunk) ingest suffix, held by reference
+        from .sharded_agg import MeshIngestLog
+        self.ingest_log = MeshIngestLog()
         super().__init__(left, right, **kwargs)
         shard, repl = P(VNODE_AXIS), P()
 
@@ -145,6 +149,10 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
                 applies[key] = (make_apply_fused(side, mf) if fused
                                 else make_apply(side, mf))
             if fused:
+                # replay point: retain the ingest by reference before
+                # the fused program consumes it (sharded_agg.py
+                # MeshIngestLog — the mesh-plane uncommitted suffix)
+                self.ingest_log.note((side, chunk))
                 (own2, odeg, cols, ops, vis, errs2, self._dropped_dev,
                  n) = applies[key](own, other, errs, self._dropped_dev,
                                    chunk, wm)
@@ -231,6 +239,9 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
         from ..common.chunk import OP_DELETE, OP_INSERT
         from ..utils.d2h import (fetch_flat, finish_prefix_groups,
                                  prepare_prefix_groups)
+        # stamp the interval's replay point with the epoch this barrier
+        # seals; the coordinator drops it when that epoch commits
+        self.ingest_log.seal(barrier.epoch.prev)
         tables = [st for st in (self.state_tables[LEFT],
                                 self.state_tables[RIGHT]) if st is not None]
         if not tables:

@@ -36,6 +36,20 @@ Fault points (site → effect when the rule fires):
                   fail-stops the next injection exactly like an upload
                   failure; the re-delivered batch dedupes on the seq
                   persisted in the topic; filter `topic=`/`seq=`)
+  dcn_drop        stream/remote_exchange.py RemoteOutput.send (WORKER
+                  process; the spec rides the cluster config push) —
+                  severs one DCN output leg mid-epoch by closing its
+                  socket: the producer parks on the dead leg, the
+                  consumer dies on the disconnect and its worker
+                  reports the failed actor ids, and per-worker partial
+                  recovery rewinds the leg (filter `port=`)
+  worker_crash_partial  cluster/compute_node.py _on_committed (WORKER
+                  process) — hard-kills the worker (os._exit) at the
+                  k-th sealed report (`at=k`; context `seals=` carries
+                  the running count), so a real mid-epoch worker death
+                  is deterministic: meta's connection loss marks the
+                  handle dead and the worker radius re-places its
+                  actors onto the survivors
 
 Spec grammar (one statement, deterministic by construction — rules fire
 on exact occurrence counts, never on wall clock):

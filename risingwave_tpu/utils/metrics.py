@@ -308,13 +308,18 @@ MESH_SHUFFLE_DROPPED = GLOBAL_METRICS.counter(
     "mesh_shuffle_dropped_rows_total")
 
 # Recovery plane (frontend/session.py): every auto-recovery increments
-# `recovery_total{scope=fragment|full,cause=...}` (labelled series ride
-# alongside these process totals) and observes its wall-clock duration;
-# tick's exponential backoff between attempts accumulates into the
-# backoff counter. Buckets reach low because a per-fragment rebuild on a
-# warm process is milliseconds while a full DDL replay is seconds.
+# `recovery_total{scope=fragment|cone|mesh|worker|full,cause=...}`
+# (labelled series ride alongside these process totals) and observes
+# its wall-clock duration; tick's exponential backoff between attempts
+# accumulates into the backoff counter. Buckets reach low because a
+# per-fragment rebuild on a warm process is milliseconds while a full
+# DDL replay is seconds. `recovery_flapping{cause}` flips to 1 when a
+# cause recovers more than `recovery_flap_threshold` times within the
+# trailing window below — the rate then escalates the backoff base and
+# /healthz reports `degraded`.
 RECOVERY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                     5.0, 10.0, 30.0)
+RECOVERY_FLAP_WINDOW_S = 30.0
 RECOVERY_TOTAL = GLOBAL_METRICS.counter("recovery_total")
 RECOVERY_DURATION = GLOBAL_METRICS.histogram(
     "recovery_duration_seconds", buckets=RECOVERY_BUCKETS)

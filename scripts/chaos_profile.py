@@ -13,13 +13,26 @@ logstore gate use):
                    replay the in-flight interval
   poison_chunk     corrupt payload kills the CONSUMING (materialize)
                    actor -> same partial scope
-  agg_actor_crash  actor exception UPSTREAM (hash_agg fragment, which
-                   has a downstream consumer) -> full recovery
+  interior_crash   actor exception at an INTERIOR fragment (hash_agg,
+                   which has a downstream consumer) -> scope=CONE: the
+                   agg AND its downstream materialize rebuild together,
+                   the upstream source/project chain keeps its device
+                   state, the cone's inbound frontier replays
+  mesh_crash       the FUSED MESH fragment (streaming_parallelism_
+                   devices=2 on the virtual mesh) crashes -> scope=MESH:
+                   the fused program re-runs from the committed epoch
+                   over the replayed ingest instead of tearing the
+                   deployment down
+  dcn_drop         2-WORKER cluster run: one DCN output leg severed
+                   mid-epoch -> scope=WORKER: the dead leg's consumer
+                   closure rebuilds in place, the surviving producer
+                   rewinds its replay buffer into the rebuilt consumer,
+                   survivors' stores stay open
   upload_fail      checkpoint upload raises -> fail-stop -> full
                    recovery from the committed epoch
-  kill_during_recovery  agg crash + a second crash injected MID
-                   DDL-REPLAY inside the first recovery -> the retry
-                   converges (recovery re-entrancy)
+  kill_during_recovery  interior crash + crashes injected inside BOTH
+                   recovery paths (mid cone rebuild, then mid
+                   DDL-replay) -> the retry converges (re-entrancy)
   channel_stall    the consumer parks 400ms on one chunk -> NO recovery,
                    the barrier just completes late
   upload_delay     the checkpoint upload sleeps 400ms -> NO recovery,
@@ -44,12 +57,14 @@ Exits non-zero unless ALL hold:
   * every run converges BIT-IDENTICAL to the generator-prefix oracle:
     the MV's rows equal a numpy recount of the bid generator prefix at
     the committed source offset (window_end -> max(price));
-  * the single-fragment faults recover at scope=fragment and rebuild
-    STRICTLY FEWER actors than the full-recovery runs (asserted on the
-    actor-id sets reported in last_recovery);
-  * fragment-scope recovery p50 beats the full-recovery p50 on the same
-    shape AND stays under the absolute budget (0.5s on CPU — a partial
-    rebuild is host-side re-wiring plus state reload, not a DDL replay);
+  * every CONTAINED fault recovers at its named scope — fragment, cone,
+    mesh, worker — with the matching recovery_total{scope=...} label in
+    /metrics, and rebuilds a STRICT SUBSET of the topology's actors
+    (asserted on the actor-id sets reported in last_recovery);
+  * fragment/cone/worker-scope recovery p50s beat the full-recovery p50
+    AND fragment stays under the absolute budget (0.5s on CPU — a
+    partial rebuild is host-side re-wiring plus state reload, not a
+    DDL replay);
   * recovery_total{scope=...,cause=...} and recovery_duration_seconds
     render in /metrics, and /healthz carries the last-recovery fields
     (scope/cause/duration) — recovery is observable end to end.
@@ -62,11 +77,22 @@ CI usage (CPU backend):
 import asyncio
 import json
 import os
+import socket
+import subprocess
 import sys
+import time
 import urllib.request
 from collections import Counter
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the mesh_crash class needs a multi-device mesh on the CPU backend
+# (same virtual-device trick as tests/conftest.py) — must precede any
+# jax import
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 
 from risingwave_tpu.utils.compile_cache import enable_persistent_cache  # noqa: E402
 
@@ -122,7 +148,7 @@ def _committed_offset(session) -> int:
     raise AssertionError("no source executor")
 
 
-async def _run_fault(name: str, tmp: str, arm) -> dict:
+async def _run_fault(name: str, tmp: str, arm, pre_ddl=()) -> dict:
     """One fresh durable session, one injected fault class: warm up,
     arm the injector, tick through the fault and its recovery, then
     verify convergence against the oracle. `arm(session) -> spec`."""
@@ -131,6 +157,8 @@ async def _run_fault(name: str, tmp: str, arm) -> dict:
     store = HummockStateStore(
         LocalFsObjectStore(os.path.join(tmp, name)))
     s = Session(store=store)
+    for sql in pre_ddl:
+        await s.execute(sql)
     for sql in _ddl():
         await s.execute(sql)
     await s.tick(3)
@@ -298,6 +326,128 @@ async def _run_broker_faults(tmp: str) -> list:
     return out
 
 
+def _mesh_actor(session) -> int:
+    """The fused mesh fragment's actor (the agg lowered onto the
+    virtual device mesh under streaming_parallelism_devices=2)."""
+    dep = session.catalog.mvs["q7w"].deployment
+    assert dep.mesh_actor_ids, "no mesh fragment deployed"
+    return dep.mesh_actor_ids[0]
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_worker(port: int) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "risingwave_tpu.worker", str(port)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=1).close()
+            return p
+        except OSError:
+            time.sleep(0.2)
+    p.terminate()
+    raise RuntimeError("worker never started listening")
+
+
+async def _run_cluster_dcn(tmp: str) -> dict:
+    """The WORKER radius over a real 2-worker cluster: sever one DCN
+    output leg mid-epoch (dcn_drop, armed on the workers through the
+    cluster config push) — the consumer's downstream closure rebuilds
+    in place at scope=worker, the surviving producer rewinds its
+    replay buffer into the rebuilt consumer, survivors keep their
+    store objects, and the MV converges bit-identical to the
+    generator-prefix oracle at the committed per-split offsets."""
+    import numpy as np
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+    ports = [_free_port(), _free_port()]
+    procs = [_spawn_worker(p) for p in ports]
+    try:
+        s = Session(store=HummockStateStore(
+            LocalFsObjectStore(os.path.join(tmp, "dcn"))))
+        addr = ",".join(f"127.0.0.1:{p}" for p in ports)
+        await s.execute(f"SET cluster = '{addr}'")
+        await s.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+            "chunk_size=256, splits=2, rate_limit=512)")
+        await s.execute(
+            "CREATE MATERIALIZED VIEW agg AS SELECT auction, "
+            "count(*) AS n, max(price) AS mx FROM bid GROUP BY auction")
+        for _ in range(4):
+            await asyncio.wait_for(s.tick(), 60)
+        all_actors = sorted(
+            a for dep in s.cluster.deployments.values()
+            for ids in dep.rebuild_info["actors"].values() for a in ids)
+        await s.execute("SET fault_injection = 'dcn_drop:at=3'")
+        for _ in range(6):
+            await asyncio.wait_for(s.tick(max_recoveries=4), 60)
+        await s.execute("SET fault_injection = ''")
+        await asyncio.wait_for(s.tick(2), 60)
+
+        got = sorted(s.query("SELECT auction, n, mx FROM agg"))
+        # generator-prefix oracle at the committed per-split offsets
+        from risingwave_tpu.common.types import (DataType, Field,
+                                                 Schema)
+        from risingwave_tpu.connectors import NexmarkGenerator
+        from risingwave_tpu.state.state_table import StateTable
+        from risingwave_tpu.state.storage_table import StorageTable
+        sch = Schema((Field("split_id", DataType.INT64),
+                      Field("offset", DataType.INT64)))
+        offsets = {}
+        for tid in range(1, 40):
+            st = StateTable(s.store, table_id=tid, schema=sch,
+                            pk_indices=(0,))
+            try:
+                rows = list(StorageTable.for_state_table(st).batch_iter())
+            except Exception:  # noqa: BLE001 — not this table's layout
+                continue
+            if rows and all(len(r) == 2 for r in rows) \
+                    and {r[0] for r in rows} <= {0, 1}:
+                offsets = {int(k): int(v) for k, v in rows}
+                break
+        gen = NexmarkGenerator("bid", chunk_size=1 << 16)
+        c = gen.next_chunk()
+        auction = np.asarray(c.columns[0].data)
+        price = np.asarray(c.columns[2].data)
+        idx = []
+        for k, off in offsets.items():
+            for j in range(off // 256):
+                b = j * 2 + k
+                idx.extend(range(b * 256, (b + 1) * 256))
+        idx = np.asarray(sorted(idx), dtype=np.int64)
+        a, p = auction[idx], price[idx]
+        cnt = Counter(a.tolist())
+        mx: dict = {}
+        for ai, pi in zip(a.tolist(), p.tolist()):
+            mx[ai] = max(mx.get(ai, 0), pi)
+        oracle = sorted((k, cnt[k], mx[k]) for k in cnt)
+        out = {
+            "fault": "dcn_drop",
+            "converged": got == oracle and bool(offsets),
+            "mv_rows": sum(g[1] for g in got),
+            "recoveries": s.recoveries,
+            "last_recovery": s.last_recovery,
+            "total_actors": all_actors,
+        }
+        await s.shutdown()
+        return out
+    finally:
+        for p_ in procs:
+            if p_.poll() is None:
+                p_.terminate()
+
+
 async def main() -> int:
     import tempfile
     tmp = tempfile.mkdtemp(prefix="chaos_profile_")
@@ -310,60 +460,88 @@ async def main() -> int:
         "poison_chunk", tmp,
         lambda s: f"poison_chunk:actor={_mv_actor(s)},at=3"))
     results.append(await _run_fault(
-        "agg_actor_crash", tmp,
+        "interior_crash", tmp,
         lambda s: f"actor_crash:actor={_agg_actor(s)},at=2"))
+    results.append(await _run_fault(
+        "mesh_crash", tmp,
+        lambda s: f"actor_crash:actor={_mesh_actor(s)},at=2",
+        pre_ddl=("SET streaming_parallelism_devices = 2",)))
     results.append(await _run_fault(
         "upload_fail", tmp, lambda s: "upload_fail:at=1"))
     results.append(await _run_fault(
         "kill_during_recovery", tmp,
         lambda s: (f"actor_crash:actor={_agg_actor(s)},at=2;"
+                   "recovery_crash:phase=partial,at=1;"
                    "recovery_crash:phase=full,at=1")))
     results.append(await _run_fault(
         "channel_stall", tmp,
         lambda s: f"channel_stall:actor={_mv_actor(s)},at=2,ms=400"))
     results.append(await _run_fault(
         "upload_delay", tmp, lambda s: "upload_delay:at=1,ms=400"))
+    dcn = await _run_cluster_dcn(tmp)
+    results_cluster = [dcn]
     broker_results = await _run_broker_faults(tmp)
-    for r in results + broker_results:
+    for r in results + results_cluster + broker_results:
         print(json.dumps(r))
 
     by_name = {r["fault"]: r for r in results}
     frag_runs = [by_name["mv_actor_crash"], by_name["poison_chunk"]]
-    full_runs = [by_name["agg_actor_crash"], by_name["upload_fail"],
-                 by_name["kill_during_recovery"]]
+    cone_runs = [by_name["interior_crash"]]
+    mesh_runs = [by_name["mesh_crash"]]
+    full_runs = [by_name["upload_fail"], by_name["kill_during_recovery"]]
+    contained = frag_runs + cone_runs + mesh_runs + [dcn]
 
     def _p50(runs):
         xs = sorted(r["last_recovery"]["duration_s"] for r in runs)
         return xs[len(xs) // 2]
 
     frag_p50 = _p50(frag_runs)
+    cone_p50 = _p50(cone_runs)
+    worker_p50 = _p50([dcn])
     full_p50 = _p50(full_runs)
     stall = by_name["channel_stall"]
     delay = by_name["upload_delay"]
+    # scope labels land in the process-global registry as the runs go
+    from risingwave_tpu.utils.metrics import GLOBAL_METRICS
+    final_metrics = GLOBAL_METRICS.render_prometheus()
     verdict = {
-        "all_converged": all(r["converged"] for r in results),
+        "all_converged": all(r["converged"]
+                             for r in results + results_cluster),
         "delay_no_recovery": delay["recoveries"] == 0,
         "fragment_scope": all(
             r["last_recovery"]["scope"] == "fragment" for r in frag_runs),
-        "fragment_rebuilds_strictly_fewer": all(
+        "cone_scope": all(
+            r["last_recovery"]["scope"] == "cone" for r in cone_runs),
+        "mesh_scope": all(
+            r["last_recovery"]["scope"] == "mesh" for r in mesh_runs),
+        "worker_scope": dcn["last_recovery"]["scope"] == "worker",
+        # every contained radius rebuilds a STRICT subset of the actors
+        "contained_rebuild_strictly_fewer": all(
             set(r["last_recovery"]["actors"]) < set(r["total_actors"])
-            for r in frag_runs),
+            for r in contained),
         "full_scope": all(
             r["last_recovery"]["scope"] == "full"
             and set(r["last_recovery"]["actors"]) == set(r["total_actors"])
             for r in full_runs),
         "stall_no_recovery": stall["recoveries"] == 0,
         "fragment_recovery_p50_s": round(frag_p50, 5),
+        "cone_recovery_p50_s": round(cone_p50, 5),
+        "worker_recovery_p50_s": round(worker_p50, 5),
         "full_recovery_p50_s": round(full_p50, 5),
         "fragment_beats_full": frag_p50 < full_p50,
+        "cone_beats_full": cone_p50 < full_p50,
+        "worker_beats_full": worker_p50 < full_p50,
         "fragment_under_budget": frag_p50 < FRAGMENT_P50_BUDGET_S,
+        "scope_labels_in_metrics": all(
+            f'scope="{sc}"' in final_metrics
+            for sc in ("fragment", "cone", "mesh", "worker", "full")),
         "recovery_metrics_visible": all(
             r["metrics_recovery_total"] and r["metrics_recovery_duration"]
             for r in results),
         "healthz_last_recovery": all(
             r["healthz_last_recovery"] is not None
             and "scope" in r["healthz_last_recovery"]
-            for r in frag_runs + full_runs),
+            for r in frag_runs + cone_runs + mesh_runs + full_runs),
         # external ingress/egress faults take the fail-stop -> recovery
         # path (never a hang) and converge exactly-once
         "broker_faults_converged": all(

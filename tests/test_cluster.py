@@ -312,6 +312,55 @@ async def test_worker_kill_auto_recovery_converges(tmp_path,
     await _step(s.shutdown())
 
 
+async def test_single_worker_kill_partial_recovery(tmp_path,
+                                                   two_workers):
+    """The per-worker recovery radius: killing ONE compute node
+    re-places only its actors (plus their downstream closure) onto the
+    survivor — scope=worker, strictly fewer actors than the topology,
+    the survivor's STORE OBJECT stays open across the recovery (no
+    reset+reopen), and the MV converges bit-identical to the
+    generator-prefix oracle at the committed offsets."""
+    ports, procs = two_workers
+    s = await _cluster_session(tmp_path, ports)
+    for d in AGG_DDL:
+        await _step(s.execute(d))
+    for _ in range(4):
+        await _step(s.tick())
+    h1 = s.cluster.workers[1]
+    store_id_before = (await _step(
+        h1.call("ping", timeout=10)))["store_id"]
+    all_actors = sorted(
+        a for dep in s.cluster.deployments.values()
+        for ids in dep.rebuild_info["actors"].values() for a in ids)
+
+    procs[1].kill()
+    procs[1].wait(timeout=10)
+    for _ in range(5):
+        await _step(s.tick(max_recoveries=4))
+
+    assert s.recoveries == 1
+    assert s.last_recovery["scope"] == "worker"
+    assert s.last_recovery["cause"] == "worker_death"
+    rebuilt = set(s.last_recovery["actors"])
+    assert rebuilt < set(all_actors), (rebuilt, all_actors)
+    # the survivor kept its store OBJECT — partial recovery re-points
+    # it at the committed manifest instead of reset+reopen
+    store_id_after = (await _step(
+        h1.call("ping", timeout=10)))["store_id"]
+    assert store_id_after == store_id_before
+    rows = await _step(s.execute("SHOW cluster"))
+    assert [r[2] for r in rows] == ["alive"], rows
+    got = sorted(s.query("SELECT auction, n, mx FROM agg"))
+    offsets = _split_offsets(s)
+    assert got == _agg_oracle(offsets)
+    # keeps converging with more progress
+    for _ in range(2):
+        await _step(s.tick())
+    got = sorted(s.query("SELECT auction, n, mx FROM agg"))
+    assert got == _agg_oracle(_split_offsets(s))
+    await _step(s.shutdown())
+
+
 async def test_cluster_hbm_budget_partitioned_and_show_memory(
         tmp_path, two_workers):
     """`SET hbm_budget_bytes` on the meta session partitions evenly
