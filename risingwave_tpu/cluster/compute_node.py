@@ -584,12 +584,36 @@ class ComputeNode:
         producer leg into its rebuilt consumer (all workers' actors are
         live by now; live sends on a rewinding leg park until the
         suffix is through, so the consumer sees committed-base INITIAL,
-        suffix, live — in order)."""
+        suffix, live — in order). Legs rewind CONCURRENTLY — they are
+        independent ordered streams, and exactly one task drains each
+        leg's suffix sequentially, so per-leg frame order is preserved
+        while the wall clock is the slowest leg instead of the sum
+        (serial streaming was the PR 11 follow-up in ROADMAP 2e).
+
+        The LAST leg is awaited as a bare coroutine, not a task: the
+        replayed suffix wakes the rebuilt consumer actors, whose first
+        dispatch can compile for seconds — a task-based resume (plain
+        gather) queues this handler's response BEHIND that compile and
+        charges it to the recovery window. A direct await resumes the
+        handler synchronously after the leg's final write, and awaiting
+        the (by then usually done) head tasks returns without yielding,
+        so the response beats the compile exactly like the old serial
+        path did."""
         rewinds, self._pending_rewinds = \
             getattr(self, "_pending_rewinds", []), []
         replayed = 0
-        for out, host, port in rewinds:
-            replayed += await out.rewind_replay(host, port)
+        if rewinds:
+            *head, (lout, lhost, lport) = rewinds
+            tasks = [asyncio.create_task(out.rewind_replay(host, port))
+                     for out, host, port in head]
+            try:
+                replayed += await lout.rewind_replay(lhost, lport)
+            except BaseException:
+                for t in tasks:
+                    t.cancel()
+                raise
+            for t in tasks:
+                replayed += await t
         return {"replayed": replayed}
 
     # ------------------------------------------------------------ teardown

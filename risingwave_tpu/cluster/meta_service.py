@@ -680,9 +680,12 @@ class ClusterManager:
                          stale_ceiling=plan["stale_ceiling"])
         # 4. phase 3: with EVERY worker's rebuilt consumers live, the
         # surviving producer legs stream their uncommitted suffix (a
-        # rewind before all spawns could deadlock on the credit window)
-        for h in live:
-            await h.call("partial_rewind", timeout=300)
+        # rewind before all spawns could deadlock on the credit window).
+        # Workers rewind concurrently — each worker in turn fans its
+        # own legs out in parallel (compute_node.rpc_partial_rewind);
+        # per-leg order is preserved because one task owns one leg
+        await asyncio.gather(
+            *(h.call("partial_rewind", timeout=300) for h in live))
         # the new placement is authoritative for any LATER recovery
         for did, dplan in plan["deployments"].items():
             dep = self.deployments.get(did)

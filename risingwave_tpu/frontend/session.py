@@ -221,6 +221,23 @@ class Session:
         # hammering rebuilds), `recovery_flapping{cause}` flips to 1 in
         # /metrics, and /healthz reports `degraded`. 0 disables.
         "recovery_flap_threshold": (3, int),
+        # ---- fault-tolerant storage plane (state/) ----
+        # quarantine repair source: a local-dir backup written by
+        # BACKUP TO (which also sets this). When set, a durably-corrupt
+        # SST restores from its checksum-verified backup copy instead
+        # of crash-looping; '' detaches.
+        "backup_path": ("", str),
+        # background scrubber cadence (state/scrub.py): verify a batch
+        # of manifest-referenced objects + sweep orphan SSTs every N
+        # collected barriers. 0 disables the scrubber.
+        "storage_scrub_interval": (16, int),
+        # objects integrity-verified per scrub pulse
+        "storage_scrub_batch": (2, int),
+        # bounded retry budget of the ResilientObjectStore wrapper: a
+        # transient PUT/GET absorbs up to N-1 retries (seeded backoff +
+        # jitter) below the recovery machinery before it surfaces as a
+        # persistent fail-stop fault
+        "object_store_retries": (4, int),
         # deterministic fault injection (utils/faults.py): named fault
         # points armed by spec, e.g.
         #   SET fault_injection = 'actor_crash:actor=4,at=2'
@@ -288,6 +305,29 @@ class Session:
         self._apply_serving_config()
         self._apply_obs_config()
         self._apply_logstore_config()
+        self._apply_storage_config()
+
+    def _apply_storage_config(self) -> None:
+        """Plumb the storage-plane session vars to the live store +
+        coordinator scrubber (re-applied after auto-recovery swaps the
+        coordinator): scrub cadence, object-store retry budget, and the
+        quarantine repair source (backup_path)."""
+        self.coord.scrubber.configure(
+            interval=self.config.get("storage_scrub_interval", 16),
+            batch=self.config.get("storage_scrub_batch", 2))
+        objects = getattr(self.store, "objects", None)
+        if objects is not None and hasattr(objects, "max_attempts"):
+            objects.max_attempts = max(
+                1, self.config.get("object_store_retries", 4))
+        if hasattr(self.store, "backup_store"):
+            path = self.config.get("backup_path", "")
+            if path:
+                from ..state import LocalFsObjectStore
+                cur = getattr(self.store.backup_store, "root", None)
+                if cur != path:
+                    self.store.backup_store = LocalFsObjectStore(path)
+            else:
+                self.store.backup_store = None
 
     def _apply_memory_config(self) -> None:
         """Plumb the memory session vars to the live coordinator's
@@ -356,23 +396,33 @@ class Session:
         blob = json.dumps({"format": 1, "ddl": self._ddl_log}).encode()
         objects = getattr(self.store, "objects", None)
         if objects is not None:          # Hummock: atomic object swap
-            objects.upload(CATALOG_PATH, blob)
+            # same self-checksummed framing the MANIFEST carries: a
+            # bit-rotted catalog is detected at load, not replayed
+            from ..state.sstable import frame_meta
+            objects.upload(CATALOG_PATH, frame_meta(blob))
         else:                            # in-memory: survives in-process
             self.store._catalog_blob = blob
     def _load_catalog_blob(self):
         objects = getattr(self.store, "objects", None)
         if objects is not None:
             if objects.exists(CATALOG_PATH):
-                return objects.read(CATALOG_PATH)
+                from ..state.sstable import unframe_meta
+                return unframe_meta(objects.read(CATALOG_PATH),
+                                    CATALOG_PATH)
             return None
         return getattr(self.store, "_catalog_blob", None)
 
     async def backup(self, dest_object_store) -> dict:
         """Consistent backup of the session's durable state (manifest,
-        SSTs, catalog/DDL log) into another object store. Holds the
-        coordinator's rounds lock so no sync/compaction/manifest swap
-        runs mid-copy (reference: src/storage/backup/src/, the meta
-        snapshot taken under the barrier manager's pause)."""
+        SSTs, catalog/DDL log) into another object store — INCREMENTAL
+        and generation-stamped: only objects the destination does not
+        already hold at the recorded checksum copy (SSTs are immutable,
+        so a steady-state backup moves just the new generation's
+        objects), each copy read back + verified before it enters the
+        backup ledger (state/backup.py). Holds the coordinator's rounds
+        lock so no sync/compaction/manifest swap runs mid-copy
+        (reference: src/storage/backup/src/, the meta snapshot taken
+        under the barrier manager's pause)."""
         from ..state.backup import backup_objects
         objects = getattr(self.store, "objects", None)
         if objects is None:
@@ -388,17 +438,49 @@ class Session:
             # is (catalog-as-of-start, manifest quiesced): concurrent
             # DDL can only leave unreferenced extra state in the copy,
             # never a catalog pointing at absent state
-            catalog = (objects.read(CATALOG_PATH)
-                       if objects.exists(CATALOG_PATH) else None)
+            extra = ({CATALOG_PATH: objects.read(CATALOG_PATH)}
+                     if objects.exists(CATALOG_PATH) else None)
             # the copy itself runs off-loop so pgwire/sinks/actors stay
             # responsive during a large backup
-            meta = await asyncio.to_thread(
-                backup_objects, objects, dest_object_store,
-                skip=(CATALOG_PATH,))
-            if catalog is not None:
-                dest_object_store.upload(CATALOG_PATH, catalog)
-                meta["objects"] += 1
-            return meta
+            return await asyncio.to_thread(
+                backup_objects, objects, dest_object_store, extra)
+
+    async def restore_from(self, path: str) -> dict:
+        """Cold-start disaster recovery (RESTORE FROM '<path>'): verify
+        EVERY object of the backup against its ledger checksum, copy the
+        verified set into this session's FRESH primary store, re-point
+        the store at the restored manifest, reload the string dictionary
+        and DDL log, then replay the DDL log — the restored session
+        converges from the backup's committed epoch exactly like a
+        normal post-crash recovery. Refuses a non-empty session/store:
+        restoring over a live world would interleave two histories."""
+        from ..state import LocalFsObjectStore
+        from ..state.backup import restore_objects
+        objects = getattr(self.store, "objects", None)
+        if objects is None:
+            raise BindError("restore needs a durable (Hummock) store")
+        if self.catalog.mvs or self.catalog.sinks or self._ddl_log:
+            raise BindError(
+                "RESTORE FROM requires an empty session (no DDL log, "
+                "no live flows) over a fresh store")
+        backup = LocalFsObjectStore(path)
+        # verification + copy run off-loop (reads every backup object)
+        meta = await asyncio.to_thread(restore_objects, backup, objects)
+        # re-point the live handles at the restored world
+        self.store.refresh_manifest()
+        from ..common.types import load_dict_log
+        self.coord.dict_cursor = load_dict_log(objects)
+        self.coord._prev_epoch = max(self.coord._prev_epoch,
+                                     self.store.committed_epoch())
+        blob = self._load_catalog_blob()
+        if blob:
+            self._ddl_log = list(json.loads(blob)["ddl"])
+        # the backup that restored us is by construction a valid
+        # quarantine repair source going forward
+        self.config["backup_path"] = path
+        self._apply_storage_config()
+        await self.recover()
+        return meta
 
     async def recover(self) -> None:
         """Replay the persisted DDL log: re-register sources, re-deploy
@@ -510,6 +592,16 @@ class Session:
                 f"SELECT * FROM {stmt.name}")
         if isinstance(stmt, ast.Insert):
             return self._insert(stmt)
+        if isinstance(stmt, ast.BackupStmt):
+            from ..state import LocalFsObjectStore
+            meta = await self.backup(LocalFsObjectStore(stmt.path))
+            # the backup destination doubles as the quarantine repair
+            # source from here on (SET backup_path to change/detach)
+            self.config["backup_path"] = stmt.path
+            self._apply_storage_config()
+            return meta
+        if isinstance(stmt, ast.RestoreStmt):
+            return await self.restore_from(stmt.path)
         if isinstance(stmt, ast.Explain):
             return self.explain(stmt.stmt)
         if isinstance(stmt, ast.ExplainMv):
@@ -558,6 +650,12 @@ class Session:
                 # commit pulse re-evaluates which durable cursors still
                 # pin changelog retention
                 self._apply_logstore_config()
+            elif stmt.name in ("backup_path", "storage_scrub_interval",
+                               "storage_scrub_batch",
+                               "object_store_retries"):
+                # runtime-mutable on the live store/scrubber: the next
+                # scrub pulse and the next object op see the new policy
+                self._apply_storage_config()
             elif stmt.name == "partial_recovery":
                 # build-time knob: channels allocated after this carry
                 # (or not) the replay buffers; classification also
@@ -848,6 +946,44 @@ class Session:
                                      "-" if lag is None else str(lag)))
                 else:
                     rows.append((n, "-", "-", "-"))
+            return rows
+        if what == "storage":
+            # the storage plane's operator surface: retry/scrub/orphan/
+            # quarantine/backup state as (key, value) rows — the SQL
+            # twin of the storage_* series in /metrics
+            from ..state.backup import load_backup_manifest
+            from ..utils.metrics import (BACKUP_GENERATION,
+                                         OBJECT_RETRIES,
+                                         OBJECT_TMP_SWEPT,
+                                         STORAGE_CRC_RETRIES,
+                                         STORAGE_RESTORED)
+            rows = [("object_store_retries_total",
+                     str(int(OBJECT_RETRIES.value))),
+                    ("object_store_tmp_swept_total",
+                     str(int(OBJECT_TMP_SWEPT.value))),
+                    ("crc_retries_total",
+                     str(int(STORAGE_CRC_RETRIES.value))),
+                    ("restored_from_backup_total",
+                     str(int(STORAGE_RESTORED.value)))]
+            for k, v in sorted(self.coord.scrubber.report().items()):
+                rows.append((f"scrub_{k}", str(v)))
+            q = getattr(self.store, "quarantined", None)
+            if q is not None:
+                rows.append(("quarantined_objects",
+                             ",".join(q) if q else "0"))
+            path = self.config.get("backup_path", "")
+            rows.append(("backup_path", path or "-"))
+            gen = int(BACKUP_GENERATION.value)
+            if path and not gen:
+                # a repair source attached without a backup run this
+                # process: read the generation off the ledger itself
+                try:
+                    from ..state import LocalFsObjectStore
+                    m = load_backup_manifest(LocalFsObjectStore(path))
+                    gen = m["generation"] if m else 0
+                except Exception:  # noqa: BLE001 — display-only
+                    gen = 0
+            rows.append(("backup_generation", str(gen) if gen else "-"))
             return rows
         if what in ("tables", "materialized_views"):
             return [(n,) for n in sorted(self.catalog.mvs)]
@@ -1780,6 +1916,9 @@ class Session:
         # serving across the swap
         self._apply_obs_config()
         self._apply_logstore_config()
+        # fresh scrubber rides the new coordinator; retry budget +
+        # quarantine repair source re-attach to the (surviving) store
+        self._apply_storage_config()
         if self.cluster is not None:
             # prune dead workers, reset survivors (reopen their store
             # handles at the committed manifest, fresh SST blocks) and

@@ -186,6 +186,23 @@ class ExplainMv:
 
 
 @dataclass
+class BackupStmt:
+    """BACKUP TO '<path>' — incremental, generation-stamped, verified
+    copy of the session's durable state into a local-dir object store
+    (state/backup.py). The path also becomes the session's quarantine
+    repair source (backup_path)."""
+    path: str
+
+
+@dataclass
+class RestoreStmt:
+    """RESTORE FROM '<path>' — verify the backup, copy it into this
+    session's FRESH primary store, reload catalog+manifest, replay the
+    DDL log (cold-start disaster recovery)."""
+    path: str
+
+
+@dataclass
 class Show:
     what: str           # sources|tables|materialized_views|sinks|all|<var>
 
@@ -269,6 +286,21 @@ class Parser:
         return stmt
 
     def _statement(self):
+        # BACKUP/RESTORE lead with plain idents (not reserved keywords:
+        # a column named `backup` keeps working everywhere else)
+        t = self.peek()
+        if t.kind == "ident" and t.val == "backup":
+            self.next()
+            self.expect("ident", "to")
+            path = self.expect("str").val
+            self.accept("op", ";")
+            return BackupStmt(path)
+        if t.kind == "ident" and t.val == "restore":
+            self.next()
+            self.expect("kw", "from")
+            path = self.expect("str").val
+            self.accept("op", ";")
+            return RestoreStmt(path)
         if self.accept("kw", "explain"):
             # EXPLAIN MATERIALIZED VIEW <name>: live deployed graph +
             # memory accounting (a bare EXPLAIN CREATE ... still plans

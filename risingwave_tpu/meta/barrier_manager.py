@@ -124,6 +124,12 @@ class BarrierCoordinator:
         # executor is idle — once a budget is configured (Session plumbs
         # hbm_budget_bytes / memory_eviction_policy through).
         self.memory = MemoryManager()
+        # Storage scrubber (state/scrub.py): verifies manifest-referenced
+        # objects and sweeps orphan SSTs on the same between-epochs pulse
+        # the memory manager uses; no-ops on non-durable stores. Session
+        # plumbs storage_scrub_interval / storage_scrub_batch here.
+        from ..state.scrub import StorageScrubber
+        self.scrubber = StorageScrubber(store)
         # Serving authority (serving/manager.py): per-MV snapshot caches
         # advance at every collected barrier — the same between-epochs
         # moment the memory manager uses — so pinned reads always sit on
@@ -592,6 +598,12 @@ class BarrierCoordinator:
         # table state a subscription backfills; everything after is
         # logged once active)
         self.logstore.on_barrier(barrier)
+        # storage scrub pulse (throttled internally): verify a bounded
+        # slice of the referenced objects, account/sweep orphans — in
+        # cluster mode orphans are counted but never deleted (a worker's
+        # in-flight upload is invisible to meta)
+        self.scrubber.on_barrier(barrier.epoch.curr,
+                                 cluster_mode=bool(self.workers))
 
     async def run_rounds(self, n: int, interval_s: Optional[float] = None) -> None:
         """Inject n barriers, waiting for each to complete. The very first

@@ -99,7 +99,20 @@ class MonitorService:
             # fault keeps coming back
             flap = getattr(self._session, "flapping_causes", None)
             causes = flap() if flap is not None else []
-            payload["degraded"] = bool(causes)
+            # storage-plane health (state/hummock.py read-path rules):
+            # a quarantined object means durable corruption was seen —
+            # the session stays DEGRADED (even after a successful
+            # restore-from-backup healed the primary copy) until an
+            # operator inspects the quarantine/ evidence
+            quarantined = list(
+                getattr(self._session.store, "quarantined", ()) or ())
+            if quarantined:
+                payload["storage"] = {
+                    "quarantined": quarantined,
+                    "restored_from_backup": list(getattr(
+                        self._session.store, "restored_objects", ())),
+                }
+            payload["degraded"] = bool(causes) or bool(quarantined)
             if causes:
                 payload["flapping_causes"] = causes
             last = getattr(self._session, "last_recovery", None)

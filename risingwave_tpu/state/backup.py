@@ -1,18 +1,43 @@
 """Backup / restore of a Hummock deployment (manifest + SSTs + catalog).
 
 Reference: src/storage/backup/src/ (meta snapshot + SST manifest backup,
-restored into a fresh cluster). Here a backup is an object-store-level
-copy taken in dependency order — SSTs first, the MANIFEST and CATALOG
-last — so the copied manifest can only reference SSTs that were already
-copied (SST files are immutable once uploaded; the manifest swap is the
-only mutation). Callers must quiesce compaction/sync for full
-consistency; `Session.backup()` takes the coordinator's rounds lock to
-guarantee it.
+restored into a fresh cluster). A backup is an object-store-level copy
+taken in dependency order — SSTs first, the MANIFEST and CATALOG last —
+so the copied manifest can only reference SSTs that were already copied
+(SST files are immutable once uploaded; the manifest swap is the only
+mutation). Callers must quiesce compaction/sync for full consistency;
+`Session.backup()` takes the coordinator's rounds lock to guarantee it.
+
+The copy is **incremental and generation-stamped**: every run bumps a
+backup generation and copies ONLY objects the destination does not
+already hold at the recorded checksum (SST immutability means a
+same-name same-crc object never needs recopying; mutable objects —
+MANIFEST, CATALOG, the dict log head, DML jsonl tails — recopy when
+their crc moved). Each copied object is read back from the destination
+and verified before it enters the backup manifest, and every restore
+re-verifies EVERY recorded object against its crc — a corrupted backup
+refuses loudly (`BackupCorruption`) instead of cold-starting a wrong
+world. Objects the source dropped since the previous generation
+(compaction victims) are pruned from the destination only AFTER the new
+backup manifest is durable, mirroring the manifest-swap-then-delete
+rule of the store itself.
 """
 
 from __future__ import annotations
 
+import json
+import zlib
+from typing import Optional
+
 from .object_store import ObjectStore
+from .sstable import frame_meta, unframe_meta, MetaCorruption
+
+BACKUP_MANIFEST_PATH = "BACKUP_MANIFEST"
+
+
+class BackupCorruption(Exception):
+    """A backup object is missing or fails its recorded checksum — the
+    restore (or verified read) refuses instead of serving it."""
 
 
 def _manifest_last() -> tuple:
@@ -23,27 +48,153 @@ def _manifest_last() -> tuple:
     return (MANIFEST_PATH, CATALOG_PATH)
 
 
+def load_backup_manifest(dst: ObjectStore) -> Optional[dict]:
+    """The destination's backup manifest, or None for a fresh/legacy
+    destination. A corrupt manifest raises — an incremental run must not
+    silently trust (or silently discard) a damaged ledger."""
+    if not dst.exists(BACKUP_MANIFEST_PATH):
+        return None
+    body = unframe_meta(dst.read(BACKUP_MANIFEST_PATH),
+                        BACKUP_MANIFEST_PATH)
+    m = json.loads(body)
+    if m.get("format") != 2:
+        raise BackupCorruption(
+            f"unknown backup manifest format: {m.get('format')!r}")
+    return m
+
+
 def backup_objects(src: ObjectStore, dst: ObjectStore,
-                   skip: tuple = ()) -> dict:
-    """Copy every object from src to dst, manifest/catalog LAST (`skip`
-    lets the caller substitute its own snapshot of a name, e.g. the
-    catalog read under the rounds lock). Returns a summary manifest."""
-    last = [n for n in _manifest_last() if n not in skip]
+                   extra: Optional[dict] = None) -> dict:
+    """Incremental generation-stamped copy of every src object into dst
+    (manifest/catalog last), each copy read back + checksum-verified
+    before it is recorded. `extra` maps name -> bytes for caller-held
+    snapshots written last (Session passes the CATALOG it read under the
+    rounds lock). Returns the summary: generation, per-run copied /
+    skipped counts and the total recorded object count."""
+    from ..utils.metrics import (BACKUP_GENERATION, BACKUP_OBJECTS_COPIED,
+                                 BACKUP_OBJECTS_SKIPPED)
+    extra = dict(extra or {})
+    prev = load_backup_manifest(dst)
+    gen = (prev["generation"] + 1) if prev else 1
+    entries: dict[str, dict] = dict(prev["objects"]) if prev else {}
+    last = [n for n in _manifest_last() if n not in extra]
     names = src.list("")
-    ordinary = [n for n in names if n not in last and n not in skip]
-    copied = 0
-    for n in ordinary:
-        dst.upload(n, src.read(n))
+    # quarantined evidence is deliberately NOT backed up (it is the
+    # corrupt bytes); the backup ledger itself never copies as data
+    names = [n for n in names
+             if not n.startswith("quarantine/")
+             and n != BACKUP_MANIFEST_PATH]
+    ordinary = [n for n in names if n not in last and n not in extra]
+    copied = skipped = 0
+
+    def _put_verified(name: str, data: bytes) -> None:
+        nonlocal copied, skipped
+        crc = zlib.crc32(data)
+        ent = entries.get(name)
+        if ent is not None and ent["crc"] == crc and dst.exists(name):
+            skipped += 1
+            return
+        dst.upload(name, data)
+        back = dst.read(name)          # read-back verify AT BACKUP TIME
+        if zlib.crc32(back) != crc:
+            raise BackupCorruption(
+                f"backup copy of {name!r} failed read-back verification")
+        entries[name] = {"crc": crc, "size": len(data), "generation": gen}
         copied += 1
+
+    for n in ordinary:
+        _put_verified(n, src.read(n))
     for n in last:
         if src.exists(n):
-            dst.upload(n, src.read(n))
-            copied += 1
-    return {"objects": copied}
+            _put_verified(n, src.read(n))
+    for n, data in extra.items():
+        _put_verified(n, data)
+    # prune ledger entries whose source object is gone (compacted away):
+    # manifest first, deletes strictly after — a crash between them
+    # leaves harmless unreferenced extra objects, never a ledger entry
+    # pointing at nothing
+    live = set(names) | set(extra) | {n for n in last if src.exists(n)}
+    pruned = sorted(n for n in entries if n not in live)
+    for n in pruned:
+        del entries[n]
+    manifest = {"format": 2, "generation": gen, "objects": entries}
+    dst.upload(BACKUP_MANIFEST_PATH,
+               frame_meta(json.dumps(manifest).encode()))
+    for n in pruned:
+        dst.delete(n)
+    BACKUP_OBJECTS_COPIED.inc(copied)
+    BACKUP_OBJECTS_SKIPPED.inc(skipped)
+    BACKUP_GENERATION.set(float(gen))
+    return {"objects": len(entries), "copied": copied,
+            "skipped": skipped, "pruned": len(pruned), "generation": gen}
+
+
+def verify_backup(backup: ObjectStore) -> Optional[dict]:
+    """Verify EVERY recorded object against its checksum; raises
+    BackupCorruption on the first missing/mismatched object. Returns the
+    backup manifest (None for a legacy destination with no ledger —
+    nothing to verify against, the caller decides whether to trust it)."""
+    m = load_backup_manifest(backup)
+    if m is None:
+        return None
+    for name, ent in sorted(m["objects"].items()):
+        if not backup.exists(name):
+            raise BackupCorruption(f"backup object {name!r} is missing")
+        data = backup.read(name)
+        if zlib.crc32(data) != ent["crc"]:
+            raise BackupCorruption(
+                f"backup object {name!r} fails its checksum "
+                f"(generation {ent['generation']})")
+    return m
+
+
+def read_backup_object(backup: ObjectStore, name: str) -> Optional[bytes]:
+    """Checksum-verified read of ONE backup object (the quarantine-repair
+    path): None when the backup has no (intact) record of it."""
+    try:
+        m = load_backup_manifest(backup)
+    except (BackupCorruption, MetaCorruption, ValueError):
+        return None
+    if m is None or name not in m["objects"] or not backup.exists(name):
+        return None
+    data = backup.read(name)
+    if zlib.crc32(data) != m["objects"][name]["crc"]:
+        return None
+    return data
+
+
+def restore_objects(backup: ObjectStore, dest: ObjectStore) -> dict:
+    """Cold-start restore: verify the whole backup, then copy every
+    recorded object into `dest` (a FRESH primary store root). Returns
+    {objects, generation}. A destination that already holds a manifest
+    refuses — restoring over a live store would interleave two worlds."""
+    from .hummock import MANIFEST_PATH
+    if dest.exists(MANIFEST_PATH):
+        raise BackupCorruption(
+            "restore destination already holds a MANIFEST — refusing to "
+            "overwrite a live store")
+    m = verify_backup(backup)
+    if m is None:
+        raise BackupCorruption(
+            "backup has no BACKUP_MANIFEST ledger — cannot verify; "
+            "use restore_store() to adopt an unverified legacy copy")
+    last = _manifest_last()
+    ordered = ([n for n in sorted(m["objects"]) if n not in last]
+               + [n for n in last if n in m["objects"]])
+    for n in ordered:
+        dest.upload(n, backup.read(n))
+    return {"objects": len(ordered), "generation": m["generation"]}
 
 
 def restore_store(backup: ObjectStore):
     """Open a HummockStateStore over a backup (or a copy of it) — the
-    catalog/DDL log restores through Session.recover() as usual."""
+    catalog/DDL log restores through Session.recover() as usual. The
+    backup verifies first when it carries a ledger (one written by any
+    current `backup_objects` run); a legacy ledger-less copy opens
+    unverified for compatibility. NOTE: this ADOPTS the backup directory
+    as the live store (new checkpoints write into it); use
+    `restore_objects` + a fresh primary for a true cold start that
+    leaves the backup immutable."""
+    verify_backup(backup)
     from .hummock import HummockStateStore
     return HummockStateStore(backup)

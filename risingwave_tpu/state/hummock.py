@@ -40,11 +40,13 @@ from __future__ import annotations
 import json
 from typing import Iterator, Optional
 
-from .object_store import ObjectStore
-from .sstable import SsTable, build_sstable
+from .object_store import ObjectStore, ResilientObjectStore
+from .sstable import (SsTable, SsTableCorruption, build_sstable,
+                      frame_meta, unframe_meta)
 from .store import StateStore, WriteBatch, lazy_merge_ranges
 
 MANIFEST_PATH = "MANIFEST"
+QUARANTINE_PREFIX = "quarantine/"
 
 
 def _sst_path(sst_id: int) -> str:
@@ -77,9 +79,25 @@ class SealedBatch:
 class HummockStateStore(StateStore):
     L0_COMPACT_THRESHOLD = 8
 
-    def __init__(self, object_store: ObjectStore):
+    def __init__(self, object_store: ObjectStore,
+                 backup_store: Optional[ObjectStore] = None):
         super().__init__()
-        self.objects = object_store
+        # every backend rides the retry layer: transient PUT/GET faults
+        # absorb below the recovery machinery (bounded backoff, per-op
+        # deadline); persistent faults keep the fail-stop path
+        self.objects = ResilientObjectStore.wrap(object_store)
+        # read-path integrity (see _read_sst): durably-corrupt objects
+        # are quarantined here (paths) and — when a backup store is
+        # attached — restored from their verified backup copy instead of
+        # crash-looping; /healthz reports `degraded` while non-empty.
+        # Attaching the backup AT OPEN (ctor arg; SET backup_path covers
+        # the running session) matters for the reopen-after-corruption
+        # path: the manifest load below already reads every referenced
+        # SST, so a bit-rotted object heals during open instead of
+        # crash-looping the restart
+        self.quarantined: list[str] = []
+        self.restored_objects: list[str] = []
+        self.backup_store: Optional[ObjectStore] = backup_store
         # epoch -> {key: value|None}; dict order = staging order within epoch
         self._shared: dict[int, dict[bytes, Optional[bytes]]] = {}
         # sealed-but-uncommitted batches, oldest first (the uploader queue)
@@ -104,7 +122,7 @@ class HummockStateStore(StateStore):
         # next crash. Per-worker partial recovery RESTAGES these into
         # the shared buffer so the next checkpoint re-seals them.
         self._unconfirmed: list[SealedBatch] = []
-        if object_store.exists(MANIFEST_PATH):
+        if self.objects.exists(MANIFEST_PATH):
             self._load_manifest()
 
     def set_sst_id_block(self, base: int) -> None:
@@ -116,14 +134,95 @@ class HummockStateStore(StateStore):
 
     # ------------------------------------------------------------ manifest
     def _load_manifest(self) -> None:
-        m = json.loads(self.objects.read(MANIFEST_PATH))
+        m = json.loads(unframe_meta(self.objects.read(MANIFEST_PATH),
+                                    MANIFEST_PATH))
         assert m.get("format") == 1, f"unknown manifest format {m}"
         self._committed_epoch = m["committed_epoch"]
         self._next_sst_id = m["next_sst_id"]
-        self._l0 = [SsTable.parse(i, self.objects.read(_sst_path(i)))
-                    for i in m["l0"]]
-        self._l1 = (SsTable.parse(m["l1"], self.objects.read(_sst_path(m["l1"])))
+        self._l0 = [self._read_sst(i) for i in m["l0"]]
+        self._l1 = (self._read_sst(m["l1"])
                     if m["l1"] is not None else None)
+
+    # --------------------------------------------------- read-path integrity
+    def _read_sst(self, sst_id: int) -> SsTable:
+        """Checksum-verified SST read with the transient/durable split:
+        a crc mismatch retries ONCE (torn page cache / transient media —
+        the re-read observes the real bytes); a second mismatch is
+        DURABLE corruption — the object is quarantined and restored from
+        its verified backup copy when one is attached, instead of
+        crash-looping the recovery engine against the same bad bytes."""
+        path = _sst_path(sst_id)
+        try:
+            return SsTable.parse(sst_id, self.objects.read(path))
+        except SsTableCorruption:
+            from ..utils.metrics import STORAGE_CRC_RETRIES
+            STORAGE_CRC_RETRIES.inc()
+            try:
+                return SsTable.parse(sst_id, self.objects.read(path))
+            except SsTableCorruption:
+                return SsTable.parse(
+                    sst_id, self._quarantine_and_restore(path))
+
+    def _quarantine_and_restore(self, path: str) -> bytes:
+        """Durable corruption: park the bad bytes under quarantine/ (the
+        post-mortem evidence — never served again), then restore the
+        object from the attached backup's checksum-verified copy. No
+        backup (or the backup lacks it): raise — named, loud, and
+        exactly-once-preserving (fail-stop, never silent serving)."""
+        from ..utils.metrics import STORAGE_QUARANTINED, STORAGE_RESTORED
+        try:
+            bad = self.objects.read(path)
+            self.objects.upload(
+                QUARANTINE_PREFIX + path.replace("/", "_"), bad)
+        except Exception:  # noqa: BLE001 — quarantine is best-effort
+            pass
+        if path not in self.quarantined:
+            self.quarantined.append(path)
+        STORAGE_QUARANTINED.set(float(len(self.quarantined)))
+        if self.backup_store is not None:
+            from .backup import read_backup_object
+            data = read_backup_object(self.backup_store, path)
+            if data is not None:
+                self.objects.upload(path, data)
+                self.restored_objects.append(path)
+                STORAGE_RESTORED.inc()
+                return data
+        raise SsTableCorruption(
+            f"{path}: durable corruption (quarantined) and no verified "
+            f"backup copy to restore from")
+
+    def scrub_verify(self, path: str) -> bool:
+        """One scrubber probe: read + integrity-check `path` without
+        mutating any in-memory state. Returns True when the object
+        verifies (possibly after the one transient re-read), False when
+        it is durably corrupt — quarantined, and restored when a backup
+        is attached (the False return still marks the pass degraded so
+        the operator sees the incident)."""
+
+        def _check() -> None:
+            data = self.objects.read(path)
+            if path.startswith("ssts/"):
+                SsTable.parse(0, data)
+            else:
+                json.loads(unframe_meta(data, path))
+
+        try:
+            _check()
+            return True
+        except SsTableCorruption:
+            from ..utils.metrics import STORAGE_CRC_RETRIES
+            STORAGE_CRC_RETRIES.inc()
+            try:
+                _check()
+                return True
+            except SsTableCorruption:
+                try:
+                    self._quarantine_and_restore(path)
+                except SsTableCorruption:
+                    pass      # quarantined without a backup: stay degraded
+                return False
+        except Exception:  # noqa: BLE001 — read errors own the fail-stop
+            return False
 
     def refresh_manifest(self) -> None:
         """Re-point this handle at the CURRENT committed manifest
@@ -149,7 +248,8 @@ class HummockStateStore(StateStore):
             "l0": [t.sst_id for t in self._l0],
             "l1": self._l1.sst_id if self._l1 is not None else None,
         }
-        self.objects.upload(MANIFEST_PATH, json.dumps(m).encode())
+        self.objects.upload(MANIFEST_PATH,
+                            frame_meta(json.dumps(m).encode()))
 
     # --------------------------------------------------------------- reads
     def get(self, key: bytes) -> Optional[bytes]:
@@ -356,8 +456,7 @@ class HummockStateStore(StateStore):
         assert epoch > self._committed_epoch, \
             f"cluster commit out of order ({epoch} <= {self._committed_epoch})"
         for sst_id in sst_ids:
-            self._l0.insert(
-                0, SsTable.parse(sst_id, self.objects.read(_sst_path(sst_id))))
+            self._l0.insert(0, self._read_sst(sst_id))
         self._committed_epoch = epoch
         obsolete: list[int] = []
         if len(self._l0) > self.L0_COMPACT_THRESHOLD:

@@ -1,15 +1,26 @@
 """Object store layer — where checkpoint SSTs live.
 
 Reference: src/object_store/src/object/mod.rs (ObjectStore trait: upload /
-read / delete / list) with S3 / in-mem / local-fs backends. Here the durable
-backend is the local filesystem (atomic tmp+rename uploads, fsync'd), which
-is what a TPU-VM pod slice sees for /tmp-class scratch and what the restart
-tests exercise; an in-memory backend backs pure-unit tests of the LSM layer.
+read / delete / list) with S3 / in-mem / local-fs backends, wrapped in the
+reference's RetryCondition/timeout layer (object/src/object/mod.rs
+ObjectStoreConfig: every op retries transient errors with bounded
+exponential backoff under a per-op deadline). Here the durable backend is
+the local filesystem (atomic tmp+rename uploads, fsync'd), which is what a
+TPU-VM pod slice sees for /tmp-class scratch and what the restart tests
+exercise; an in-memory backend backs pure-unit tests of the LSM layer.
+`ResilientObjectStore` is the retry layer every Hummock handle wraps its
+backend in: transient faults are absorbed BELOW the recovery machinery
+(bounded retries, seeded backoff + jitter, per-op deadline), persistent
+faults classify out immediately and take the existing fail-stop ->
+recovery path, so correctness is never weaker than fail-stop.
 """
 
 from __future__ import annotations
 
 import os
+import random
+import time
+from typing import Optional
 
 
 class ObjectStore:
@@ -51,17 +62,42 @@ class InMemObjectStore(ObjectStore):
         return path in self._objects
 
 
+# a stranded `.tmp` (crash between write and rename) older than this is
+# swept at open; the age guard keeps a concurrent opener (cluster compute
+# nodes share the store directory) from deleting a sibling's IN-FLIGHT
+# upload tmp — a live upload never lives anywhere near this long
+TMP_SWEEP_AGE_S = 300.0
+
+
 class LocalFsObjectStore(ObjectStore):
     """Durable local-dir backend (reference: object/opendal_engine/fs.rs).
 
     Uploads are atomic (write tmp, fsync, rename) so a crash mid-upload can
     never leave a torn object visible — the manifest-swap recovery protocol
-    depends on this.
+    depends on this. The crash DOES strand the `.tmp` file forever
+    (`list()` hides them but the directory grows unboundedly), so open
+    sweeps stale ones (see TMP_SWEEP_AGE_S).
     """
 
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
+        self._sweep_stale_tmp()
+
+    def _sweep_stale_tmp(self) -> None:
+        from ..utils.metrics import OBJECT_TMP_SWEPT
+        now = time.time()
+        for dirpath, _, files in os.walk(self.root):
+            for name in files:
+                if not name.endswith(".tmp"):
+                    continue
+                p = os.path.join(dirpath, name)
+                try:
+                    if now - os.path.getmtime(p) >= TMP_SWEEP_AGE_S:
+                        os.remove(p)
+                        OBJECT_TMP_SWEPT.inc()
+                except OSError:
+                    pass          # raced another opener / live upload
 
     def _abs(self, path: str) -> str:
         root = os.path.normpath(self.root)
@@ -107,3 +143,178 @@ class LocalFsObjectStore(ObjectStore):
 
     def exists(self, path: str) -> bool:
         return os.path.exists(self._abs(path))
+
+
+class TransientObjectError(OSError):
+    """A fault the retry layer may absorb (injected faults and real
+    I/O-class errors classify here). Deliberately an OSError so an
+    unwrapped backend raising it still takes the fail-stop path."""
+
+
+class ObjectStoreUnavailable(RuntimeError):
+    """Retries/deadline exhausted on a transient fault — the PERSISTENT
+    outcome: falls through to the existing fail-stop -> recovery-radius
+    machinery, exactly like any other store error."""
+
+
+def _path_kind(path: str) -> str:
+    """Coarse object class for fault-rule filtering and metrics labels."""
+    if path.startswith("ssts/"):
+        return "sst"
+    if path == "MANIFEST":
+        return "manifest"
+    if path == "CATALOG":
+        return "catalog"
+    if path.startswith("dict/"):
+        return "dict"
+    return "other"
+
+
+def _corrupt_bytes(data: bytes) -> bytes:
+    """Deterministic payload corruption for the object_get_corrupt fault
+    point: flip a byte in the middle (past any magic) so checksums fail
+    but framing-magic checks still route to the crc branch."""
+    if len(data) <= 8:
+        return bytes(len(data))
+    i = len(data) // 2
+    return data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:]
+
+
+class ResilientObjectStore(ObjectStore):
+    """Bounded-retry wrapper every Hummock handle puts around its backend.
+
+    * transient faults (I/O-class OSErrors, injected `object_put_fail` /
+      `object_get_fail`) retry up to `max_attempts` with seeded
+      exponential backoff + jitter under a per-op deadline — absorbed
+      below the recovery machinery, `object_store_retries_total{op}`
+      counts them;
+    * persistent faults (missing object, path escape, type errors)
+      raise immediately;
+    * exhausted retries raise ObjectStoreUnavailable — the persistent
+      outcome falls through to today's fail-stop -> radius engine, so
+      correctness is never weaker than without the wrapper;
+    * `object_get_corrupt` injects payload corruption AFTER the read so
+      the caller's checksum-retry path (state/hummock.py `_read_sst`)
+      exercises exactly like torn-cache media corruption.
+
+    `object_store_op_seconds{op}` histograms every op. Attribute reads
+    that miss here delegate to the wrapped backend (`root`, test pokes),
+    so existing `getattr(store.objects, "root", ...)` call sites keep
+    working.
+    """
+
+    # persistent: retrying cannot help; the error is the answer
+    _PERSISTENT = (FileNotFoundError, IsADirectoryError, NotADirectoryError,
+                   PermissionError, KeyError, ValueError, TypeError)
+
+    _OP_BUCKETS = (0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 30.0)
+
+    def __init__(self, inner: ObjectStore, max_attempts: int = 4,
+                 backoff_base_ms: float = 10.0,
+                 backoff_cap_ms: float = 1000.0,
+                 op_deadline_s: float = 30.0, seed: int = 0):
+        self._inner = inner
+        self.max_attempts = int(max_attempts)
+        self.backoff_base_ms = float(backoff_base_ms)
+        self.backoff_cap_ms = float(backoff_cap_ms)
+        self.op_deadline_s = float(op_deadline_s)
+        self._rng = random.Random(seed)
+        from ..utils.metrics import GLOBAL_METRICS
+        self._metrics = GLOBAL_METRICS
+        self._m_retries: dict[str, object] = {}
+        self._m_seconds: dict[str, object] = {}
+
+    @classmethod
+    def wrap(cls, store: ObjectStore) -> "ResilientObjectStore":
+        """Idempotent: wrapping a wrapper returns it unchanged (cluster
+        compute nodes and meta both construct Hummock handles over the
+        same directory)."""
+        return store if isinstance(store, cls) else cls(store)
+
+    @property
+    def inner(self) -> ObjectStore:
+        return self._inner
+
+    def __getattr__(self, name):
+        # only reached when normal lookup fails: backend-specific
+        # attributes (root, _objects, ...) pass through
+        return getattr(self._inner, name)
+
+    def _classify_transient(self, exc: BaseException) -> bool:
+        if isinstance(exc, TransientObjectError):
+            return True
+        if isinstance(exc, self._PERSISTENT):
+            return False
+        return isinstance(exc, (OSError, TimeoutError))
+
+    def _retry_counter(self, op: str):
+        c = self._m_retries.get(op)
+        if c is None:
+            c = self._metrics.counter("object_store_retries_total", op=op)
+            self._m_retries[op] = c
+        return c
+
+    def _op_hist(self, op: str):
+        h = self._m_seconds.get(op)
+        if h is None:
+            h = self._metrics.histogram("object_store_op_seconds",
+                                        buckets=self._OP_BUCKETS, op=op)
+            self._m_seconds[op] = h
+        return h
+
+    def _do(self, op: str, path: str, fn):
+        from ..utils.faults import FAULTS
+        from ..utils.metrics import OBJECT_RETRIES
+        t0 = time.monotonic()
+        kind = _path_kind(path)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                if FAULTS.active and op in ("put", "get"):
+                    if FAULTS.hit(f"object_{op}_fail", path=path,
+                                  kind=kind, attempt=attempt) is not None:
+                        raise TransientObjectError(
+                            f"injected object_{op}_fail for {path!r} "
+                            f"(attempt {attempt})")
+                out = fn()
+                if op == "get" and FAULTS.active:
+                    if FAULTS.hit("object_get_corrupt", path=path,
+                                  kind=kind) is not None:
+                        out = _corrupt_bytes(out)
+                self._op_hist(op).observe(time.monotonic() - t0)
+                return out
+            except BaseException as e:  # noqa: BLE001 — classified below
+                if not self._classify_transient(e):
+                    raise
+                elapsed = time.monotonic() - t0
+                if attempt >= self.max_attempts \
+                        or elapsed >= self.op_deadline_s:
+                    self._op_hist(op).observe(time.monotonic() - t0)
+                    raise ObjectStoreUnavailable(
+                        f"object {op} {path!r} failed after {attempt} "
+                        f"attempts in {elapsed:.3f}s") from e
+                self._retry_counter(op).inc()
+                OBJECT_RETRIES.inc()
+                delay_ms = min(self.backoff_cap_ms,
+                               self.backoff_base_ms * (2 ** (attempt - 1)))
+                # +-50% jitter off a seeded RNG — deterministic per
+                # process for the chaos harness, decorrelated in a fleet
+                time.sleep(delay_ms / 1e3 * (0.5 + self._rng.random()))
+
+    # ------------------------------------------------------------- ops
+    def upload(self, path: str, data: bytes) -> None:
+        self._do("put", path, lambda: self._inner.upload(path, data))
+
+    def read(self, path: str) -> bytes:
+        return self._do("get", path, lambda: self._inner.read(path))
+
+    def delete(self, path: str) -> None:
+        self._do("delete", path, lambda: self._inner.delete(path))
+
+    def list(self, prefix: str) -> list[str]:
+        return self._do("list", prefix, lambda: self._inner.list(prefix))
+
+    def exists(self, path: str) -> bool:
+        return self._do("exists", path, lambda: self._inner.exists(path))

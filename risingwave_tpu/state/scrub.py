@@ -1,0 +1,160 @@
+"""Background integrity scrubber — the storage plane's health loop.
+
+Reference: the reference dedicates a storage subsystem to object health
+(SURVEY §2.5 — the compactor's object lifetime bookkeeping plus
+`src/storage/backup/` verification); cloud LSM stores scrub at rest
+because bit-rot and torn caches are detected cheapest BEFORE a recovery
+needs the bytes. Same shape here, collapsed to a coordinator-owned pulse:
+
+* **verify**: round-robin over every manifest-referenced object (SSTs,
+  MANIFEST, CATALOG), a bounded `batch` per pulse, each read +
+  crc-checked through `HummockStateStore.scrub_verify` — a transient
+  mismatch re-reads once, a durable one quarantines + restores from the
+  attached backup (state/hummock.py read-path rules);
+* **orphan sweep**: SSTs visible under `ssts/` that no manifest
+  references and no sealed/unconfirmed batch is about to commit are
+  orphans (a crashed upload's leftovers — `upload_sealed` can always
+  leave one; they used to leak forever). An orphan is DELETED only after
+  being sighted in two consecutive pulses (grace: an object that appears
+  mid-pulse could be a racing upload's fresh PUT), and never in cluster
+  mode (meta cannot see worker uploads still in flight — it only counts
+  them there).
+
+Barrier-paced like the MemoryManager: `on_barrier` runs synchronously at
+every collected barrier, throttled to every `interval` barriers, so
+scrub work can never race an in-flight apply and a disabled scrubber
+(interval=0) costs one integer compare per barrier.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class StorageScrubber:
+    """Owned by the BarrierCoordinator; active only over a durable
+    manifest-owner Hummock store (everything else no-ops)."""
+
+    def __init__(self, store, interval: int = 16, batch: int = 2):
+        self.store = store
+        self.interval = int(interval)   # barriers between pulses; 0=off
+        self.batch = int(batch)         # objects verified per pulse
+        self._count = 0
+        self._cursor = 0
+        # orphans sighted last pulse — the two-sighting sweep grace
+        self._orphan_seen: set[str] = set()
+        # report surface (SHOW storage)
+        self.passes = 0
+        self.verified = 0
+        self.corruptions = 0
+        self.orphans_live = 0
+        self.orphans_swept = 0
+
+    def configure(self, interval: Optional[int] = None,
+                  batch: Optional[int] = None) -> None:
+        if interval is not None:
+            self.interval = int(interval)
+        if batch is not None:
+            self.batch = int(batch)
+
+    # ------------------------------------------------------------ pulse
+    def _active(self) -> bool:
+        return (self.interval > 0
+                and getattr(self.store, "manifest_owner", True)
+                and getattr(self.store, "objects", None) is not None
+                and hasattr(self.store, "scrub_verify"))
+
+    def on_barrier(self, epoch: int, cluster_mode: bool = False) -> None:
+        if not self._active():
+            return
+        self._count += 1
+        if self._count % self.interval:
+            return
+        self._pulse(cluster_mode)
+
+    def _referenced(self) -> list[str]:
+        from .hummock import MANIFEST_PATH, _sst_path
+        store = self.store
+        paths = [_sst_path(t.sst_id) for t in store._l0]
+        if store._l1 is not None:
+            paths.append(_sst_path(store._l1.sst_id))
+        for name in (MANIFEST_PATH, "CATALOG"):
+            if store.objects.exists(name):
+                paths.append(name)
+        return paths
+
+    def _pulse(self, cluster_mode: bool) -> None:
+        from ..utils.metrics import (STORAGE_ORPHAN_OBJECTS,
+                                     STORAGE_ORPHANS_SWEPT,
+                                     STORAGE_SCRUB_CORRUPTIONS,
+                                     STORAGE_SCRUB_OBJECTS,
+                                     STORAGE_SCRUB_PASSES)
+        store = self.store
+        objects = store.objects
+        self.passes += 1
+        STORAGE_SCRUB_PASSES.inc()
+        # ---- verify a bounded slice of the referenced set ----
+        refs = self._referenced()
+        if refs:
+            for k in range(min(self.batch, len(refs))):
+                path = refs[(self._cursor + k) % len(refs)]
+                try:
+                    ok = store.scrub_verify(path)
+                except Exception:  # noqa: BLE001 — scrub never kills a barrier
+                    ok = False
+                self.verified += 1
+                STORAGE_SCRUB_OBJECTS.inc()
+                if not ok:
+                    self.corruptions += 1
+                    STORAGE_SCRUB_CORRUPTIONS.inc()
+            self._cursor = (self._cursor + self.batch) % len(refs)
+        # ---- orphan accounting + grace-period sweep ----
+        from .hummock import _sst_path
+        try:
+            listed = set(objects.list("ssts/"))
+        except Exception:  # noqa: BLE001 — a flaky list skips the round
+            return
+        keep = {_sst_path(t.sst_id) for t in store._l0}
+        if store._l1 is not None:
+            keep.add(_sst_path(store._l1.sst_id))
+        # sealed-but-uncommitted and sealed-but-unconfirmed batches are
+        # IN FLIGHT, not orphaned — their commit installs them shortly
+        for b in list(getattr(store, "_sealed", ())) \
+                + list(getattr(store, "_unconfirmed", ())):
+            if b.sst_id is not None:
+                keep.add(_sst_path(b.sst_id))
+        orphans = listed - keep
+        self.orphans_live = len(orphans)
+        STORAGE_ORPHAN_OBJECTS.set(float(len(orphans)))
+        if cluster_mode:
+            # meta cannot prove a worker's fresh upload is not about to
+            # be committed — count, never delete (the sweep runs when
+            # the cluster detaches / on the single-process path)
+            self._orphan_seen = orphans
+            return
+        swept = 0
+        for path in sorted(orphans & self._orphan_seen):
+            try:
+                objects.delete(path)
+                swept += 1
+            except Exception:  # noqa: BLE001 — best-effort hygiene
+                pass
+        if swept:
+            self.orphans_swept += swept
+            STORAGE_ORPHANS_SWEPT.inc(swept)
+            self.orphans_live -= swept
+            STORAGE_ORPHAN_OBJECTS.set(float(self.orphans_live))
+        self._orphan_seen = orphans - {p for p in self._orphan_seen
+                                       if p in orphans}
+
+    # ----------------------------------------------------------- report
+    def report(self) -> dict:
+        return {
+            "interval": self.interval,
+            "batch": self.batch,
+            "passes": self.passes,
+            "objects_verified": self.verified,
+            "corruptions": self.corruptions,
+            "orphans_live": self.orphans_live,
+            "orphans_swept": self.orphans_swept,
+        }

@@ -21,11 +21,37 @@ from bisect import bisect_left, bisect_right
 from typing import Iterator, Optional, Sequence
 
 MAGIC = b"RWS1"
+META_MAGIC = b"RWM1"
 TOMBSTONE = 0xFFFFFFFF
 
 
 class SsTableCorruption(Exception):
     pass
+
+
+class MetaCorruption(SsTableCorruption):
+    """A framed meta object (MANIFEST/CATALOG/backup manifest) failed its
+    checksum — same detection class as an SST, same quarantine rules."""
+
+
+def frame_meta(body: bytes) -> bytes:
+    """Self-checksummed framing for meta objects — the MANIFEST and
+    CATALOG carry the same crc32 integrity envelope SSTs always had, so
+    a torn or bit-rotted manifest is DETECTED at open instead of being
+    json-decoded into a plausible-but-wrong world."""
+    return META_MAGIC + body + struct.pack("<I", zlib.crc32(body))
+
+
+def unframe_meta(data: bytes, name: str = "meta") -> bytes:
+    """Verify + strip the meta frame. Unframed blobs pass through —
+    stores written before the framing existed still open (their json
+    layer keeps rejecting garbage, just without crc attribution)."""
+    if data[:4] != META_MAGIC:
+        return data
+    body, (crc,) = data[4:-4], struct.unpack("<I", data[-4:])
+    if zlib.crc32(body) != crc:
+        raise MetaCorruption(f"{name}: checksum mismatch")
+    return body
 
 
 def build_sstable(epoch: int,

@@ -36,6 +36,22 @@ Fault points (site → effect when the rule fires):
                   fail-stops the next injection exactly like an upload
                   failure; the re-delivered batch dedupes on the seq
                   persisted in the topic; filter `topic=`/`seq=`)
+  object_put_fail state/object_store.py ResilientObjectStore — an
+                  object PUT raises a TRANSIENT error below the retry
+                  layer: with occurrence counts under the retry budget
+                  the wrapper absorbs it (object_store_retries_total
+                  bumps, ZERO recoveries); past the budget it surfaces
+                  as ObjectStoreUnavailable and takes the existing
+                  fail-stop path (filter `path=`/`kind=`sst|manifest|
+                  catalog|dict|other/`attempt=`)
+  object_get_fail same site, for object GETs (manifest loads, scrub
+                  verifies, cluster commit reads)
+  object_get_corrupt  same site — the GET succeeds but the returned
+                  payload is corrupted AFTER the retry layer, so the
+                  CALLER's checksum path runs: an SST/manifest reader
+                  re-reads once (transient torn-cache/media model);
+                  `times=` high enough makes the corruption durable —
+                  quarantine + restore-from-backup (state/hummock.py)
   dcn_drop        stream/remote_exchange.py RemoteOutput.send (WORKER
                   process; the spec rides the cluster config push) —
                   severs one DCN output leg mid-epoch by closing its

@@ -334,6 +334,41 @@ RECOVERY_BACKOFF = GLOBAL_METRICS.counter(
 # once flows register.
 LOGSTORE_APPEND_BYTES = GLOBAL_METRICS.counter("logstore_append_bytes_total")
 
+# Fault-tolerant storage plane (state/object_store.py ResilientObjectStore,
+# state/hummock.py read-path hardening, state/scrub.py): transient object
+# faults are absorbed BELOW the recovery machinery. Per-op labelled series
+# `object_store_retries_total{op}` / `object_store_op_seconds{op}` ride
+# alongside the process totals; crc-retry counts the read-path's one
+# re-read of a checksum-mismatched object before it is declared durably
+# corrupt, quarantined and (when a backup is attached) restored.
+OBJECT_RETRIES = GLOBAL_METRICS.counter("object_store_retries_total")
+OBJECT_TMP_SWEPT = GLOBAL_METRICS.counter("object_store_tmp_swept_total")
+STORAGE_CRC_RETRIES = GLOBAL_METRICS.counter(
+    "storage_crc_retries_total")
+STORAGE_QUARANTINED = GLOBAL_METRICS.gauge("storage_quarantined_objects")
+STORAGE_RESTORED = GLOBAL_METRICS.counter(
+    "storage_restored_from_backup_total")
+# Background scrubber (state/scrub.py, barrier-paced by the coordinator):
+# objects verified, corruptions found, orphan SSTs currently visible
+# (uploaded by a crashed/aborted checkpoint, referenced by no manifest)
+# and orphans actually swept after the two-sighting grace.
+STORAGE_SCRUB_PASSES = GLOBAL_METRICS.counter("storage_scrub_passes_total")
+STORAGE_SCRUB_OBJECTS = GLOBAL_METRICS.counter(
+    "storage_scrub_objects_total")
+STORAGE_SCRUB_CORRUPTIONS = GLOBAL_METRICS.counter(
+    "storage_scrub_corruptions_total")
+STORAGE_ORPHAN_OBJECTS = GLOBAL_METRICS.gauge("storage_orphan_objects")
+STORAGE_ORPHANS_SWEPT = GLOBAL_METRICS.counter(
+    "storage_orphan_swept_total")
+# Backup plane (state/backup.py): generation-stamped incremental backups;
+# objects copied vs skipped-as-already-backed-up per run, and the last
+# generation written (gauge — SHOW storage reads it too).
+BACKUP_OBJECTS_COPIED = GLOBAL_METRICS.counter(
+    "backup_objects_copied_total")
+BACKUP_OBJECTS_SKIPPED = GLOBAL_METRICS.counter(
+    "backup_objects_skipped_total")
+BACKUP_GENERATION = GLOBAL_METRICS.gauge("backup_last_generation")
+
 # Source split observability (stream/source.py): per-split labelled
 # gauges `source_split_offset{source,split}` (rows consumed by the
 # split, refreshed at barrier cadence) and `source_lag_rows{source,

@@ -39,6 +39,33 @@ logstore gate use):
                    the pipelined commit just lands late (delivery and
                    replay-buffer trims follow it)
 
+plus the STORAGE-PLANE classes (state/object_store.py retry layer,
+state/hummock.py read-path integrity, state/backup.py verified
+backup/restore — transient faults absorb BELOW the recovery radius
+engine, durable faults repair from backup):
+
+  object_put_flake    two consecutive transient PUT failures during
+                   checkpoint upload -> absorbed by the bounded-retry
+                   wrapper: ZERO recoveries, retries counted, MV
+                   bit-identical to the oracle
+  object_get_flake    a transient GET failure on the scrub read path ->
+                   absorbed the same way, zero recoveries
+  object_get_corrupt_transient  one corrupted GET payload -> the crc
+                   retry re-reads clean: zero recoveries, nothing
+                   quarantined
+  sst_corrupt_durable  an on-disk SST bit-rotted AFTER a backup -> the
+                   scrubber detects it, quarantines the bad bytes,
+                   restores the object from its checksum-verified
+                   backup copy, /healthz flips degraded — zero
+                   recoveries, the engine never serves the corruption
+  backup_restore_coldstart  BACKUP TO twice (the second run must copy
+                   only the new generation's objects), then a REAL
+                   FRESH PROCESS runs RESTORE FROM into an empty
+                   primary and converges bit-identical to the
+                   generator-prefix oracle at the restored committed
+                   offset; a deliberately corrupted backup object is
+                   REFUSED loudly at restore time
+
 plus the external-ingress/egress classes over an in-process broker
 (connectors/broker.py — the fail-stop -> auto-recovery path, never a
 hang):
@@ -326,6 +353,201 @@ async def _run_broker_faults(tmp: str) -> list:
     return out
 
 
+CHILD_RESTORE_SRC = r"""
+import asyncio, json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+async def main():
+    bak, primary = sys.argv[1], sys.argv[2]
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+    from risingwave_tpu.state.storage_table import StorageTable
+    from risingwave_tpu.stream.source import SourceExecutor
+    s = Session(store=HummockStateStore(LocalFsObjectStore(primary)))
+    meta = await s.execute("RESTORE FROM '%s'" % bak)
+    offset = 0
+    dep = s.catalog.mvs["q7w"].deployment
+    for roots in dep.roots.values():
+        for root in roots:
+            node = root
+            while node is not None:
+                if isinstance(node, SourceExecutor):
+                    rows = list(StorageTable.for_state_table(
+                        node.state_table).batch_iter())
+                    offset = int(rows[0][1]) if rows else 0
+                node = getattr(node, "input", None)
+    rows = sorted(s.query("SELECT window_end, maxprice FROM q7w"))
+    print(json.dumps({"restore": meta, "offset": offset, "rows": rows}))
+    await s.crash()
+
+asyncio.run(main())
+"""
+
+
+async def _run_storage_faults(tmp: str) -> tuple[list, dict]:
+    """The storage-plane classes: transient object faults absorb BELOW
+    the recovery machinery (zero recoveries, retries counted), durable
+    corruption repairs from backup, and the incremental backup restores
+    bit-identical over a REAL fresh process."""
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+    from risingwave_tpu.utils.metrics import (OBJECT_RETRIES,
+                                              RECOVERY_TOTAL,
+                                              STORAGE_CRC_RETRIES)
+    out = []
+
+    async def _q7(name, pre=()):
+        store = HummockStateStore(
+            LocalFsObjectStore(os.path.join(tmp, name)))
+        s = Session(store=store)
+        for sql in pre:
+            await s.execute(sql)
+        for sql in _ddl():
+            await s.execute(sql)
+        await s.tick(3)
+        return s, store
+
+    def _conv(s):
+        offset = _committed_offset(s)
+        got = Counter(s.query("SELECT window_end, maxprice FROM q7w"))
+        return got == _oracle(offset), offset, sum(got.values())
+
+    async def _transient(name, spec, pre=()):
+        s, store = await _q7(name, pre=pre)
+        r0 = OBJECT_RETRIES.value
+        c0 = STORAGE_CRC_RETRIES.value
+        t0 = RECOVERY_TOTAL.value
+        await s.execute(f"SET fault_injection = '{spec}'")
+        await s.tick(4)
+        await s.execute("SET fault_injection = ''")
+        await s.tick(1)
+        conv, offset, nrows = _conv(s)
+        res = {"fault": name, "converged": conv, "offset": offset,
+               "mv_rows": nrows, "recoveries": s.recoveries,
+               "retries_delta": OBJECT_RETRIES.value - r0,
+               "crc_retries_delta": STORAGE_CRC_RETRIES.value - c0,
+               "recovery_total_delta": RECOVERY_TOTAL.value - t0,
+               "quarantined": list(store.quarantined)}
+        await s.drop_all()
+        return res
+
+    scrub_on = ("SET storage_scrub_interval = 1",
+                "SET storage_scrub_batch = 4")
+    out.append(await _transient(
+        "object_put_flake", "object_put_fail:at=1,times=2"))
+    out.append(await _transient(
+        "object_get_flake", "object_get_fail:at=1,kind=sst",
+        pre=scrub_on))
+    out.append(await _transient(
+        "object_get_corrupt_transient", "object_get_corrupt:at=1,kind=sst",
+        pre=scrub_on))
+
+    # ---- durable SST corruption -> quarantine + restore-from-backup ----
+    s, store = await _q7("sst_corrupt_durable",
+                         pre=("SET storage_scrub_interval = 1",
+                              "SET storage_scrub_batch = 8"))
+    bak_repair = os.path.join(tmp, "sst_corrupt_durable_bak")
+    await s.execute(f"BACKUP TO '{bak_repair}'")
+    t0 = RECOVERY_TOTAL.value
+    sst = store._l0[0] if store._l0 else store._l1
+    sst_path = os.path.join(tmp, "sst_corrupt_durable", "ssts",
+                            f"{sst.sst_id:010d}.sst")
+    with open(sst_path, "r+b") as f:     # bit-rot AFTER the backup
+        f.seek(24)
+        f.write(b"\xde\xad\xbe\xef")
+    await s.tick(4)                      # scrub pulse finds + repairs it
+    from risingwave_tpu.state.sstable import SsTable
+    healed = True
+    try:
+        SsTable.parse(sst.sst_id, open(sst_path, "rb").read())
+    except Exception:  # noqa: BLE001
+        healed = False
+    await s.start_monitor(0)
+    port = s.monitor.port
+    healthz = json.loads(await asyncio.to_thread(
+        lambda: urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5)
+        .read().decode()))
+    await s.stop_monitor()
+    conv, offset, nrows = _conv(s)
+    out.append({"fault": "sst_corrupt_durable", "converged": conv,
+                "offset": offset, "mv_rows": nrows,
+                "recoveries": s.recoveries,
+                "recovery_total_delta": RECOVERY_TOTAL.value - t0,
+                "quarantined": list(store.quarantined),
+                "restored": list(store.restored_objects),
+                "healed_on_disk": healed,
+                "healthz_degraded": bool(healthz.get("degraded"))})
+    await s.drop_all()
+
+    # ---- incremental backup + cold-start restore in a FRESH process ----
+    s, store = await _q7("coldstart_primary")
+    bak = os.path.join(tmp, "coldstart_bak")
+    meta1 = await s.execute(f"BACKUP TO '{bak}'")
+    await s.tick(3)
+    meta2 = await s.execute(f"BACKUP TO '{bak}'")
+    final_offset = _committed_offset(s)
+    final_rows = sorted(s.query("SELECT window_end, maxprice FROM q7w"))
+    await s.crash()
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+
+    def _restore_child(primary):
+        return subprocess.run(
+            [sys.executable, "-c", CHILD_RESTORE_SRC, bak, primary],
+            capture_output=True, timeout=300, env=env, cwd=repo)
+
+    child = _restore_child(os.path.join(tmp, "coldstart_fresh"))
+    restored = {}
+    if child.returncode == 0:
+        restored = json.loads(child.stdout.decode().strip().split("\n")[-1])
+    conv = (bool(restored)
+            and Counter(map(tuple, restored["rows"]))
+            == _oracle(restored["offset"])
+            and restored["offset"] == final_offset
+            and [list(r) for r in final_rows] == restored["rows"])
+    # a corrupted backup object must REFUSE loudly at restore time
+    from risingwave_tpu.state.backup import load_backup_manifest
+    ledger = load_backup_manifest(LocalFsObjectStore(bak))
+    sst_name = sorted(n for n in ledger["objects"] if n.startswith("ssts/"))[0]
+    with open(os.path.join(bak, *sst_name.split("/")), "r+b") as f:
+        f.seek(16)
+        f.write(b"\x66\x6f\x6f\x21")
+    child2 = _restore_child(os.path.join(tmp, "coldstart_fresh2"))
+    refused = (child2.returncode != 0
+               and b"BackupCorruption" in child2.stderr)
+    out.append({"fault": "backup_restore_coldstart",
+                "converged": conv,
+                "recoveries": 0,
+                "backup_gen1": meta1, "backup_gen2": meta2,
+                "child_rc": child.returncode,
+                "corrupt_backup_refused": refused,
+                "child2_rc": child2.returncode})
+    verdict_bits = {
+        "storage_transient_zero_recoveries": all(
+            r["recoveries"] == 0 and r["recovery_total_delta"] == 0
+            for r in out if r["fault"] != "backup_restore_coldstart"),
+        "storage_retries_counted": (
+            out[0]["retries_delta"] > 0 and out[1]["retries_delta"] > 0
+            and out[2]["crc_retries_delta"] > 0),
+        "storage_transient_nothing_quarantined": all(
+            not r["quarantined"] for r in out[:3]),
+        "storage_all_converged": all(
+            r["converged"] for r in out),
+        "sst_corrupt_durable_repaired": (
+            bool(out[3]["quarantined"]) and bool(out[3]["restored"])
+            and out[3]["healed_on_disk"] and out[3]["healthz_degraded"]),
+        "backup_incremental_copy_only_new": (
+            meta2["generation"] == meta1["generation"] + 1
+            and meta2["skipped"] > 0
+            and meta2["copied"] < meta2["objects"]),
+        "coldstart_restore_converged": conv,
+        "corrupt_backup_refused": refused,
+    }
+    return out, verdict_bits
+
+
 def _mesh_actor(session) -> int:
     """The fused mesh fragment's actor (the agg lowered onto the
     virtual device mesh under streaming_parallelism_devices=2)."""
@@ -481,7 +703,9 @@ async def main() -> int:
     dcn = await _run_cluster_dcn(tmp)
     results_cluster = [dcn]
     broker_results = await _run_broker_faults(tmp)
-    for r in results + results_cluster + broker_results:
+    storage_results, storage_verdict = await _run_storage_faults(tmp)
+    for r in (results + results_cluster + broker_results
+              + storage_results):
         print(json.dumps(r))
 
     by_name = {r["fault"]: r for r in results}
@@ -548,6 +772,10 @@ async def main() -> int:
             r["converged"] and r["recoveries"] >= 1
             for r in broker_results),
     }
+    # storage plane: transient classes absorb below the radius engine,
+    # durable corruption repairs from backup, cold-start restore over a
+    # real fresh process converges (bits computed in _run_storage_faults)
+    verdict.update(storage_verdict)
     print(json.dumps({"verdict": verdict}))
     ok = all(v for k, v in verdict.items()
              if isinstance(v, bool))

@@ -46,6 +46,44 @@ def test_sstable_checksum_detects_corruption():
         SsTable.parse(1, bytes(data))
 
 
+def test_transient_crc_mismatch_absorbed_by_one_reread():
+    """Read-path integrity split (state/hummock.py _read_sst): a crc
+    mismatch that a re-read resolves (torn cache / transient media) is
+    absorbed — no quarantine, no recovery, the parsed SST is correct."""
+    objs = InMemObjectStore()
+    st = HummockStateStore(objs)
+    st.ingest_batch(_batch(1, a="1", b="2"))
+    st.sync(1)
+    sst_id = st._l0[0].sst_id
+    path = f"ssts/{sst_id:010d}.sst"
+    good = objs.read(path)
+
+    class _TornOnceStore:
+        def __init__(self, inner, torn_path):
+            self._inner = inner
+            self._path = torn_path
+            self.reads = 0
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def read(self, p):
+            data = self._inner.read(p)
+            if p == self._path:
+                self.reads += 1
+                if self.reads == 1:      # torn crc on the FIRST read
+                    return data[:-4] + b"\x00\x00\x00\x00"
+            return data
+
+    torn = _TornOnceStore(objs, path)
+    st.objects = torn
+    sst = st._read_sst(sst_id)
+    assert sst.get(b"a") == (True, b"1")
+    assert st.quarantined == []          # transient: nothing quarantined
+    assert torn.reads == 2               # exactly one re-read
+    assert objs.read(path) == good
+
+
 # ------------------------------------------------------------- object store
 
 def test_local_fs_object_store(tmp_path):

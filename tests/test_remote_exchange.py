@@ -156,3 +156,47 @@ async def test_multiprocess_pipeline():
     exp = Counter(zip(auction[keep].tolist(), price[keep].tolist()))
     assert got == exp
     assert got, "oracle vacuous"
+
+
+async def test_concurrent_rewind_preserves_per_leg_frame_order():
+    """Phase-3 parallel rewind (cluster partial recovery, meta's
+    partial_rewind): several surviving producer legs stream their
+    uncommitted suffixes CONCURRENTLY instead of serially — each leg is
+    an independent ordered stream drained by exactly one task, so the
+    consumer must still see the 'R' base frame first and then the
+    buffered suffix in exact send order on every leg."""
+    import json
+
+    legs = []
+    for li in range(3):
+        rx = await RemoteInput(SCH, queue_depth=2).start()
+        tx = await RemoteOutput("127.0.0.1", rx.port,
+                                replay=True).connect()
+        legs.append((rx, tx))
+    # a distinct suffix per leg: barrier epochs carry the leg id so an
+    # interleaving across legs could never masquerade as correct order
+    for li, (_rx, tx) in enumerate(legs):
+        await tx.send(Barrier(EpochPair(1, 0), BarrierKind.INITIAL))
+        for ep in range(2, 10):
+            await tx.send(Barrier(EpochPair(1000 * li + ep,
+                                            1000 * li + ep - 1)))
+    # nothing committed => the whole stream is the replay suffix; rewind
+    # all legs at once, exactly like the parallel phase 3
+    counts = await asyncio.gather(
+        *(tx.rewind_replay() for _rx, tx in legs))
+    assert counts == [9, 9, 9]
+    for li, (rx, tx) in enumerate(legs):
+        seen_r = False
+        epochs_after_r = []
+        while not rx._queue.empty():
+            tag, payload = rx._queue.get_nowait()
+            if tag == b"R":
+                seen_r = True
+                epochs_after_r = []
+            elif tag == b"B" and seen_r:
+                epochs_after_r.append(json.loads(payload)["curr"])
+        assert seen_r, f"leg {li}: no rewind frame"
+        expected = [1] + [1000 * li + ep for ep in range(2, 10)]
+        assert epochs_after_r == expected, (li, epochs_after_r)
+        await tx.close()
+        await rx.stop()
