@@ -414,6 +414,10 @@ async def _bench_sql(progress: dict, ddl: list, interval_s: float,
 
     _phase(progress, "setup_ddl")
     s = Session(store=store)
+    # stash the live session + loop for the deadline autopsy
+    # (_one_query_main._bail dumps trace/await-tree/events on abort)
+    progress["session"] = s
+    progress["loop"] = asyncio.get_running_loop()
     # arm the stuck-barrier watchdog WELL below the phase deadline: a
     # stall self-diagnoses (remaining actors + await tree, on stderr)
     # before the deadline kills the process with only a phase name
@@ -629,6 +633,10 @@ async def bench_q7_kill(progress: dict) -> None:
         LocalFsObjectStore(tempfile.mkdtemp(prefix="bench_q7k_")))
     _phase(progress, "setup_ddl")
     s = Session(store=store)
+    # stash the live session + loop for the deadline autopsy
+    # (_one_query_main._bail dumps trace/await-tree/events on abort)
+    progress["session"] = s
+    progress["loop"] = asyncio.get_running_loop()
     await s.execute("SET barrier_stall_threshold_ms = 15000")
     for stmt in [
         "SET streaming_durability = 1",
@@ -788,6 +796,10 @@ async def _bench_q7_kill_worker(progress: dict) -> None:
         procs.append(p)
     s = Session(store=HummockStateStore(
         LocalFsObjectStore(os.path.join(tmp, "c"))))
+    # stash the live session + loop for the deadline autopsy
+    # (_one_query_main._bail dumps trace/await-tree/events on abort)
+    progress["session"] = s
+    progress["loop"] = asyncio.get_running_loop()
     await s.execute("SET barrier_stall_threshold_ms = 15000")
     await s.execute(
         "SET cluster = '" + ",".join(f"127.0.0.1:{p}"
@@ -908,6 +920,10 @@ async def bench_q17(progress: dict) -> None:
     CS = 8192
     _phase(progress, "setup_ddl")
     s = Session()
+    # stash the live session + loop for the deadline autopsy
+    # (_one_query_main._bail dumps trace/await-tree/events on abort)
+    progress["session"] = s
+    progress["loop"] = asyncio.get_running_loop()
     await s.execute("SET barrier_stall_threshold_ms = 15000")
     for stmt in [
         "SET streaming_durability = 0",
@@ -1120,12 +1136,62 @@ def _one_query_main(query: str) -> None:
         hist = ">".join(progress.get("phase_history", []))
         return f"stuck in phase {ph!r} for {dt:.1f}s (path: {hist})"
 
+    async def _autopsy_report(s) -> str:
+        # runs ON the session's loop: the stitched epoch trace + the
+        # local await tree, plus every live worker's tree in cluster
+        # mode — the same evidence the stuck-barrier watchdog prints
+        from risingwave_tpu.utils.trace import \
+            format_stuck_barrier_report
+        wr = None
+        if getattr(s, "cluster", None) is not None:
+            try:
+                wr = await asyncio.wait_for(s.cluster.dump_tasks_all(),
+                                            5)
+            except Exception as e:  # noqa: BLE001
+                wr = {0: f"(worker pull failed: {e!r})"}
+        return format_stuck_barrier_report(s.coord, wr)
+
+    def _autopsy():
+        """Deadline-abort post-mortem to stderr: distributed trace +
+        merged await tree + event-log tail. Runs on the watcher THREAD;
+        a wedged loop degrades to ring-only evidence, never a hang."""
+        s = progress.get("session")
+        if s is None:
+            return
+        print(f"== bench autopsy ({query}) ==", file=sys.stderr)
+        loop = progress.get("loop")
+        try:
+            if loop is not None and loop.is_running():
+                fut = asyncio.run_coroutine_threadsafe(
+                    _autopsy_report(s), loop)
+                print(fut.result(timeout=8), file=sys.stderr)
+            else:
+                from risingwave_tpu.utils.trace import \
+                    format_stuck_barrier_report
+                print(format_stuck_barrier_report(s.coord),
+                      file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — evidence is best-effort
+            print(f"(trace dump failed: {e!r})", file=sys.stderr)
+        try:
+            recs = s.event_log.records(limit=50)
+            print(f"-- last {len(recs)} event-log records --",
+                  file=sys.stderr)
+            for r in recs:
+                print(json.dumps(r), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"(event log dump failed: {e!r})", file=sys.stderr)
+        sys.stderr.flush()
+
     def _bail(reason: str = ""):
         # no-op once the clean final line is out (ADVICE r3 #5: a late
         # timer must not relabel a successful run as abandoned)
         if finals["done"]:
             return
         progress["clean_exit"] = False
+        try:
+            _autopsy()
+        except Exception:  # noqa: BLE001 — never block the abort line
+            pass
         _emit((reason or f"hard deadline {budget}s") + "; "
               + _phase_note(), final=True)
         os._exit(0)

@@ -42,6 +42,11 @@ class _MonitorShim:
     def __init__(self, node: "ComputeNode"):
         self._node = node
         self.recoveries = 0
+        # worker processes keep an in-memory event ring (no durable
+        # root: meta owns the durable log next to the object store)
+        from ..meta.event_log import EventLog
+        self.event_log = EventLog(None)
+        self.recovery_ring = None
 
     @property
     def coord(self):
@@ -78,6 +83,10 @@ class ComputeNode:
         # sealed reports pushed so far (the worker_crash_partial fault
         # point counts these)
         self._sealed_reports = 0
+        # epochs whose closed trace spans already shipped to meta
+        # (piggybacked on the sealed report — the distributed-trace
+        # bundle of utils/trace.py)
+        self._shipped_spans: set[int] = set()
 
     # --------------------------------------------------------- RPC surface
     async def handle(self, method: str, args: dict):
@@ -173,9 +182,22 @@ class ComputeNode:
             # EVERY node arms it, so the worker= filter picks the one
             # victim) — a hard exit, exactly a kill -9 mid-epoch
             os._exit(43)
+        # piggyback this node's closed (not-yet-shipped) epoch spans on
+        # the sealed report: meta stitches them into its per-epoch
+        # timeline (EpochTracer.ingest_worker) with zero extra RPCs
+        spans = None
+        if self.coord is not None:
+            pend = self.coord.tracer.unshipped(self._shipped_spans)
+            if pend:
+                spans = [t.to_dict() for t in pend]
+                self._shipped_spans.update(t.epoch for t in pend)
+                if len(self._shipped_spans) > 512:
+                    keep = sorted(self._shipped_spans)[-128:]
+                    self._shipped_spans = set(keep)
         asyncio.get_running_loop().create_task(
             self.conn.push("sealed", worker_id=self.worker_id,
-                           epoch=epoch, sst_ids=list(sst_ids)))
+                           epoch=epoch, sst_ids=list(sst_ids),
+                           spans=spans))
 
     # ------------------------------------------------------------- deploy
     async def rpc_deploy_prepare(self, deploy_id: int, graph,
@@ -684,6 +706,35 @@ class ComputeNode:
 
     async def rpc_memory_report(self):
         return self.coord.memory.report() if self.coord is not None else []
+
+    async def rpc_dump_tasks(self):
+        """This node's own stuck-barrier diagnosis: in-flight epochs
+        with THEIR remaining (local) actor ids, plus the local await
+        tree — meta's watchdog merges one section per worker so a
+        wedged cluster epoch names worker, actor, and parked frame."""
+        from ..utils.trace import format_stuck_barrier_report
+        if self.coord is None:
+            return "(no coordinator)"
+        lines = []
+        for epoch, st in sorted(self.coord._epochs.items()):
+            lines.append(f"in-flight epoch {epoch}: remaining actors "
+                         f"{sorted(st.remaining)}")
+        lines.append(format_stuck_barrier_report(self.coord))
+        return "\n".join(lines)
+
+    async def rpc_profile_cpu(self, seconds: float = 2.0):
+        """On-demand cpu profile of THIS worker process (collapsed
+        stacks); sampling blocks a helper thread, never the loop."""
+        from ..utils.profiler import profile_cpu
+        return await asyncio.to_thread(profile_cpu, seconds)
+
+    async def rpc_profile_heap(self, seconds: float = 2.0):
+        from ..utils.profiler import profile_heap
+        return await asyncio.to_thread(profile_heap, seconds)
+
+    async def rpc_profile_device(self):
+        from ..utils.profiler import profile_device
+        return profile_device(self.coord)
 
     async def closed(self) -> None:
         """Meta connection died: this node's actors are orphans — tear

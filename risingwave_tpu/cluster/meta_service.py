@@ -337,6 +337,13 @@ class ClusterManager:
             self.session.coord.collect_worker(args["worker_id"],
                                               args["epoch"])
         elif method == "sealed":
+            # piggybacked distributed-trace bundle: the worker ships its
+            # closed epoch spans with the sealed report; stitch them
+            # into meta's per-epoch timelines before the committer wakes
+            spans = args.get("spans")
+            if spans:
+                self.session.coord.tracer.ingest_worker(
+                    handle.worker_id, spans)
             handle.on_sealed(args["epoch"], args["sst_ids"])
         elif method == "failed":
             # an ACTOR died on that node (often collateral: its DCN peer
@@ -755,6 +762,40 @@ class ClusterManager:
             except Exception:  # noqa: BLE001
                 pass
         return rows
+
+    async def dump_tasks_all(self) -> dict[int, str]:
+        """worker_id -> that node's own stuck-barrier report (in-flight
+        epochs with remaining LOCAL actors + its await tree) — the
+        watchdog and /debug/await_tree merge one section per worker."""
+        out = {}
+        for h in self.live_workers():
+            try:
+                out[h.worker_id] = await h.call("dump_tasks", timeout=10)
+            except Exception as e:  # noqa: BLE001 — diagnosis is best-effort
+                out[h.worker_id] = f"(unreachable: {e!r})"
+        return out
+
+    async def profile_all(self, kind: str, seconds: float = 0.0) \
+            -> dict[int, str]:
+        """Fan one /debug/profile/* trigger out to every live worker;
+        worker_id -> that node's profile text (merged under wN/ prefixes
+        by the monitor, mirroring the /metrics merge). Timed profiles
+        run CONCURRENTLY so the wall clock is one window, not N."""
+        method = f"profile_{kind}"
+        args = {} if kind == "device" else {"seconds": seconds}
+        live = list(self.live_workers())
+        # every worker samples the SAME window; timeout covers the
+        # window plus rpc slack
+        timeout = max(10.0, float(seconds) * 2 + 10.0)
+
+        async def one(h):
+            try:
+                return h.worker_id, await h.call(method, timeout=timeout,
+                                                 **args)
+            except Exception as e:  # noqa: BLE001
+                return h.worker_id, f"(unreachable: {e!r})"
+
+        return dict(await asyncio.gather(*(one(h) for h in live)))
 
     def registry_rows(self) -> list[tuple]:
         """SHOW cluster."""

@@ -292,6 +292,17 @@ class Session:
         from collections import deque as _deque
         self._recovery_log = _deque(maxlen=256)
         self.env.partial_recovery = bool(self.config["partial_recovery"])
+        # durable event log (meta/event_log.py): notable cluster events
+        # append next to the object store and survive restart; memory-
+        # only ring on a pure in-memory store. SESSION-owned so it
+        # survives the coordinator swap a full recovery performs.
+        from ..meta.event_log import EventLog
+        self.event_log = EventLog(getattr(objects, "root", None))
+        # recovery post-mortem spans, session-owned for the same reason
+        # (/debug/traces must describe the recovery that replaced the
+        # coordinator whose tracer used to hold them)
+        from ..utils.trace import RecoveryRing
+        self.recovery_ring = RecoveryRing()
         # monitor HTTP endpoint (SET monitor_port / start_monitor)
         self.monitor = None
         # changelog subscription endpoint (SET subscription_port /
@@ -352,6 +363,12 @@ class Session:
         self.coord.stats.configure(self.config["metric_level"])
         thr = self.config["barrier_stall_threshold_ms"]
         self.coord.stall_threshold_ms = float(thr) if thr > 0 else None
+        # attach the session-owned durable event log to every emitter
+        # living on the (swappable) coordinator — re-running this after
+        # auto-recovery re-attaches it to the new incarnation
+        self.coord.event_log = self.event_log
+        self.coord.scrubber.event_log = self.event_log
+        self.coord.logstore.event_log = self.event_log
 
     def _apply_logstore_config(self) -> None:
         """Plumb the log-store session vars to the live hub (re-applied
@@ -599,9 +616,18 @@ class Session:
             # source from here on (SET backup_path to change/detach)
             self.config["backup_path"] = stmt.path
             self._apply_storage_config()
+            self.event_log.emit(
+                "backup", path=stmt.path,
+                generation=meta.get("generation"),
+                epoch=meta.get("epoch"))
             return meta
         if isinstance(stmt, ast.RestoreStmt):
-            return await self.restore_from(stmt.path)
+            meta = await self.restore_from(stmt.path)
+            self.event_log.emit(
+                "restore", path=stmt.path,
+                generation=(meta or {}).get("generation")
+                if isinstance(meta, dict) else None)
+            return meta
         if isinstance(stmt, ast.Explain):
             return self.explain(stmt.stmt)
         if isinstance(stmt, ast.ExplainMv):
@@ -610,7 +636,8 @@ class Session:
             if self.cluster is not None and stmt.what in ("cluster",
                                                           "memory"):
                 return await self._show_cluster(stmt.what)
-            return self.show(stmt.what)
+            return self.show(stmt.what,
+                             limit=getattr(stmt, "limit", None))
         if isinstance(stmt, ast.SetVar):
             if stmt.name not in self.CONFIG_VARS:
                 raise BindError(f"unknown session variable {stmt.name!r}")
@@ -911,9 +938,20 @@ class Session:
                          str(r["spilled_rows"])))
         return rows
 
-    def show(self, what: str) -> list:
+    def show(self, what: str, limit=None) -> list:
         """SHOW <objects|variable> (reference: handler/show.rs +
         session_config reads)."""
+        if what == "events":
+            # the durable event log, newest last: (seq, ts, kind,
+            # details-json) — `SHOW events LIMIT n` bounds the tail
+            rows = []
+            for r in self.event_log.records(limit=limit or 32):
+                extra = {k: v for k, v in r.items()
+                         if k not in ("seq", "ts", "kind")}
+                rows.append((str(r["seq"]),
+                             f"{r['ts']:.3f}", r["kind"],
+                             json.dumps(extra, sort_keys=True)))
+            return rows
         if what == "memory":
             # per-executor HBM accounting from the memory manager
             return [(r["executor"], str(r["state_bytes"]),
@@ -1705,6 +1743,12 @@ class Session:
                               "duration_s": round(dur_ns / 1e9, 6),
                               "actors": list(actors)}
         self.coord.tracer.note_recovery(scope, cause, dur_ns, actors)
+        # session-owned ring: survives the coordinator swap a FULL
+        # recovery performs (the tracer above dies with it)
+        self.recovery_ring.note_recovery(scope, cause, dur_ns, actors)
+        self.event_log.emit("recovery", scope=scope, cause=cause,
+                            duration_s=round(dur_ns / 1e9, 6),
+                            actors=list(actors))
         # flap detection: the recovery RATE per cause feeds the backoff
         # base and the degraded surface (recovery_flapping{cause})
         self._recovery_log.append((_time.monotonic(), cause))
@@ -1713,6 +1757,8 @@ class Session:
         for c in seen:
             GLOBAL_METRICS.gauge("recovery_flapping", cause=c).set(
                 1.0 if c in flapping else 0.0)
+            if c in flapping:
+                self.event_log.emit("flap_detected", cause=c)
 
     async def _partial_recover(self, flow, cone) -> list[int]:
         """Rebuild one deployment's failure CONE in place (the narrow
@@ -2015,6 +2061,7 @@ class Session:
         DDL log)."""
         await self.stop_monitor()
         await self.stop_subscription_server()
+        self.event_log.close()
         if self.cluster is not None:
             for name in reversed(list(self.catalog.sinks)):
                 sink = self.catalog.sinks.pop(name)

@@ -205,6 +205,7 @@ class RestoreStmt:
 @dataclass
 class Show:
     what: str           # sources|tables|materialized_views|sinks|all|<var>
+    limit: object = None   # SHOW events LIMIT n — tail bound
 
 
 @dataclass
@@ -323,8 +324,11 @@ class Parser:
                     self.expect("ident", "views")
                 what = "materialized_views"
             # else: object class or a session variable name
+            limit = None
+            if self.accept("kw", "limit"):
+                limit = int(self.expect("num").val)
             self.accept("op", ";")
-            return Show(what)
+            return Show(what, limit=limit)
         if self.accept("kw", "set"):
             # SET var = value — session config (reference: session_config/)
             name = self.next().val

@@ -436,6 +436,9 @@ class LogStoreHub:
         self._commit_event = asyncio.Event()
         self.failure: Optional[tuple[str, BaseException]] = None
         self.aborted = False
+        # durable event log (meta/event_log.py), attached by the
+        # session: a sink parking on delivery failure leaves a record
+        self.event_log = None
         # durable-cursor lease (SET subscription_cursor_ttl_ms): a named
         # cursor with NO live pump renewing its lease for this long
         # stops pinning changelog retention — the abandoned-replica
@@ -571,6 +574,9 @@ class LogStoreHub:
     def fail(self, name: str, exc: BaseException) -> None:
         if self.failure is None:
             self.failure = (name, exc)
+            if self.event_log is not None:
+                self.event_log.emit("sink_park", sink=name,
+                                    error=repr(exc))
         self.commit_seq += 1
         self._commit_event.set()          # wake waiters so they observe it
 

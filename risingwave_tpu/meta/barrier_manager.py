@@ -103,6 +103,12 @@ class BarrierCoordinator:
         # TracingContext + grafana trace panel analogue)
         from ..utils.trace import EpochTracer
         self.tracer = EpochTracer()
+        # durable event log (meta/event_log.py): the session attaches
+        # its log here (and re-attaches after recovery swaps the
+        # coordinator); None = no emissions. Every control-plane
+        # incident this coordinator detects (barrier stalls, broker
+        # split adoptions) goes through the one choke point.
+        self.event_log = None
         # stuck-barrier watchdog (the MonitorService/risectl-trace
         # analogue): a background task fires once per stalled epoch when
         # an in-flight barrier exceeds this threshold — logs the full
@@ -289,6 +295,14 @@ class BarrierCoordinator:
                     adds.setdefault(sid, []).extend(sp)
         if not adds:
             return None
+        if self.event_log is not None:
+            # split adoption is a topology event an operator wants in
+            # the post-mortem record (rw_event_logs analogue)
+            self.event_log.emit(
+                "broker_split_adopt",
+                splits={str(sid): [getattr(s, "split_id", str(s))
+                                   for s in sp]
+                        for sid, sp in adds.items()})
         from ..stream.message import AddSplitsMutation
         return AddSplitsMutation(
             {sid: tuple(v) for sid, v in adds.items()})
@@ -503,6 +517,30 @@ class BarrierCoordinator:
                     if age_ms >= thr:
                         self._stalls_reported.add(epoch)
                         self._m_stalls.inc()
+                        remaining = sorted(st.remaining)
+                        if self.event_log is not None:
+                            self.event_log.emit(
+                                "barrier_stall", epoch=epoch,
+                                age_ms=round(age_ms, 1),
+                                remaining=remaining)
+                        # cluster mode: pull every live worker's own
+                        # stuck-barrier report (its in-flight remaining
+                        # actors + await tree) over rpc.py — the merged
+                        # report then names the stalled WORKER, ACTOR
+                        # and parked FRAME, not just "phase collect".
+                        # The watchdog is an async task, so the fan-out
+                        # awaits here without blocking collection.
+                        worker_reports = None
+                        if self.workers:
+                            worker_reports = {}
+                            for wid, handle in list(self.workers.items()):
+                                try:
+                                    worker_reports[wid] = await \
+                                        handle.call("dump_tasks",
+                                                    timeout=5)
+                                except Exception as e:  # noqa: BLE001
+                                    worker_reports[wid] = \
+                                        f"(unreachable: {e!r})"
                         # stderr, NOT stdout: bench.py and the profile
                         # gates parse this process's stdout for JSON
                         # result lines — a multi-line diagnosis landing
@@ -512,8 +550,9 @@ class BarrierCoordinator:
                         print(
                             f"[stuck barrier] epoch {epoch} in flight "
                             f"{age_ms:.0f}ms (threshold {thr:.0f}ms); "
-                            f"remaining actors {sorted(st.remaining)}\n"
-                            + format_stuck_barrier_report(self),
+                            f"remaining actors {remaining}\n"
+                            + format_stuck_barrier_report(
+                                self, worker_reports),
                             flush=True, file=sys.stderr)
             poll_s = max(0.02, min(1.0, (thr or 1000.0) / 1e3 / 8))
             await asyncio.sleep(poll_s)
@@ -748,6 +787,12 @@ class BarrierCoordinator:
                 res = store.commit_sealed(batch)
                 t3 = time.monotonic_ns()
                 self.committed_epochs.append(job.prev_epoch)
+                # annotate BEFORE the commit listener: on a compute node
+                # the listener ships this epoch's closed span to meta
+                # piggybacked on the sealed report, and the span must
+                # already carry its checkpoint-pipeline phases
+                self.tracer.annotate(job.curr_epoch, seal_ns=t1 - t0,
+                                     upload_ns=t2 - t1, commit_ns=t3 - t2)
                 if self.commit_listener is not None:
                     self.commit_listener(
                         job.prev_epoch,
@@ -758,8 +803,6 @@ class BarrierCoordinator:
                 self._m_seal.observe((t1 - t0) / 1e9)
                 self._m_upload.observe((t2 - t1) / 1e9)
                 self._m_commit.observe((t3 - t2) / 1e9)
-                self.tracer.annotate(job.curr_epoch, seal_ns=t1 - t0,
-                                     upload_ns=t2 - t1, commit_ns=t3 - t2)
             except asyncio.CancelledError:
                 self._inflight -= 1
                 self._slot_free.set()

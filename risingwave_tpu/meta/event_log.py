@@ -1,0 +1,163 @@
+"""Durable event log — the `rw_event_logs` analogue.
+
+Reference: the reference persists operator-relevant incidents (barrier
+collection failures, recovery runs, sink faults) into a system table
+(`rw_catalog.rw_event_logs`) so a post-mortem can ask "what happened
+around 14:02" AFTER the process that suffered it restarted. Same shape
+here: every notable control-plane incident — recoveries, barrier
+stalls, flap detections, scrub findings/quarantines, backup/restore
+generations, sink-delivery parks, broker split adoptions — flows
+through ONE choke point (`EventLog.emit(kind, **fields)`) and appends a
+crc-framed JSON record to a size-rolled log living NEXT TO the object
+store, with the broker segments' torn-tail-tolerant framing
+(broker/log.py): a record is a `(len, crc32)` header + JSON body,
+appended in a single write+fsync, and a reopen drops a torn trailing
+record WHOLE (crc or length mismatch truncates the tail) so a SIGKILL
+mid-append can never surface half an event.
+
+Surfaced by `SHOW events [LIMIT n]` (frontend/session.py) and
+`/debug/events?since=ts` (meta/monitor_service.py). Sessions over a
+non-durable store still get the in-memory ring (post-mortems within
+the process); only durability is lost.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import time
+from collections import deque
+
+# same frame as the broker segments: (body_len, crc32(body)) big-endian
+_FRAME = struct.Struct("!II")
+
+EVENTS_DIR = "events"
+
+
+class EventLog:
+    """Append-only incident log: in-memory ring mirror (fast reads)
+    backed by crc-framed, size-rolled segment files when `root` names a
+    durable directory (None = ring only)."""
+
+    def __init__(self, root=None, segment_bytes: int = 1 << 20,
+                 keep: int = 4096, max_segments: int = 8):
+        self.segment_bytes = int(segment_bytes)
+        self.max_segments = max(1, int(max_segments))
+        self._ring: deque[dict] = deque(maxlen=keep)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._dir = None
+        self._f = None
+        if root:
+            self._dir = os.path.join(root, EVENTS_DIR)
+            os.makedirs(self._dir, exist_ok=True)
+            self._load()
+
+    # ------------------------------------------------------------- load
+    def _segments(self) -> list:
+        return sorted(f for f in os.listdir(self._dir)
+                      if f.endswith(".seg"))
+
+    def _load(self) -> None:
+        """Replay every segment into the ring; a torn trailing frame in
+        the LAST segment is dropped whole (truncated away) — the
+        SIGKILL-mid-append contract the broker segments established."""
+        segs = self._segments()
+        for i, name in enumerate(segs):
+            path = os.path.join(self._dir, name)
+            with open(path, "rb") as f:
+                data = f.read()
+            pos = 0
+            while pos + _FRAME.size <= len(data):
+                blen, crc = _FRAME.unpack_from(data, pos)
+                body = data[pos + _FRAME.size: pos + _FRAME.size + blen]
+                if len(body) != blen or _crc(body) != crc:
+                    break                       # torn tail: drop whole
+                try:
+                    rec = json.loads(body)
+                except ValueError:
+                    break
+                self._ring.append(rec)
+                self._seq = max(self._seq, int(rec.get("seq", 0)) + 1)
+                pos += _FRAME.size + blen
+            if pos != len(data) and i == len(segs) - 1:
+                with open(path, "ab") as t:
+                    t.truncate(pos)
+
+    # ------------------------------------------------------------ append
+    def _active_file(self):
+        """Open (or roll) the active segment; rolling prunes the oldest
+        segments past `max_segments` — the size bound of 'size-rolled'."""
+        if self._f is not None and not self._f.closed:
+            if self._f.tell() < self.segment_bytes:
+                return self._f
+            self._f.close()          # roll: a fresh segment takes over
+            self._f = None
+        segs = self._segments()
+        if self._f is None and segs:
+            path = os.path.join(self._dir, segs[-1])
+            if os.path.getsize(path) < self.segment_bytes:
+                self._f = open(path, "ab")
+                return self._f
+        for name in segs[:-(self.max_segments - 1)] \
+                if self.max_segments > 1 else segs:
+            try:
+                os.remove(os.path.join(self._dir, name))
+            except OSError:
+                pass
+        self._f = open(os.path.join(
+            self._dir, f"{self._seq:020d}.seg"), "ab")
+        return self._f
+
+    def emit(self, kind: str, **fields) -> dict:
+        """THE choke point: one incident in, one framed record out.
+        Never raises into the emitter — an unwritable log must not turn
+        an observability note into a second failure."""
+        with self._lock:
+            rec = {"seq": self._seq, "ts": time.time(),
+                   "kind": str(kind), **fields}
+            self._seq += 1
+            self._ring.append(rec)
+            if self._dir is None:
+                return rec
+            try:
+                body = json.dumps(rec, default=str).encode()
+                frame = _FRAME.pack(len(body), _crc(body)) + body
+                f = self._active_file()
+                f.write(frame)           # ONE write: torn = whole frame
+                f.flush()
+                os.fsync(f.fileno())
+            except OSError:
+                pass
+            return rec
+
+    # ------------------------------------------------------------- reads
+    def records(self, limit=None, since=None, kind=None) -> list:
+        """Newest-last slice of the ring: `since` filters on the wall
+        timestamp, `kind` on the event kind, `limit` keeps the newest N."""
+        with self._lock:
+            out = list(self._ring)
+        if since is not None:
+            since = float(since)
+            out = [r for r in out if r.get("ts", 0) >= since]
+        if kind is not None:
+            out = [r for r in out if r.get("kind") == kind]
+        if limit is not None:
+            out = out[-int(limit):]
+        return out
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None and not self._f.closed:
+                self._f.close()
+            self._f = None
+
+
+def _crc(body: bytes) -> int:
+    import zlib
+    return zlib.crc32(bytes(body)) & 0xFFFFFFFF

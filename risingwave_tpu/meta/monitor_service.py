@@ -7,15 +7,26 @@ one tiny asyncio HTTP/1.0 listener (stdlib only — no aiohttp, no
 prometheus_client) so a REAL Prometheus can scrape a running session
 and an operator can curl the stuck-barrier evidence:
 
-    /metrics          full text exposition (render_prometheus)
-    /healthz          JSON liveness: committed epoch, barrier p50,
-                      in-flight epochs, actor count
-    /debug/traces     recent + in-flight epoch spans (the \\trace verb)
-    /debug/await_tree every task's await stack (the \\stacks verb)
+    /metrics                  full text exposition (render_prometheus)
+    /healthz                  JSON liveness: committed epoch, barrier
+                              p50, in-flight epochs, actor count
+    /debug/traces             recent + in-flight epoch spans — stitched
+                              across workers in cluster mode;
+                              ?format=json | ?format=chrome (Perfetto)
+    /debug/await_tree         every task's await stack; cluster mode
+                              appends one section per live worker
+    /debug/events?since=ts    the durable event log (meta/event_log.py)
+    /debug/profile/cpu?seconds=N    collapsed-stack cpu samples
+    /debug/profile/heap?seconds=N   tracemalloc top-N allocation diff
+    /debug/profile/device           per-executor HBM + jax live buffers
 
 Off by default; `SET monitor_port = <port>` starts it (0 stops it).
-Handlers run on the event loop and only READ host state — a scrape can
-never dispatch device work or block a barrier.
+Read-only handlers run on the event loop and only READ host state; the
+on-demand profilers run their timed sampling on a worker thread
+(`asyncio.to_thread`) so even a 10s profile never blocks a barrier. In
+cluster mode every profile/dump endpoint fans out to the live workers
+over rpc.py and merges their output under `wN` prefixes, mirroring the
+/metrics merge.
 """
 
 from __future__ import annotations
@@ -23,6 +34,7 @@ from __future__ import annotations
 import asyncio
 import json
 from typing import Optional
+from urllib.parse import parse_qsl
 
 
 def merge_worker_label(text: str, worker: str) -> str:
@@ -49,6 +61,32 @@ def merge_worker_label(text: str, worker: str) -> str:
     return "\n".join(out)
 
 
+def merge_profile(kind: str, local: str,
+                  worker_texts: dict) -> str:
+    """Merge per-worker profile text under the local (meta) output.
+    cpu profiles are collapsed stacks — the worker becomes the stack
+    ROOT frame (`wN;...`), so a flamegraph shows one subtree per
+    worker; heap/device rows get a `wN/` path prefix like the
+    memory-report merge."""
+    parts = [local.rstrip("\n")]
+    for wid in sorted(worker_texts):
+        pref = f"w{wid}"
+        for line in str(worker_texts[wid]).splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("#"):
+                parts.append(f"# {pref}: {line.lstrip('# ')}")
+            elif kind == "cpu":
+                parts.append(f"{pref};{line}")
+            else:
+                parts.append(f"{pref}/{line}")
+    return "\n".join(parts) + "\n"
+
+
+_TEXT = "text/plain; charset=utf-8"
+_JSON = "application/json"
+
+
 class MonitorService:
     def __init__(self, session, host: str = "127.0.0.1", port: int = 0):
         self._session = session          # live handle: coord may be
@@ -72,7 +110,7 @@ class MonitorService:
 
     # ------------------------------------------------------------ routing
     def _route(self, path: str) -> tuple[int, str, str]:
-        """-> (status, content_type, body). Pure host reads."""
+        """Sync routes: pure host reads, no awaits."""
         from ..utils.metrics import GLOBAL_METRICS
         coord = self._session.coord
         if path == "/metrics":
@@ -121,25 +159,113 @@ class MonitorService:
                 # (the recovery-time SLO's operator surface)
                 payload["last_recovery"] = last
             body = json.dumps(payload)
-            return 200, "application/json", body + "\n"
+            return 200, _JSON, body + "\n"
         if path == "/debug/traces":
-            lines = []
-            stuck = coord.tracer.open_traces()
-            if stuck:
-                lines.append("== in-flight epochs ==")
-                lines.extend(t.render() for t in stuck)
-            lines.append("== recent epochs ==")
-            lines.extend(t.render() for t in coord.tracer.recent())
-            rec = coord.tracer.render_recoveries()
-            if rec:
-                lines.append("== recoveries ==")
-                lines.extend(rec)
-            return 200, "text/plain; charset=utf-8", "\n".join(lines) + "\n"
+            # text render is a pure host read; the async router adds
+            # the format= variants on top of this same handler
+            return self._route_traces({})
+        return 404, _TEXT, "not found\n"
+
+    def _recovery_source(self):
+        """Recovery spans prefer the SESSION-owned ring (it survives
+        the coordinator swap a full recovery performs); the tracer's
+        back-compat mirror covers shims without one."""
+        ring = getattr(self._session, "recovery_ring", None)
+        return ring if ring is not None else self._session.coord.tracer
+
+    def _route_traces(self, params: dict) -> tuple[int, str, str]:
+        from ..utils.trace import traces_to_chrome, traces_to_json
+        coord = self._session.coord
+        stuck = coord.tracer.open_traces()
+        recent = coord.tracer.recent()
+        fmt = params.get("format", "text")
+        if fmt == "json":
+            rec = list(self._recovery_source().recoveries)
+            body = json.dumps(traces_to_json(stuck + recent, rec))
+            return 200, _JSON, body + "\n"
+        if fmt == "chrome":
+            body = json.dumps(traces_to_chrome(stuck + recent))
+            return 200, _JSON, body + "\n"
+        lines = []
+        if stuck:
+            lines.append("== in-flight epochs ==")
+            lines.extend(t.render() for t in stuck)
+        lines.append("== recent epochs ==")
+        lines.extend(t.render() for t in recent)
+        rec = self._recovery_source().render_recoveries()
+        if rec:
+            lines.append("== recoveries ==")
+            lines.extend(rec)
+        return 200, _TEXT, "\n".join(lines) + "\n"
+
+    async def _route_async(self, path: str,
+                           params: dict) -> tuple[int, str, str]:
+        """Full router: async routes (cluster fan-outs, timed
+        profilers) first, then the sync reads."""
+        from ..utils.metrics import GLOBAL_METRICS
+        session = self._session
+        cluster = getattr(session, "cluster", None)
+        if path == "/metrics":
+            body = GLOBAL_METRICS.render_prometheus()
+            if cluster is not None:
+                # one scrape sees the whole cluster: every live
+                # compute node's series merged under worker="wN"
+                # (the meta process's own series carry no label)
+                parts = [body.rstrip("\n")]
+                for wid, text in (await cluster.scrape_all()).items():
+                    parts.append(merge_worker_label(text.rstrip("\n"),
+                                                    f"w{wid}"))
+                body = "\n".join(parts) + "\n"
+            return (200, "text/plain; version=0.0.4; charset=utf-8",
+                    body)
+        if path == "/debug/traces":
+            return self._route_traces(params)
         if path == "/debug/await_tree":
             from ..utils.trace import dump_task_tree
-            return (200, "text/plain; charset=utf-8",
-                    dump_task_tree() + "\n")
-        return 404, "text/plain; charset=utf-8", "not found\n"
+            body = dump_task_tree() + "\n"
+            if cluster is not None:
+                for wid, text in sorted(
+                        (await cluster.dump_tasks_all()).items()):
+                    body += f"== worker w{wid} ==\n{text}\n"
+            return 200, _TEXT, body
+        if path == "/debug/events":
+            log = getattr(session, "event_log", None)
+            try:
+                limit = (int(params["limit"])
+                         if "limit" in params else None)
+                since = (float(params["since"])
+                         if "since" in params else None)
+            except ValueError:
+                return 400, _TEXT, "bad since/limit\n"
+            recs = [] if log is None else log.records(
+                limit=limit, since=since, kind=params.get("kind"))
+            return 200, _JSON, json.dumps(recs) + "\n"
+        if path.startswith("/debug/profile/"):
+            kind = path.rsplit("/", 1)[-1]
+            if kind not in ("cpu", "heap", "device"):
+                return 404, _TEXT, f"unknown profile {kind!r}\n"
+            try:
+                seconds = float(params.get("seconds", 2.0))
+            except ValueError:
+                return 400, _TEXT, "bad seconds\n"
+            from ..utils import profiler
+            if kind == "cpu":
+                local_coro = asyncio.to_thread(
+                    profiler.profile_cpu, seconds)
+            elif kind == "heap":
+                local_coro = asyncio.to_thread(
+                    profiler.profile_heap, seconds)
+            else:
+                async def _dev():
+                    return profiler.profile_device(session.coord)
+                local_coro = _dev()
+            if cluster is None:
+                return 200, _TEXT, await local_coro
+            # local profile and worker fan-out sample the SAME window
+            local, workers = await asyncio.gather(
+                local_coro, cluster.profile_all(kind, seconds))
+            return 200, _TEXT, merge_profile(kind, local, workers)
+        return self._route(path)
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
@@ -147,28 +273,20 @@ class MonitorService:
             request = await asyncio.wait_for(reader.readline(), timeout=5)
             parts = request.decode("latin-1", "replace").split()
             path = parts[1] if len(parts) >= 2 else "/"
-            path = path.split("?", 1)[0]
+            path, _, query = path.partition("?")
+            params = dict(parse_qsl(query))
             # drain headers (we never need them; HTTP/1.0, close after)
             while True:
                 line = await asyncio.wait_for(reader.readline(), timeout=5)
                 if line in (b"\r\n", b"\n", b""):
                     break
             try:
-                status, ctype, body = self._route(path)
-                cluster = getattr(self._session, "cluster", None)
-                if path == "/metrics" and cluster is not None:
-                    # one scrape sees the whole cluster: every live
-                    # compute node's series merged under worker="wN"
-                    # (the meta process's own series carry no label)
-                    parts = [body.rstrip("\n")]
-                    for wid, text in (await cluster.scrape_all()).items():
-                        parts.append(merge_worker_label(text.rstrip("\n"),
-                                                        f"w{wid}"))
-                    body = "\n".join(parts) + "\n"
+                status, ctype, body = await self._route_async(path,
+                                                              params)
             except Exception as e:        # a scrape must never kill us
                 status, ctype, body = (500, "text/plain",
                                        f"internal error: {e}\n")
-            reason = {200: "OK", 404: "Not Found",
+            reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
                       500: "Internal Server Error"}.get(status, "OK")
             payload = body.encode("utf-8", "replace")
             writer.write(

@@ -41,6 +41,9 @@ class StorageScrubber:
         self.batch = int(batch)         # objects verified per pulse
         self._count = 0
         self._cursor = 0
+        # durable event log (meta/event_log.py) — the session attaches
+        # it so a scrub finding leaves an operator-visible record
+        self.event_log = None
         # orphans sighted last pulse — the two-sighting sweep grace
         self._orphan_seen: set[str] = set()
         # report surface (SHOW storage)
@@ -107,6 +110,9 @@ class StorageScrubber:
                 if not ok:
                     self.corruptions += 1
                     STORAGE_SCRUB_CORRUPTIONS.inc()
+                    if self.event_log is not None:
+                        self.event_log.emit("scrub_corruption",
+                                            path=path)
             self._cursor = (self._cursor + self.batch) % len(refs)
         # ---- orphan accounting + grace-period sweep ----
         from .hummock import _sst_path

@@ -29,6 +29,7 @@ loop is the pre-observability one.
 from __future__ import annotations
 
 import enum
+import time
 from typing import Optional
 
 import numpy as np
@@ -119,6 +120,79 @@ class ChannelObs:
                      ("stream_exchange_blocked_put_seconds_total", labels)]
 
 
+class ExecutorObs:
+    """Per-executor child handle inside a fused chain: attributes the
+    actor's row flow and wall time to each executor position (labels
+    {actor, executor, pos}; pos 0 = chain root, so the root child's
+    row count equals the actor-level total). The hot path only stashes
+    the chunk's vis-mask reference — NO device dispatch per chunk (a
+    per-executor jnp.sum would multiply dispatch count by chain
+    length); `ActorObs.on_barrier` flushes every child after the epoch
+    fence already blocked, so the host-side count there syncs nothing
+    extra."""
+
+    __slots__ = ("row_count", "busy_seconds", "_vis", "busy_ns",
+                 "keys")
+
+    def __init__(self, registry: MetricsRegistry, actor_id: int,
+                 executor_label: str, pos: int):
+        labels = dict(actor=str(actor_id), executor=executor_label,
+                      pos=str(pos))
+        self.row_count = registry.counter(
+            "stream_actor_row_count", **labels)
+        self.busy_seconds = registry.counter(
+            "stream_actor_busy_seconds_total", **labels)
+        self.keys = [("stream_actor_row_count", labels),
+                     ("stream_actor_busy_seconds_total", labels)]
+        self._vis = []
+        self.busy_ns = 0
+
+    def note_chunk(self, chunk) -> None:
+        self._vis.append(chunk.vis)
+
+    def flush(self) -> None:
+        if self._vis:
+            n = 0
+            for v in self._vis:
+                n += int(np.asarray(v).sum())
+            self.row_count.inc(n)
+            self._vis.clear()
+        if self.busy_ns:
+            self.busy_seconds.inc(self.busy_ns / 1e9)
+            self.busy_ns = 0
+
+
+def _wrap_executor(ex) -> None:
+    """Install the per-executor counting passthrough ONCE per executor
+    instance. The wrapper consults `ex._exec_obs` per message (None =
+    pure passthrough), so `SET metric_level` toggles attribution live
+    without touching a generator chain that is already running. Row
+    counts stay lazy device scalars; the wall clock charged to a child
+    is the time its frame (and everything upstream of it) took to
+    produce each item — pos-ordered series therefore nest, and the
+    difference between adjacent positions isolates one executor."""
+    if getattr(ex, "_exec_obs_wrapped", False):
+        return
+    inner = ex.execute
+
+    def execute(*a, **k):
+        async def _gen():
+            t0 = time.monotonic_ns()
+            async for item in inner(*a, **k):
+                obs = ex._exec_obs
+                if obs is not None:
+                    obs.busy_ns += time.monotonic_ns() - t0
+                    if hasattr(item, "cardinality"):
+                        obs.note_chunk(item)
+                yield item
+                t0 = time.monotonic_ns()
+        return _gen()
+
+    ex._exec_obs = None
+    ex.execute = execute
+    ex._exec_obs_wrapped = True
+
+
 class ActorObs:
     """Per-actor instrument bundle. Interval cells reset at each
     barrier; the phase split they produce rides into the EpochTrace."""
@@ -127,7 +201,7 @@ class ActorObs:
         "actor_id", "debug", "apply_ns", "persist_ns", "input_wait_ns",
         "fence_ns", "_row_acc", "row_count", "chunks_in", "chunks_out",
         "dispatch", "busy_seconds", "align_seconds", "keys",
-        "_occupancy", "registry",
+        "_occupancy", "registry", "children",
     )
 
     def __init__(self, registry: MetricsRegistry, actor_id: int,
@@ -143,6 +217,7 @@ class ActorObs:
         self._row_acc = None          # lazy device scalar (sum of chunk
         #                               cardinalities this interval)
         self._occupancy = []          # (executor_label, part, gauge, fn)
+        self.children = []            # ExecutorObs, chain-walk order
         self.keys = []
         if debug:
             labels = dict(actor=str(actor_id), executor=executor_label)
@@ -204,6 +279,8 @@ class ActorObs:
                 self.row_count.inc(int(np.asarray(self._row_acc)))
             self.busy_seconds.inc((self.apply_ns + self.persist_ns) / 1e9)
             self.align_seconds.inc(align_ns / 1e9)
+            for child in self.children:
+                child.flush()
             for _label, _part, gauge, fn in self._occupancy:
                 try:
                     gauge.set(float(fn()))
@@ -318,6 +395,23 @@ class StreamingStats:
         obs = ActorObs(self.registry, actor.actor_id, executor_label,
                        debug)
         chan_idx = 0
+        for pos, ex in enumerate(_iter_chain(root)):
+            # per-executor attribution: wrap execute() once (pure
+            # passthrough until a child handle fills the slot); at
+            # debug, each chain position gets its own {actor, executor,
+            # pos} row/busy series so a hot fused chain names the
+            # executor, not just the actor
+            _wrap_executor(ex)
+            if debug:
+                child = ExecutorObs(
+                    self.registry, actor.actor_id,
+                    f"{scope}/"
+                    f"{getattr(ex, 'identity', type(ex).__name__)}", pos)
+                ex._exec_obs = child
+                obs.children.append(child)
+                obs.keys.extend(child.keys)
+            else:
+                ex._exec_obs = None
         for ex in _iter_chain(root):
             if hasattr(ex, "barrier_queue") and hasattr(ex, "obs"):
                 # sources: barrier-queue wait is align (idle) time
@@ -362,6 +456,7 @@ class StreamingStats:
                 self.registry.remove(name, **labels)
         actor.obs = None
         for ex in _iter_chain(root):
+            ex._exec_obs = None       # wrapper stays; slot goes dark
             if hasattr(ex, "barrier_queue") and hasattr(ex, "obs"):
                 ex.obs = None
             if isinstance(ex, (ChannelInput, MergeExecutor)):

@@ -1,0 +1,169 @@
+"""On-demand profiling — the MonitorService's heap/cpu/device triggers.
+
+Reference: the reference's MonitorService exposes on-demand profiling
+RPCs (StackTrace / Profiling / HeapProfiling — stream_manager.rs:66,
+monitor_service.proto): an operator hits an endpoint on a LIVE node and
+gets a profile back, no restart, no always-on overhead. Same shape
+here, stdlib-only:
+
+  * profile_cpu(seconds)   — a helper thread samples every Python
+    thread's current frame stack (`sys._current_frames`) at ~100Hz and
+    emits COLLAPSED-STACK lines ("thread;frameA;frameB N") so standard
+    flamegraph tooling consumes the output directly.
+  * profile_heap(seconds)  — tracemalloc enable -> snapshot -> wait ->
+    snapshot -> top-N allocation diff by source line (enable/disable is
+    scoped to the call when tracing was off, so idle cost stays zero).
+  * profile_device(coord)  — per-executor HBM from the coordinator's
+    MemoryManager accounting plus jax live-buffer totals when a device
+    runtime is importable (gated: works CPU-only too).
+
+Both timed profilers BLOCK for `seconds` — callers on the event loop
+run them via `asyncio.to_thread` (meta/monitor_service.py does; the
+worker RPC path in cluster/compute_node.py does too).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+# sampling cadence for the cpu profiler: ~100Hz is the flamegraph
+# convention — coarse enough to stay invisible next to device steps,
+# fine enough that a hot loop dominates the sample counts
+DEFAULT_HZ = 100.0
+
+
+def _frame_name(frame) -> str:
+    """One collapsed-stack frame token: file.py:func:line with the
+    separator characters (';' and whitespace) sanitized so the line
+    splits cleanly back into frames."""
+    code = frame.f_code
+    fname = code.co_filename.rsplit("/", 1)[-1]
+    tok = f"{fname}:{code.co_name}:{frame.f_lineno}"
+    return tok.replace(";", ",").replace(" ", "_")
+
+
+def _thread_names() -> dict:
+    return {t.ident: t.name for t in threading.enumerate()}
+
+
+def profile_cpu(seconds: float, hz: float = DEFAULT_HZ,
+                max_seconds: float = 60.0) -> str:
+    """Sample every live thread's stack for `seconds`, return collapsed
+    stacks: one line per unique (thread, root-first frame chain), the
+    trailing integer its sample count. Blocking — run off-loop."""
+    seconds = max(0.05, min(float(seconds), max_seconds))
+    interval = 1.0 / max(1.0, float(hz))
+    counts: dict = {}
+    samples = 0
+    me = threading.get_ident()
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        names = _thread_names()
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue   # the sampler's own busy-loop is noise
+            stack = []
+            f = frame
+            while f is not None:
+                stack.append(_frame_name(f))
+                f = f.f_back
+            stack.reverse()   # root-first, the collapsed-stack order
+            tname = names.get(ident, f"thread-{ident}")
+            key = ";".join(
+                [tname.replace(";", ",").replace(" ", "_")] + stack)
+            counts[key] = counts.get(key, 0) + 1
+        samples += 1
+        time.sleep(interval)
+    head = (f"# cpu profile: {samples} samples over {seconds:.2f}s "
+            f"at {hz:.0f}Hz")
+    lines = [head]
+    for key, n in sorted(counts.items(), key=lambda kv: -kv[1]):
+        lines.append(f"{key} {n}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_collapsed(text: str) -> list:
+    """Parse collapsed-stack text back into [(frames, count)] — the
+    profiler's own round-trip check (tests + gate use it)."""
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        stack, _, count = line.rpartition(" ")
+        if not stack or not count.isdigit():
+            raise ValueError(f"bad collapsed-stack line: {line!r}")
+        out.append((stack.split(";"), int(count)))
+    return out
+
+
+def profile_heap(seconds: float, top: int = 30,
+                 max_seconds: float = 60.0) -> str:
+    """Allocation growth over a window: tracemalloc snapshot at start
+    and end, top-N source lines by net new bytes. Enables tracemalloc
+    for the call when it was off (and disables it after), so the idle
+    process pays nothing. Blocking — run off-loop."""
+    import tracemalloc
+    seconds = max(0.05, min(float(seconds), max_seconds))
+    was_tracing = tracemalloc.is_tracing()
+    if not was_tracing:
+        tracemalloc.start()
+    try:
+        before = tracemalloc.take_snapshot()
+        time.sleep(seconds)
+        after = tracemalloc.take_snapshot()
+        current, peak = tracemalloc.get_traced_memory()
+    finally:
+        if not was_tracing:
+            tracemalloc.stop()
+    stats = after.compare_to(before, "lineno")
+    lines = [f"# heap profile: {seconds:.2f}s window, "
+             f"traced current={current} peak={peak}",
+             "# size_diff_b count_diff source"]
+    for st in stats[:max(1, int(top))]:
+        frame = st.traceback[0] if st.traceback else None
+        where = (f"{frame.filename.rsplit('/', 1)[-1]}:{frame.lineno}"
+                 if frame is not None else "?")
+        lines.append(f"{st.size_diff:+d} {st.count_diff:+d} {where}")
+    return "\n".join(lines) + "\n"
+
+
+def profile_device(coord) -> str:
+    """Device-memory report: per-executor HBM accounting rows from the
+    coordinator's MemoryManager (always available — it is pure
+    bookkeeping) plus the jax live-buffer totals per device when a
+    runtime is importable."""
+    lines = ["# device profile"]
+    memory = getattr(coord, "memory", None)
+    rows = memory.report() if memory is not None else []
+    lines.append("# executor state_bytes evicted_bytes reload_count "
+                 "spilled_rows")
+    for r in rows:
+        lines.append(f"{r['executor']} {r['state_bytes']} "
+                     f"{r['evicted_bytes']} {r['reload_count']} "
+                     f"{r['spilled_rows']}")
+    if not rows:
+        lines.append("(no accounted executors)")
+    try:
+        import jax
+        lines.append("# jax live arrays per device")
+        per_dev = {}
+        for arr in jax.live_arrays():
+            try:
+                for shard in arr.addressable_shards:
+                    dev = shard.device
+                    n, nbytes = per_dev.get(dev, (0, 0))
+                    per_dev[dev] = (n + 1,
+                                    nbytes + getattr(shard.data,
+                                                     "nbytes", 0))
+            except Exception:  # noqa: BLE001 — backend-dependent API
+                continue
+        for dev in jax.devices():
+            n, nbytes = per_dev.get(dev, (0, 0))
+            lines.append(f"{dev.platform}:{dev.id} buffers={n} "
+                         f"bytes={nbytes}")
+    except Exception:  # noqa: BLE001 — no jax runtime: accounting only
+        lines.append("# jax runtime unavailable")
+    return "\n".join(lines) + "\n"

@@ -13,6 +13,18 @@ Single-process TPU analogue:
   * dump_task_tree() — the await-tree: every asyncio task's current
     await stack, so a stuck barrier shows exactly which executor
     coroutine is parked where (channel recv, credit wait, device fence).
+
+Cluster (distributed) traces: each ComputeNode's local coordinator
+records its OWN EpochTrace (inject_remote starts it with the epoch
+re-based to the worker's clock), and the closed span bundle ships to
+meta piggybacked on the sealed-report push (cluster/compute_node.py ->
+cluster/meta_service.py -> `EpochTracer.ingest_worker`). Meta stitches
+them into ONE per-epoch timeline: worker offsets are RELATIVE TO THE
+INJECT PUSH (offset 0 on worker wN = the moment wN received meta's
+inject), so per-worker sub-blocks line up under meta's span without
+any cross-host clock agreement. `traces_to_json` / `traces_to_chrome`
+export the same stitched data machine-readably (the chrome form loads
+in Perfetto: one pid per worker, one tid per actor).
 """
 
 from __future__ import annotations
@@ -42,6 +54,51 @@ class EpochTrace:
     upload_ns: int = 0
     commit_ns: int = 0
     total_ns: int = 0
+    # cluster stitching (meta side only): worker_id -> that worker's
+    # span dict (an EpochTrace.to_dict() shipped on the sealed push).
+    # Worker offsets are relative to the worker's inject RECEIPT, which
+    # stitching anchors at meta's inject push — no cross-host clocks.
+    worker_spans: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        """Wire form of the span (sealed-push piggyback + format=json):
+        every time is an OFFSET from inject_ns, so the dict is
+        meaningful on any host."""
+        return {
+            "epoch": self.epoch,
+            "collects": [[a, int(dt)] for a, dt in self.collects],
+            "phases": {str(a): dict(ph)
+                       for a, ph in self.phases.items()},
+            "sync_ns": int(self.sync_ns),
+            "seal_ns": int(self.seal_ns),
+            "upload_ns": int(self.upload_ns),
+            "commit_ns": int(self.commit_ns),
+            "total_ns": int(self.total_ns),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "EpochTrace":
+        t = cls(int(d["epoch"]), 0)
+        t.collects = [(int(a), int(dt))
+                      for a, dt in d.get("collects", ())]
+        t.phases = {int(a): dict(ph)
+                    for a, ph in d.get("phases", {}).items()}
+        t.sync_ns = int(d.get("sync_ns", 0))
+        t.seal_ns = int(d.get("seal_ns", 0))
+        t.upload_ns = int(d.get("upload_ns", 0))
+        t.commit_ns = int(d.get("commit_ns", 0))
+        t.total_ns = int(d.get("total_ns", 0))
+        return t
+
+    @staticmethod
+    def _actor_line(actor_id, dt, ph, prefix="") -> str:
+        line = (f"  {prefix}actor {actor_id} collected at "
+                f"+{dt / 1e6:.1f}ms")
+        if ph:
+            line += (f" (apply {ph.get('apply_ns', 0) / 1e6:.1f}ms, "
+                     f"persist {ph.get('persist_ns', 0) / 1e6:.1f}ms, "
+                     f"align {ph.get('align_ns', 0) / 1e6:.1f}ms)")
+        return line
 
     def render(self) -> str:
         head = (f"epoch {self.epoch}: total {self.total_ns / 1e6:.1f}ms, "
@@ -52,13 +109,26 @@ class EpochTrace:
                      f"commit {self.commit_ns / 1e6:.1f}ms]")
         lines = [head]
         for actor_id, dt in sorted(self.collects, key=lambda x: x[1]):
-            line = f"  actor {actor_id} collected at +{dt / 1e6:.1f}ms"
-            ph = self.phases.get(actor_id)
-            if ph:
-                line += (f" (apply {ph.get('apply_ns', 0) / 1e6:.1f}ms, "
-                         f"persist {ph.get('persist_ns', 0) / 1e6:.1f}ms, "
-                         f"align {ph.get('align_ns', 0) / 1e6:.1f}ms)")
-            lines.append(line)
+            lines.append(self._actor_line(
+                actor_id, dt, self.phases.get(actor_id)))
+        # stitched per-worker sub-blocks: one timeline, offsets
+        # anchored at each worker's inject receipt (= meta's push)
+        for wid in sorted(self.worker_spans):
+            w = self.worker_spans[wid]
+            lines.append(
+                f"  -- w{wid} (offsets from inject receipt): "
+                f"total {w.get('total_ns', 0) / 1e6:.1f}ms"
+                + (f", seal {w['seal_ns'] / 1e6:.1f}ms"
+                   f" upload {w['upload_ns'] / 1e6:.1f}ms"
+                   f" commit {w['commit_ns'] / 1e6:.1f}ms"
+                   if w.get("seal_ns") or w.get("upload_ns")
+                   or w.get("commit_ns") else ""))
+            phases = w.get("phases", {})
+            for actor_id, dt in sorted(w.get("collects", ()),
+                                       key=lambda x: x[1]):
+                lines.append(self._actor_line(
+                    actor_id, dt, phases.get(str(actor_id)),
+                    prefix=f"w{wid}/"))
         return "\n".join(lines)
 
 
@@ -128,6 +198,32 @@ class EpochTracer:
         if t is not None:
             t.seal_ns, t.upload_ns, t.commit_ns = seal_ns, upload_ns, commit_ns
 
+    def ingest_worker(self, worker_id: int, spans) -> None:
+        """Meta-side stitch point: attach a worker's shipped span
+        bundle (list of EpochTrace.to_dict()) to the matching meta
+        epoch spans — open first, then the ring (the sealed report that
+        carries a bundle usually lands AFTER the epoch's span closed,
+        exactly like the background uploader's annotate)."""
+        for d in spans or ():
+            try:
+                canon = EpochTrace.from_dict(d).to_dict()
+            except (KeyError, TypeError, ValueError):
+                continue            # a malformed bundle never wedges meta
+            epoch = canon["epoch"]
+            t = self._open.get(epoch)
+            if t is None:
+                for cand in reversed(self._ring):
+                    if cand.epoch == epoch:
+                        t = cand
+                        break
+            if t is not None:
+                t.worker_spans[int(worker_id)] = canon
+
+    def unshipped(self, shipped: set) -> list[EpochTrace]:
+        """Worker-side: closed spans not yet piggybacked on a sealed
+        report (the caller records what it shipped)."""
+        return [t for t in self._ring if t.epoch not in shipped]
+
     def recent(self, n: int = 8) -> list[EpochTrace]:
         return list(self._ring)[-n:]
 
@@ -172,9 +268,100 @@ def dump_task_tree(limit_frames: int = 6) -> str:
     return "\n".join(out)
 
 
-def format_stuck_barrier_report(coord) -> str:
+class RecoveryRing:
+    """Recovery post-mortem spans, owned by the SESSION (not the
+    coordinator): a full recovery swaps the coordinator — and with it
+    the EpochTracer — so a ring living there died with the very
+    recovery it was describing. The session survives the swap; the
+    ring survives with it. EpochTracer keeps a back-compat mirror."""
+
+    def __init__(self, keep: int = 64):
+        self.recoveries: deque[dict] = deque(maxlen=keep)
+
+    def note_recovery(self, scope: str, cause: str, duration_ns: int,
+                      actors=()) -> None:
+        self.recoveries.append({
+            "scope": scope, "cause": cause,
+            "duration_ns": int(duration_ns),
+            "actors": list(actors),
+            "at_ns": time.monotonic_ns()})
+
+    def render_recoveries(self) -> list[str]:
+        return [
+            (f"recovery scope={r['scope']} cause={r['cause']} "
+             f"{r['duration_ns'] / 1e6:.1f}ms "
+             f"rebuilt_actors={r['actors']}")
+            for r in self.recoveries]
+
+
+def traces_to_json(traces, recoveries=()) -> dict:
+    """format=json: the stitched spans + recovery ring, verbatim."""
+    return {
+        "traces": [
+            {**t.to_dict(),
+             "worker_spans": {str(w): dict(s)
+                              for w, s in t.worker_spans.items()}}
+            for t in traces],
+        "recoveries": [dict(r) for r in recoveries],
+    }
+
+
+def traces_to_chrome(traces) -> list:
+    """format=chrome: Chrome trace-event array (Perfetto-loadable).
+    One pid per worker (pid 0 = meta), one tid per actor (tid 0 = the
+    epoch-level span). All timestamps are µs offsets from the OLDEST
+    exported epoch's inject, each epoch anchored at its inject time;
+    worker events anchor at the inject push, i.e. the same origin."""
+    events = []
+    base = 0
+    for i, t in enumerate(sorted(traces, key=lambda t: t.epoch)):
+        def ev(name, pid, tid, ts_ns, dur_ns, **args):
+            events.append({
+                "name": name, "ph": "X", "cat": "epoch",
+                "pid": pid, "tid": tid,
+                "ts": round((base + ts_ns) / 1e3, 3),
+                "dur": round(max(dur_ns, 0) / 1e3, 3),
+                "args": {"epoch": t.epoch, **args}})
+
+        ev(f"epoch {t.epoch}", 0, 0, 0, t.total_ns,
+           sync_ms=t.sync_ns / 1e6)
+        if t.seal_ns or t.upload_ns or t.commit_ns:
+            off = t.total_ns
+            for nm, dur in (("seal", t.seal_ns),
+                            ("upload", t.upload_ns),
+                            ("commit", t.commit_ns)):
+                ev(f"{nm} {t.epoch}", 0, 0, off, dur)
+                off += dur
+        for actor_id, dt in t.collects:
+            ph = t.phases.get(actor_id, {})
+            ev(f"collect actor {actor_id}", 0, actor_id, 0, dt,
+               **{k: v / 1e6 for k, v in ph.items()})
+        for wid in sorted(t.worker_spans):
+            w = t.worker_spans[wid]
+            ev(f"w{wid} epoch {t.epoch}", wid, 0, 0,
+               w.get("total_ns", 0))
+            phases = w.get("phases", {})
+            for actor_id, dt in w.get("collects", ()):
+                ph = phases.get(str(actor_id), {})
+                ev(f"w{wid} collect actor {actor_id}", wid,
+                   actor_id, 0, dt,
+                   **{k: v / 1e6 for k, v in ph.items()})
+        # epochs laid end to end: each epoch's window begins where the
+        # previous one's longest span ended (monotonic offsets without
+        # trusting any wall clock)
+        base += max(t.total_ns + t.seal_ns + t.upload_ns + t.commit_ns,
+                    max((w.get("total_ns", 0)
+                         for w in t.worker_spans.values()), default=0),
+                    1_000_000)
+    return events
+
+
+def format_stuck_barrier_report(coord, worker_reports=None) -> str:
     """One-call diagnosis: the STUCK epochs' partial spans (who already
     collected, and when), recent completed spans, and the await tree.
+    In cluster mode the watchdog passes `worker_reports` (worker_id ->
+    that worker's own report text pulled over rpc.py) so a wedged epoch
+    names the worker, actor, AND parked await frame.
     (What the reference gets from `risectl trace` + await-tree dump.)"""
     tracer = getattr(coord, "tracer", None)
     lines = []
@@ -189,4 +376,7 @@ def format_stuck_barrier_report(coord) -> str:
             lines.append(t.render())
     lines.append("== await tree ==")
     lines.append(dump_task_tree())
+    for wid in sorted(worker_reports or ()):
+        lines.append(f"== worker w{wid} ==")
+        lines.append(str(worker_reports[wid]))
     return "\n".join(lines)

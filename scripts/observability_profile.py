@@ -1,6 +1,6 @@
 """Observability-plane gate — canned q7 shape, no TPU needed.
 
-Three checks, rc=0 iff all pass:
+Six checks, rc=0 iff all pass:
 
   1. OVERHEAD — the q7-shaped pipeline (broadcast source -> window-max
      agg -> join back) runs under real actors + a real coordinator at
@@ -16,6 +16,16 @@ Three checks, rc=0 iff all pass:
      collects) must trip the stuck-barrier watchdog within the
      threshold: barrier_stalls_total increments and the report names the
      remaining actor.
+  4. PROFILE PERTURBATION — a 2s on-demand cpu profile sampled while
+     the q7 shape keeps pacing barriers must keep the barrier p50
+     within 15% of the unprofiled run (and yield parseable stacks).
+  5. CLUSTER TRACE OVERHEAD — a real 2-worker deployment runs the q7
+     DDL with distributed span recording at `debug`; barrier p50 must
+     stay within 10% of `off` (span bundles ride every sealed report).
+  6. CLUSTER STALL REPORT — a worker-side `channel_stall` fault wedges
+     an epoch past the watchdog threshold; the merged report must name
+     the stalled WORKER (one `== worker wN ==` section per live worker)
+     and the remaining ACTORS.
 
     JAX_PLATFORMS=cpu python scripts/observability_profile.py
 """
@@ -106,9 +116,14 @@ def _canned_chunks(seed: int):
     return intervals
 
 
-async def _run_q7(metric_level: str) -> dict:
+async def _run_q7(metric_level: str, profile_seconds: float = 0.0) -> dict:
     """q7 shape under real actors: one source actor broadcasting to a
-    join actor whose right side is project -> window-max agg."""
+    join actor whose right side is project -> window-max agg.
+
+    With `profile_seconds` > 0, a cpu profile samples from a helper
+    thread WHILE barriers keep pacing (the perturbation check): the
+    interval loop keeps injecting until the profile window closes, and
+    only the latencies that overlap it are measured."""
     from risingwave_tpu.expr import call, col, lit
     from risingwave_tpu.expr.agg import AggCall, AggKind
     from risingwave_tpu.meta.barrier_manager import BarrierCoordinator
@@ -154,20 +169,39 @@ async def _run_q7(metric_level: str) -> dict:
     b = await coord.inject_barrier(kind=BarrierKind.INITIAL)
     await coord.wait_collected(b)
     lat = []
-    for i in range(N_INTERVALS - 1):
+    prof_task = None
+    prof_text = None
+    i = 0
+    while True:
         b = await coord.inject_barrier()
         await coord.wait_collected(b)
         if i >= WARMUP_INTERVALS:
+            if profile_seconds and prof_task is None:
+                from risingwave_tpu.utils.profiler import profile_cpu
+                prof_task = asyncio.ensure_future(
+                    asyncio.to_thread(profile_cpu, profile_seconds))
             lat.append(coord.latencies_ns[-1] / 1e6)
+        i += 1
+        if prof_task is not None:
+            if prof_task.done():
+                prof_text = prof_task.result()
+                break
+        elif i >= N_INTERVALS - 1:
+            break
     b = await coord.inject_barrier(mutation=StopMutation(frozenset({1, 2})))
     await coord.wait_collected(b)
     for t in tasks:
         await t
     lat.sort()
-    return {"metric_level": metric_level,
-            "p50_ms": round(lat[len(lat) // 2], 3),
-            "p90_ms": round(lat[int(len(lat) * 0.9)], 3),
-            "intervals": len(lat)}
+    out = {"metric_level": metric_level,
+           "p50_ms": round(lat[len(lat) // 2], 3),
+           "p90_ms": round(lat[int(len(lat) * 0.9)], 3),
+           "intervals": len(lat)}
+    if prof_text is not None:
+        from risingwave_tpu.utils.profiler import parse_collapsed
+        stacks = parse_collapsed(prof_text)
+        out["profile_samples"] = sum(c for _, c in stacks)
+    return out
 
 
 # ---------------------------------------------------------- exposition check
@@ -284,6 +318,145 @@ async def _check_watchdog() -> dict:
             "report_has_await_tree": "await tree" in report}
 
 
+# ------------------------------------------------------------- cluster checks
+
+CLUSTER_WARMUP = 4
+CLUSTER_MEASURE = 12
+PROFILE_PERTURB_LIMIT = 1.15
+
+W = 10_000_000
+CLUSTER_Q7_DDL = [
+    ("CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+     "chunk_size=256, splits=2, rate_limit=512, inter_event_us=250, "
+     f"emit_watermarks=1, watermark_lag_us={2 * W})"),
+    ("CREATE MATERIALIZED VIEW q7 AS "
+     "SELECT B.auction, B.price, B.bidder, B.date_time "
+     "FROM bid B JOIN ("
+     "  SELECT max(price) AS maxprice, window_end "
+     f"  FROM TUMBLE(bid, date_time, {W}) GROUP BY window_end) B1 "
+     "ON B.price = B1.maxprice "
+     f"AND B.date_time > B1.window_end - {W} "
+     "AND B.date_time <= B1.window_end"),
+]
+
+
+def _free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_worker(port: int):
+    import socket
+    import subprocess
+    import time
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "risingwave_tpu.worker", str(port)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=1).close()
+            return p
+        except OSError:
+            time.sleep(0.2)
+    p.terminate()
+    raise RuntimeError("worker never started listening")
+
+
+def _p50(xs):
+    xs = sorted(xs)
+    return round(xs[len(xs) // 2], 3) if xs else 0.0
+
+
+async def _check_cluster() -> dict:
+    """One 2-worker deployment, two checks:
+
+    TRACE OVERHEAD — the q7 pipeline runs paced rounds with span
+    recording at `metric_level=off` and again at `debug` (per-actor
+    series + span shipping on every sealed report); the debug barrier
+    p50 must stay within 10% of off.
+
+    STALL REPORT — a worker-side `channel_stall` (the spec rides the
+    cluster config push and fires inside the WORKER process) wedges an
+    epoch past the watchdog threshold; the merged report meta prints
+    must carry every live worker's section so it names the stalled
+    worker AND its remaining actors."""
+    import tempfile
+
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+
+    root = tempfile.mkdtemp(prefix="obsgate-cluster-")
+    ports = [_free_port(), _free_port()]
+    procs = [_spawn_worker(p) for p in ports]
+    out: dict = {}
+    try:
+        s = Session(store=HummockStateStore(LocalFsObjectStore(
+            os.path.join(root, "store"))))
+        addr = ",".join(f"127.0.0.1:{p}" for p in ports)
+        await s.execute(f"SET cluster = '{addr}'")
+        for d in CLUSTER_Q7_DDL:
+            await s.execute(d)
+
+        p50 = {}
+        for mode in ("off", "debug"):
+            await s.execute(f"SET metric_level = {mode}")
+            await s.tick(CLUSTER_WARMUP)
+            n0 = len(s.coord.latencies_ns)
+            await s.tick(CLUSTER_MEASURE)
+            p50[mode] = _p50([x / 1e6
+                              for x in s.coord.latencies_ns[n0:]])
+        out["trace_off_p50_ms"] = p50["off"]
+        out["trace_debug_p50_ms"] = p50["debug"]
+        out["trace_ratio"] = round(
+            p50["debug"] / max(p50["off"], 1e-9), 3)
+
+        await s.execute("SET barrier_stall_threshold_ms = 500")
+        await s.execute(
+            "SET fault_injection = 'channel_stall:ms=4000'")
+        buf = io.StringIO()
+        with contextlib.redirect_stderr(buf):
+            await s.tick(3)
+        report = buf.getvalue()
+        stalls = s.event_log.records(kind="barrier_stall")
+        out["stall_report_fired"] = "[stuck barrier]" in report
+        out["stall_report_names_worker"] = (
+            "== worker w1 ==" in report and "== worker w2 ==" in report)
+        out["stall_report_names_actor"] = bool(
+            stalls and stalls[-1].get("remaining"))
+        out["stalled_actors"] = (stalls[-1]["remaining"]
+                                 if stalls else [])
+        await s.execute("SET fault_injection = ''")
+        await s.shutdown()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                p.wait(timeout=10)
+    return out
+
+
+async def _check_profile_perturbation(baseline_p50: float) -> dict:
+    """A 2s on-demand cpu profile sampled WHILE the q7 shape keeps
+    pacing barriers must not move the barrier p50 by more than 15% —
+    /debug/profile/cpu has to be safe to point at a live cluster."""
+    runs = [await _run_q7("debug", profile_seconds=2.0)
+            for _ in range(2)]
+    prof_p50 = min(r["p50_ms"] for r in runs)
+    return {"baseline_p50_ms": baseline_p50,
+            "profiled_p50_ms": prof_p50,
+            "ratio": round(prof_p50 / max(baseline_p50, 1e-9), 3),
+            "profile_samples": max(r.get("profile_samples", 0)
+                                   for r in runs)}
+
+
 async def main() -> int:
     # overhead: alternate modes, best median per mode
     p50 = {"off": [], "debug": []}
@@ -297,16 +470,29 @@ async def main() -> int:
                 "passes": p50}
     expo = await _check_exposition()
     wd = await _check_watchdog()
+    perturb = await _check_profile_perturbation(dbg_p50)
+    cluster = await _check_cluster()
     verdict = {
         "overhead_within_10pct": dbg_p50 <= off_p50 * OVERHEAD_LIMIT,
         "exposition_valid": expo["row_series"] > 0,
         "watchdog_fired": (wd["stalls_fired"] >= 1
                            and wd["report_names_actor"]
                            and wd["report_has_await_tree"]),
+        "cluster_trace_overhead_within_10pct":
+            cluster["trace_ratio"] <= OVERHEAD_LIMIT,
+        "cluster_stall_report_names_worker_actor": (
+            cluster["stall_report_fired"]
+            and cluster["stall_report_names_worker"]
+            and cluster["stall_report_names_actor"]),
+        "cpu_profile_perturbation_within_15pct": (
+            perturb["ratio"] <= PROFILE_PERTURB_LIMIT
+            and perturb["profile_samples"] > 10),
     }
     print(json.dumps({"overhead": overhead}))
     print(json.dumps({"exposition": expo}))
     print(json.dumps({"watchdog": wd}))
+    print(json.dumps({"profile_perturbation": perturb}))
+    print(json.dumps({"cluster": cluster}))
     print(json.dumps({"verdict": verdict}))
     return 0 if all(verdict.values()) else 1
 

@@ -442,3 +442,93 @@ async def test_cluster_rejects_dict_typed_state_and_mv_on_mv(
         await _step(s.execute(
             "CREATE MATERIALIZED VIEW vv AS SELECT auction FROM ok"))
     await _step(s.shutdown())
+
+
+async def _http_get(port: int, path: str) -> str:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+    await writer.drain()
+    raw = await asyncio.wait_for(reader.read(), 30)
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    assert b" 200 " in head.split(b"\r\n", 1)[0], head
+    return body.decode()
+
+
+async def test_cluster_flight_recorder_over_real_sockets(
+        tmp_path, two_workers):
+    """One 2-worker deployment, the whole flight-recorder surface:
+
+    - the meta tracer's stitched per-epoch timeline carries the span
+      bundles BOTH workers shipped on their sealed reports, rendered
+      by /debug/traces in every format (worker offsets relative to
+      each worker's own inject receipt);
+    - the on-demand profilers fan out to the workers and merge;
+    - a worker-side channel stall wedges an epoch past the watchdog
+      threshold, and the merged report meta prints (pulling EVERY live
+      worker's own await tree over the real socket) names the stalled
+      worker, its remaining actors, and the parked frame."""
+    import contextlib
+    import io
+    import json
+    ports, _ = two_workers
+    s = await _cluster_session(tmp_path, ports)
+    for d in AGG_DDL:
+        await _step(s.execute(d))
+    for _ in range(3):
+        await _step(s.tick())
+    mon = await _step(s.start_monitor(0))
+
+    payload = json.loads(await _http_get(
+        mon.port, "/debug/traces?format=json"))
+    assert payload["traces"], payload
+    stitched = [t for t in payload["traces"]
+                if {"1", "2"} <= set(t.get("worker_spans", {}))]
+    assert stitched, [sorted(t.get("worker_spans", {}))
+                      for t in payload["traces"]]
+
+    text = await _http_get(mon.port, "/debug/traces")
+    assert "-- w1" in text and "-- w2" in text, text
+
+    # chrome export keeps the worker attribution as pids 1 and 2
+    events = json.loads(await _http_get(
+        mon.port, "/debug/traces?format=chrome"))
+    assert {1, 2} <= {e["pid"] for e in events}, events[:5]
+
+    # profilers merge worker output under wN prefixes next to the
+    # meta-local sections
+    from risingwave_tpu.utils.profiler import parse_collapsed
+    cpu = await _http_get(mon.port, "/debug/profile/cpu?seconds=0.3")
+    stacks = parse_collapsed(cpu)
+    assert stacks, cpu[:500]
+    assert any(frames[0] in ("w1", "w2")
+               for frames, _ in stacks), cpu[:500]
+    heap = await _http_get(mon.port, "/debug/profile/heap?seconds=0.3")
+    assert "# heap profile" in heap
+    assert "w1/" in heap or "w2/" in heap, heap[:500]
+    dev = await _http_get(mon.port, "/debug/profile/device")
+    assert "# device profile" in dev
+    assert "w1/" in dev and "w2/" in dev, dev[:500]
+
+    await _step(s.execute("SET barrier_stall_threshold_ms = 400"))
+    # rides the cluster config push: each worker's process-global
+    # injector arms, and its ChannelInput consumer parks 1.5s on the
+    # next matching chunk (fires once — at=1,times=1 defaults)
+    await _step(s.execute(
+        "SET fault_injection = 'channel_stall:ms=1500'"))
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        for _ in range(3):
+            await _step(s.tick())
+    report = err.getvalue()
+    assert "[stuck barrier]" in report, report[:2000] or "(empty)"
+    assert "remaining actors" in report
+    # one section per live worker, each with its own await tree
+    assert "== worker w1 ==" in report, report
+    assert "== worker w2 ==" in report, report
+    assert "task " in report, report
+    # the stall also landed in the durable event log
+    stalls = s.event_log.records(kind="barrier_stall")
+    assert stalls and stalls[-1]["remaining"], stalls
+    await _step(s.execute("SET fault_injection = ''"))
+    await _step(s.shutdown())

@@ -162,6 +162,8 @@ async def test_per_actor_rows_match_oracle():
     by_actor = {}
     for labels, v in rows.items():
         d = dict(labels)
+        if "pos" in d:
+            continue    # per-executor children (pos-labelled) aside
         if d["executor"].startswith("obs_m/"):
             by_actor[d["executor"]] = v
     # source, row-id-gen and materialize actors each saw every row once
@@ -172,6 +174,46 @@ async def test_per_actor_rows_match_oracle():
     # unregistration drops the per-actor series from future scrapes
     assert not any(d["executor"].startswith("obs_m/") for d in (
         dict(k) for k in _actor_series("stream_actor_row_count")))
+
+
+async def test_per_executor_children_match_chain_root():
+    """Per-executor attribution inside a fused chain: each chain
+    position gets its own {actor, executor, pos} series, and the chain
+    ROOT child (pos=0) counts exactly the actor-level total — the
+    root's output IS what the actor dispatches."""
+    s = Session()
+    await s.execute("SET metric_level = debug")
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=128, rate_limit=128)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW pe_m AS SELECT auction, price "
+        "FROM bid")
+    await s.tick(4)
+    rows = _actor_series("stream_actor_row_count")
+    actor_total: dict = {}
+    children: dict = {}
+    for labels, v in rows.items():
+        d = dict(labels)
+        if not d["executor"].startswith("pe_m/"):
+            continue
+        if "pos" in d:
+            children.setdefault(d["actor"], {})[int(d["pos"])] = v
+        else:
+            actor_total[d["actor"]] = v
+    assert actor_total and children
+    for actor, total in actor_total.items():
+        kids = children.get(actor)
+        assert kids and 0 in kids, (actor, children)
+        assert kids[0] == total, (actor, kids, total)
+        assert total > 0
+    # wall-time children ride the same labels
+    busy = _actor_series("stream_actor_busy_seconds_total")
+    assert any("pos" in dict(k) for k in busy)
+    await s.drop_all()
+    # children unregister with the actor
+    assert not any("pos" in dict(k)
+                   for k in _actor_series("stream_actor_row_count"))
 
 
 async def test_metric_level_off_registers_no_per_actor_series():
@@ -351,8 +393,46 @@ async def test_monitor_endpoint_serves_all_routes():
         status, body = await _http_get(mon.port, "/debug/traces")
         assert status.endswith("200 OK") and "epoch" in body
 
+        status, body = await _http_get(mon.port,
+                                       "/debug/traces?format=json")
+        assert status.endswith("200 OK")
+        doc = json.loads(body)
+        assert doc["traces"] and all("collects" in t
+                                     for t in doc["traces"])
+
+        status, body = await _http_get(mon.port,
+                                       "/debug/traces?format=chrome")
+        assert status.endswith("200 OK")
+        events = json.loads(body)
+        assert events and all(e["ph"] == "X" and "ts" in e and "dur" in e
+                              for e in events)
+
         status, body = await _http_get(mon.port, "/debug/await_tree")
         assert status.endswith("200 OK") and "task " in body
+
+        s.event_log.emit("route_probe", n=1)
+        status, body = await _http_get(mon.port,
+                                       "/debug/events?limit=5")
+        assert status.endswith("200 OK")
+        recs = json.loads(body)
+        assert any(r["kind"] == "route_probe" for r in recs)
+
+        status, body = await _http_get(mon.port,
+                                       "/debug/profile/cpu?seconds=0.2")
+        assert status.endswith("200 OK")
+        assert body.startswith("# cpu profile:")
+        from risingwave_tpu.utils.profiler import parse_collapsed
+        parse_collapsed(body)
+
+        status, body = await _http_get(mon.port,
+                                       "/debug/profile/heap?seconds=0.2")
+        assert status.endswith("200 OK") and "# heap profile" in body
+
+        status, body = await _http_get(mon.port, "/debug/profile/device")
+        assert status.endswith("200 OK") and "# device profile" in body
+
+        status, _ = await _http_get(mon.port, "/debug/profile/nope")
+        assert "404" in status
 
         status, _ = await _http_get(mon.port, "/nope")
         assert "404" in status
@@ -546,6 +626,7 @@ async def test_q7_actor_row_counters_agree_with_direct_run():
     rows = {dict(labels)["actor"]: c.value
             for (n, labels), c in GLOBAL_METRICS.counters.items()
             if n == "stream_actor_row_count"
+            and "pos" not in dict(labels)          # actor-level only
             and dict(labels)["executor"].startswith("q7/")}
     assert rows["1"] == total_in, (rows, total_in)
     assert rows["2"] == out_sink.rows, (rows, out_sink.rows)
