@@ -62,7 +62,8 @@ DEVICE_PROBE_TIMEOUT_S = 120.0
 QUERY_BUDGET_S = {"q1": 60.0, "q5": 150.0, "q7": 150.0, "q8": 170.0,
                   "q17": 150.0, "q7d": 150.0, "q7_kill": 150.0,
                   "q7_kill_interior": 150.0, "q7_kill_worker": 200.0,
-                  "q5_8chip": 150.0, "q7_8chip": 150.0}
+                  "q5_8chip": 150.0, "q7_8chip": 150.0,
+                  "q5_fused": 150.0, "q7_fused": 150.0}
 # Baseline inputs are fixed (they don't depend on the device run), so the
 # orchestrator computes all four baselines in PARALLEL CPU subprocesses
 # while the device queries run serially.
@@ -401,8 +402,19 @@ async def bench_q5_8chip(progress: dict) -> None:
     await _bench_sql(progress, _q5_ddl(mesh_devices=8), interval_s=0.2)
 
 
+async def bench_q5_fused(progress: dict) -> None:
+    """q5 as a mesh-resident CHAIN (ROADMAP 3c): the hop-window producer
+    stages hollow into preludes of the sharded agg's fused program —
+    zero per-chunk host hops per steady barrier interval, attested by
+    the mesh_host_round_trips_total counter riding in the result as
+    host_hops_per_interval."""
+    await _bench_sql(progress, _q5_ddl(mesh_devices=8), interval_s=0.2,
+                     track_host_hops=True)
+
+
 async def _bench_sql(progress: dict, ddl: list, interval_s: float,
-                     measure_s: float = MEASURE_S, store=None) -> None:
+                     measure_s: float = MEASURE_S, store=None,
+                     track_host_hops: bool = False) -> None:
     """Run a query expressed as SQL through the Session — the measured
     number IS the system number (VERDICT r3: "the bench path and the SQL
     path must converge"). The sink is connector='blackhole_device' (no
@@ -442,8 +454,19 @@ async def _bench_sql(progress: dict, ddl: list, interval_s: float,
         def offset(self):
             return sum(g.offset for g in gens)
 
+    if track_host_hops:
+        from risingwave_tpu.stream.monitor import mesh_host_round_trips
+        h0 = mesh_host_round_trips()
     await _measure(s.coord, _Gens(), sink, progress, measure_s,
                    interval_s=interval_s)
+    if track_host_hops:
+        # per-chunk host-plane crossings inside registered mesh chains,
+        # averaged over the measured barrier intervals (warmup included
+        # — the fused steady state is exactly zero either way)
+        progress["host_hops_per_interval"] = round(
+            (mesh_host_round_trips() - h0)
+            / max(progress.get("rounds", 1), 1), 2)
+        progress["mesh_chains"] = len(s.coord.mesh_chains)
     # quiesce: stop the sources producing (the stop barrier would
     # otherwise ride behind a growing backlog)
     _phase(progress, "quiesce")
@@ -536,6 +559,16 @@ async def bench_q7_8chip(progress: dict) -> None:
     each; in-mesh all_to_all exchange). Emitted as
     nexmark_q7_rows_per_sec_8chip alongside the per-chip metric."""
     await _bench_sql(progress, _q7_ddl(mesh_devices=8), interval_s=0.05)
+
+
+async def bench_q7_fused(progress: dict) -> None:
+    """q7 as mesh-resident CHAINS: eligible producer fragments hollow
+    into the sharded consumers' fused programs (agg-side auto-fusion;
+    the join side keeps its per-fragment plane). host_hops_per_interval
+    in the result counts any per-chunk host-plane crossings left inside
+    registered chains — zero in the fused steady state."""
+    await _bench_sql(progress, _q7_ddl(mesh_devices=8), interval_s=0.05,
+                     track_host_hops=True)
 
 
 async def bench_q7d(progress: dict) -> None:
@@ -1060,6 +1093,7 @@ QUERIES = {"q1": bench_q1, "q5": bench_q5, "q7": bench_q7,
            "q7_kill_interior": _q7_kill_victim("interior"),
            "q7_kill_worker": _q7_kill_victim("worker"),
            "q5_8chip": bench_q5_8chip, "q7_8chip": bench_q7_8chip,
+           "q5_fused": bench_q5_fused, "q7_fused": bench_q7_fused,
            "broker_ingest": bench_broker_ingest}
 NORTH_STAR = ("q7", "q8")
 
@@ -1081,7 +1115,8 @@ def _query_result(query: str, progress: dict, note: str = "") -> dict:
         out["baseline_rows_per_sec"] = round(base, 1)
     for k in ("d2h_bytes_per_s", "upload_overlap_pct", "recovery_ms",
               "recovery_scope", "rebuilt_actors", "recoveries",
-              "post_recovery_rows_per_sec"):
+              "post_recovery_rows_per_sec", "host_hops_per_interval",
+              "mesh_chains"):
         if k in progress:
             out[k] = progress[k]
     if progress.get("state_errs"):
@@ -1328,6 +1363,13 @@ def _emit_combined(results: dict, note: str = "",
         r8 = results.get(f"{q}_8chip")
         if r8 and r8.get("rows_per_sec"):
             out[f"nexmark_{q}_rows_per_sec_8chip"] = r8["rows_per_sec"]
+        rf = results.get(f"{q}_fused")
+        if rf and rf.get("rows_per_sec"):
+            out[f"nexmark_{q}_fused_rows_per_sec_8chip"] = \
+                rf["rows_per_sec"]
+            if "host_hops_per_interval" in rf:
+                out[f"nexmark_{q}_fused_host_hops_per_interval"] = \
+                    rf["host_hops_per_interval"]
     if extra:
         out.update(extra)
     if note:
@@ -1383,7 +1425,7 @@ def main() -> None:
     n_devices = int(m_dev.group(1)) if m_dev else 0
     query_list = ["q1", "q5", "q7", "q8", "q17", "q7d", "q7_kill"]
     if n_devices >= 8:
-        query_list += ["q5_8chip", "q7_8chip"]
+        query_list += ["q5_8chip", "q7_8chip", "q5_fused", "q7_fused"]
     for q in query_list:
         remaining = GLOBAL_BUDGET_S - (time.perf_counter() - t0) - 10
         if remaining <= 40:   # a query needs import+compile time to matter

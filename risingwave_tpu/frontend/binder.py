@@ -408,6 +408,9 @@ class StreamPlanner:
                     mesh_shuffle=self.cfg("streaming_mesh_shuffle", 1),
                     mesh_shuffle_slack=self.cfg(
                         "streaming_mesh_shuffle_slack", 0),
+                    mesh_shuffle_adaptive=self.cfg(
+                        "streaming_mesh_shuffle_adaptive", 1),
+                    mesh_chain=self.cfg("streaming_mesh_chain", 1),
                     watchdog_interval=wd,
                     durable=self.durable()),
                     inputs=(Exchange(lf), Exchange(rf)))
@@ -1574,6 +1577,9 @@ class StreamPlanner:
                     mesh_shuffle=self.cfg("streaming_mesh_shuffle", 1),
                     mesh_shuffle_slack=self.cfg(
                         "streaming_mesh_shuffle_slack", 0),
+                    mesh_shuffle_adaptive=self.cfg(
+                        "streaming_mesh_shuffle_adaptive", 1),
+                    mesh_chain=self.cfg("streaming_mesh_chain", 1),
                     watchdog_interval=wd),
                 inputs=(Exchange(fid),)),
                 dispatch="hash",

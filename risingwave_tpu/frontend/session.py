@@ -130,6 +130,21 @@ class Session:
         # on-device overflow counting that FAIL-STOPS the epoch
         # (mesh_shuffle_dropped_rows_total) if the skew beats the slack
         "streaming_mesh_shuffle_slack": (0, int),
+        # 1 (default): when the manual slack is 0, send-bucket sizing
+        # ADAPTS to the observed per-shard receive demand (EWMA + peak,
+        # refreshed at each barrier watchdog fetch, 2x pow2 headroom) —
+        # zero-drop sizing until enough intervals are observed, fail-stop
+        # overflow semantics unchanged. 0 pins zero-drop sizing.
+        "streaming_mesh_shuffle_adaptive": (1, int),
+        # 1 (default): fuse eligible producer->shuffle->consumer CHAINS
+        # onto the mesh (plan/build._fuse_mesh_chains): stateless
+        # producer stages (project / hop_window over a source) hollow out
+        # and run INSIDE the downstream sharded executor's fused program
+        # — zero host hops per steady barrier interval
+        # (mesh_host_round_trips_total{chain} == 0). 0 keeps eligible
+        # chains on the per-chunk host plane (counter still runs — the
+        # unfused baseline scripts/mesh_profile.py compares against).
+        "streaming_mesh_chain": (1, int),
         "streaming_over_window_capacity": (1 << 14, int),
         "streaming_dynamic_filter_capacity": (1 << 14, int),
         # "host:port" of a running fragment worker
@@ -1840,6 +1855,47 @@ class Session:
                     for row in mat:
                         for ch in row:
                             ch.reset_for_rebuild()
+            # 5b. channel-free mesh replay (ROADMAP 3d): capture each
+            # mesh-resident agg's uncommitted ingest suffix — sealed
+            # uncommitted MeshIngestLog intervals, the log's open
+            # interval, and undrained pending chunks — BEFORE the
+            # rebuild discards the executors. The suffix is preloaded
+            # straight into the rebuilt fused program (one fused scan
+            # at the first post-INITIAL barrier) and the frontier
+            # channels skip exactly these chunk objects by identity,
+            # so recovery re-runs ZERO per-chunk host dispatches.
+            # Identity matching requires the channel message object ==
+            # the logged object, so coalescing disables the fast path.
+            def _mesh_preload_exec(fid):
+                for root in dep.roots.get(fid, []):
+                    node = root
+                    while node is not None:
+                        if hasattr(node, "preload_replay"):
+                            return node
+                        node = getattr(node, "input", None)
+                return None
+            mesh_preload: dict[int, list] = {}
+            if getattr(self.env, "chunk_coalesce_max", 0) == 0:
+                for fid in cone:
+                    # a rebuilt (intra-cone) producer re-derives and
+                    # re-emits the suffix itself — preloading too would
+                    # double-apply it
+                    if any(u in cone
+                           for (u, d, _k) in dep.rebuild_info["channels"]
+                           if d == fid):
+                        continue
+                    ex = _mesh_preload_exec(fid)
+                    if ex is None:
+                        continue
+                    chunks = []
+                    log = getattr(ex, "ingest_log", None)
+                    if log is not None:
+                        for _ep, chs in log.entries():
+                            chunks.extend(chs)
+                        chunks.extend(log._pending)
+                    chunks.extend(getattr(ex, "_pending_chunks", []))
+                    if chunks:
+                        mesh_preload[fid] = chunks
             # 6. rebuild the cone's actors in topo order (same ids,
             # same tables — producers exist before their consumers
             # poll, exactly like the initial build)
@@ -1852,6 +1908,15 @@ class Session:
                     new_actors.extend(rebuild_fragment(dep, fid))
             finally:
                 self.env.memory_scope = None
+            # 6b. hand the captured suffix to the REBUILT executors
+            # (installed into the pending queue at their INITIAL
+            # barrier, after the durable state rebuild)
+            for fid, chunks in list(mesh_preload.items()):
+                ex = _mesh_preload_exec(fid)
+                if ex is not None:
+                    ex.preload_replay(chunks)
+                else:
+                    del mesh_preload[fid]
             # 7. re-attach terminal plumbing when the cone includes it
             if isinstance(flow, MvDef) and terminal in cone:
                 roots = dep.roots[terminal]
@@ -1884,9 +1949,14 @@ class Session:
             for (u, d, k), mat in dep.rebuild_info["channels"].items():
                 if d not in cone or u in cone:
                     continue
+                skips = mesh_preload.get(d)
                 for row in mat:
                     for ch in row:
-                        ch.begin_replay()
+                        if skips:
+                            ch.begin_replay(
+                                skip_refs={id(c) for c in skips})
+                        else:
+                            ch.begin_replay()
             for a in new_actors:
                 dep.tasks[by_id[a.actor_id]] = a.spawn()
         return sorted(ids)

@@ -177,6 +177,15 @@ class BarrierCoordinator:
         # per epoch. The registry makes that legible to /healthz, tests
         # and the mesh_profile gate.
         self.mesh_fragments: dict[int, tuple[int, str]] = {}
+        # ---- fused mesh CHAINS (plan/build.py _fuse_mesh_chains) ----
+        # chain label -> {"fids": (producer..., consumer), "hollow": bool,
+        # "consumer_actor": id}. A chain spans MULTIPLE fragments whose
+        # producer stages were hollowed into the consumer's fused program:
+        # one epoch fence covers the whole chain (hollow producers are
+        # fence-exempt — they dispatch no device programs), and the
+        # mesh_host_round_trips_total{chain} counter asserts the
+        # zero-host-hop claim per interval.
+        self.mesh_chains: dict[str, dict] = {}
         # ---- cluster mode (cluster/meta_service.py) ----
         # worker_id -> WorkerHandle: barriers are ALSO injected over RPC
         # into every compute node's source queues, each worker collects
@@ -242,6 +251,28 @@ class BarrierCoordinator:
         self.mesh_fragments[actor_id] = (int(n_shards), identity)
         GLOBAL_METRICS.gauge("mesh_fragment_shards",
                              actor=str(actor_id)).set(float(n_shards))
+
+    def register_mesh_chain(self, chain: str, fids, hollow: bool,
+                            consumer_actor: int) -> None:
+        """A fused mesh chain announces itself: producer fragments
+        `fids[:-1]` run hollow (their stages execute inside the consumer
+        fragment's fused program), `fids[-1]` is the consumer whose fence
+        covers the chain. hollow=False records an ELIGIBLE chain left on
+        the per-chunk host plane (streaming_mesh_chain=0) — the host-hop
+        counter still runs, giving the unfused comparison baseline."""
+        from ..utils.metrics import GLOBAL_METRICS
+        self.mesh_chains[chain] = {"fids": tuple(fids),
+                                   "hollow": bool(hollow),
+                                   "consumer_actor": int(consumer_actor)}
+        GLOBAL_METRICS.gauge("mesh_chain_fragments", chain=chain).set(
+            float(len(fids)))
+
+    def unregister_mesh_chain(self, chain: str) -> None:
+        from ..utils.metrics import GLOBAL_METRICS
+        if self.mesh_chains.pop(chain, None) is not None:
+            GLOBAL_METRICS.remove("mesh_chain_fragments", chain=chain)
+            GLOBAL_METRICS.remove("mesh_host_round_trips_total",
+                                  chain=chain)
 
     def unregister_mesh_fragment(self, actor_id: int) -> None:
         from ..utils.metrics import GLOBAL_METRICS

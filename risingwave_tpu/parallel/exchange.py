@@ -56,13 +56,17 @@ def bucket_by_dest(columns: Sequence[jnp.ndarray], vis: jnp.ndarray,
 
     columns: [N] arrays; vis: bool [N]; dest: int32 [N] in [0, n_dest).
     Returns (send_cols: list of [n_dest, cap_out], send_vis: [n_dest, cap_out],
-    n_dropped: int32 scalar).
+    n_dropped: int32 scalar, max_fill: int32 scalar — the largest
+    per-destination demand BEFORE capping, for adaptive bucket sizing).
     """
     onehot = (dest[:, None] == jnp.arange(n_dest, dtype=dest.dtype)[None, :]) & vis[:, None]
     pos = (jnp.cumsum(onehot, axis=0) - onehot).astype(jnp.int32)  # rank within dest
     pos_of_row = jnp.sum(pos * onehot, axis=1)
     ok = vis & (pos_of_row < cap_out)
     n_dropped = jnp.sum(vis & ~ok, dtype=jnp.int32)
+    # demand (pre-cap) per destination bucket — the adaptive slack
+    # signal: the largest send bucket this shard WANTED this chunk
+    max_fill = jnp.max(jnp.sum(onehot, axis=0, dtype=jnp.int32))
     flat = jnp.where(ok, dest * cap_out + pos_of_row, n_dest * cap_out)
     send_cols = []
     for col in columns:
@@ -70,7 +74,7 @@ def bucket_by_dest(columns: Sequence[jnp.ndarray], vis: jnp.ndarray,
         send_cols.append(buf.at[flat].set(col, mode="drop")[:-1].reshape(n_dest, cap_out))
     vbuf = jnp.zeros(n_dest * cap_out + 1, dtype=bool)
     send_vis = vbuf.at[flat].set(ok, mode="drop")[:-1].reshape(n_dest, cap_out)
-    return send_cols, send_vis, n_dropped
+    return send_cols, send_vis, n_dropped, max_fill
 
 
 def shuffle_rows(columns: Sequence[jnp.ndarray], vis: jnp.ndarray,
@@ -78,10 +82,11 @@ def shuffle_rows(columns: Sequence[jnp.ndarray], vis: jnp.ndarray,
                  cap_out: int):
     """Route rows to their destination shard (call inside shard_map).
 
-    Returns (recv_cols: list of [n_shards*cap_out], recv_vis, n_dropped):
-    the rows this shard owns, gathered from every source shard.
+    Returns (recv_cols: list of [n_shards*cap_out], recv_vis, n_dropped,
+    max_fill): the rows this shard owns, gathered from every source shard.
     """
-    send_cols, send_vis, n_dropped = bucket_by_dest(columns, vis, dest, n_shards, cap_out)
+    send_cols, send_vis, n_dropped, max_fill = bucket_by_dest(
+        columns, vis, dest, n_shards, cap_out)
     recv_cols = [
         jax.lax.all_to_all(c, axis_name, split_axis=0, concat_axis=0,
                            tiled=True).reshape(n_shards * cap_out)
@@ -89,7 +94,7 @@ def shuffle_rows(columns: Sequence[jnp.ndarray], vis: jnp.ndarray,
     ]
     recv_vis = jax.lax.all_to_all(send_vis, axis_name, split_axis=0,
                                   concat_axis=0, tiled=True).reshape(n_shards * cap_out)
-    return recv_cols, recv_vis, n_dropped
+    return recv_cols, recv_vis, n_dropped, max_fill
 
 
 def shuffle_by_vnode(columns: Sequence[jnp.ndarray], vis: jnp.ndarray,
@@ -103,26 +108,34 @@ def shuffle_by_vnode(columns: Sequence[jnp.ndarray], vis: jnp.ndarray,
     return shuffle_rows(columns, vis, dest, axis_name, n_shards, cap_out)
 
 
-def mesh_ingest_chunk(chunk: StreamChunk, key_indices: Sequence[int],
-                      vnode_to_shard_table: jnp.ndarray, axis_name: str,
-                      n_shards: int, cap_out: int):
+def mesh_ingest_chunk(chunk: StreamChunk, key_indices, vnode_to_shard_table,
+                      axis_name: str, n_shards: int, cap_out: int):
     """The fused exchange ingest (call INSIDE shard_map): this shard's
     LOCAL row slice of a chunk is routed to the shards owning each row's
     vnode — ops, every column (data + validity) and visibility ride one
-    all_to_all. Returns (local_chunk, n_dropped) where `local_chunk` has
-    capacity n_shards * cap_out and holds exactly the rows this shard
-    owns, in source-shard-major order. Because the host chunk is sliced
-    CONTIGUOUSLY over the mesh axis, source-shard-major order IS the
-    original chunk order restricted to the owned rows — the same
-    relative order the replicated-and-masked path sees, so per-shard
-    executor semantics (pk-run netting, extrema updates) are unchanged."""
+    all_to_all. Returns (local_chunk, n_dropped, max_fill) where
+    `local_chunk` has capacity n_shards * cap_out and holds exactly the
+    rows this shard owns, in source-shard-major order. Because the host
+    chunk is sliced CONTIGUOUSLY over the mesh axis, source-shard-major
+    order IS the original chunk order restricted to the owned rows — the
+    same relative order the replicated-and-masked path sees, so per-shard
+    executor semantics (pk-run netting, extrema updates) are unchanged.
+
+    key_indices=None is the mesh-to-mesh NoShuffle leg: the upstream
+    shards already own their rows under the downstream distribution, so
+    the local slice passes through untouched — ZERO transfer, no
+    collective, n_dropped == 0, max_fill = this shard's visible rows."""
+    if key_indices is None:
+        zero = jnp.zeros((), dtype=jnp.int32)
+        occ = jnp.sum(chunk.vis, dtype=jnp.int32)
+        return chunk, zero, occ
     payload = [chunk.ops]
     for c in chunk.columns:
         payload.append(c.data)
         if c.valid is not None:
             payload.append(c.valid)
     key_cols = [chunk.columns[i].data for i in key_indices]
-    recv, recv_vis, n_dropped = shuffle_by_vnode(
+    recv, recv_vis, n_dropped, max_fill = shuffle_by_vnode(
         payload, chunk.vis, key_cols, vnode_to_shard_table, axis_name,
         n_shards, cap_out)
     it = iter(recv)
@@ -132,4 +145,4 @@ def mesh_ingest_chunk(chunk: StreamChunk, key_indices: Sequence[int],
         data = next(it)
         valid = next(it) if c.valid is not None else None
         cols.append(Column(data, valid))
-    return StreamChunk(tuple(cols), ops, recv_vis, chunk.schema), n_dropped
+    return StreamChunk(tuple(cols), ops, recv_vis, chunk.schema), n_dropped, max_fill

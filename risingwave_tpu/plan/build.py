@@ -130,6 +130,7 @@ class Deployment:
     source_queues: list = field(default_factory=list)
     memory_names: list = field(default_factory=list)
     mesh_actor_ids: list = field(default_factory=list)
+    mesh_chains: list = field(default_factory=list)    # chain labels
     # split enumerators created by this deployment's source builders
     # (broker discovery, connectors/broker.py) — unregistered on stop
     enumerators: list = field(default_factory=list)
@@ -197,6 +198,10 @@ class Deployment:
                 self.coord.memory.unregister(n)
             for a in self.mesh_actor_ids:
                 self.coord.unregister_mesh_fragment(a)
+            unreg_ch = getattr(self.coord, "unregister_mesh_chain", None)
+            if unreg_ch is not None:
+                for c in self.mesh_chains:
+                    unreg_ch(c)
             unreg = getattr(self.coord, "unregister_replay_channels", None)
             if unreg is not None and self.replay_channels:
                 unreg(self.replay_channels)
@@ -266,6 +271,107 @@ def _register_mesh(dep: Deployment, env: BuildEnv, root,
                         dep.frag_ingest_logs.setdefault(
                             fid, []).append(ilog)
             return                  # one registration per actor
+
+
+def _fuse_mesh_chains(dep: Deployment, graph, env, consumers) -> None:
+    """Mesh-resident pipelines: extend the per-fragment mesh plane to a
+    whole producer -> shuffle -> consumer CHAIN. A singleton producer
+    fragment whose executor chain is nothing but prelude-capable
+    stateless stages (Project / HopWindow — `mesh_prelude_fn`) over a
+    source, feeding exactly one sharded-agg fragment over a single
+    ChannelInput leg, is HOLLOWED: its stages pass raw source chunks
+    through untouched and their `_step_impl`s install as preludes INSIDE
+    the consumer's fused shard_map program. The chain then runs
+    device-resident end-to-end per barrier interval — the host touches
+    only barrier control, the persist d2h, and the MeshIngestLog replay
+    point (which now logs RAW source chunks, so a mesh-scope replay
+    re-runs the hollowed stages too). The producer actor turns
+    fence-exempt: it dispatches no device programs of its own, the
+    consumer's fence covers the chain.
+
+    Eligibility is conservative — any miss leaves the PR 8 per-fragment
+    plane untouched: producer must be singleton, local, single-consumer
+    (source-sharing fragments keep their host stages); Filter never
+    qualifies (its UD/UI pair fixup reads across rows). With
+    streaming_mesh_chain=0 the chain still REGISTERS and the host-hop
+    counter still runs un-hollowed — that is the unfused comparison
+    baseline scripts/mesh_profile.py measures against.
+
+    Runs after build_graph and again after rebuild_fragment (idempotent:
+    surviving hollow producers re-hollow, a surviving consumer keeps its
+    installed preludes — the stage impls are pure and config-identical
+    across incarnations)."""
+    actors_by_id = {a.actor_id: a for a in dep.actors}
+    for c_fid, roots in dep.roots.items():
+        f = graph.fragments.get(c_fid)
+        if f is None or len(roots) != 1 \
+                or getattr(f, "remote_worker", None):
+            continue
+        # consumer: first sharded executor in the chain, agg form only
+        # (dict-valued _mesh_preludes marks the join's per-side variant —
+        # its sides rarely meet the single-edge rule; per-chunk fallback
+        # keeps semantics there)
+        sharded, node = None, roots[0]
+        while node is not None:
+            if isinstance(getattr(node, "_mesh_preludes", None), tuple) \
+                    and getattr(node, "mesh", None) is not None:
+                sharded = node
+                break
+            node = getattr(node, "input", None)
+        if sharded is None or not getattr(sharded, "mesh_shuffle", False):
+            continue
+        if type(getattr(sharded, "input", None)).__name__ \
+                != "ChannelInput":
+            continue
+        # the single upstream edge into this fragment
+        ups = [u for u, cons in consumers.items()
+               if any(d == c_fid for d, _k in cons)]
+        if len(ups) != 1:
+            continue
+        u_fid = ups[0]
+        uf = graph.fragments[u_fid]
+        if (uf.parallelism != 1 or getattr(uf, "remote_worker", None)
+                or len(consumers.get(u_fid, ())) != 1
+                or len(dep.roots.get(u_fid, ())) != 1):
+            continue
+        # producer: only prelude-capable stages above the fragment's
+        # inlet — either an in-fragment source or the channel leg from a
+        # dedicated source fragment (the binder splits sources out, so
+        # the common shape is source-fragment -> project-fragment ->
+        # agg-fragment; hollowing the middle one is semantics-preserving
+        # regardless of what feeds it: raw chunks pass through untouched)
+        stages, p_node = [], dep.roots[u_fid][0]
+        while p_node is not None and hasattr(p_node, "mesh_prelude_fn"):
+            stages.append(p_node)
+            p_node = getattr(p_node, "input", None)
+        if not stages or not (isinstance(p_node, SourceExecutor)
+                              or type(p_node).__name__ == "ChannelInput"):
+            continue
+        chain = f"f{u_fid}-f{c_fid}"
+        hollow = bool(getattr(sharded, "mesh_chain_fuse", True))
+        for s in stages:
+            s.mesh_chain_hop = chain
+            if hollow:
+                s.mesh_hollow = True
+        if hollow:
+            if not sharded._mesh_preludes:
+                # source-most stage runs first inside the fused program
+                sharded.set_mesh_preludes(
+                    [s.mesh_prelude_fn() for s in reversed(stages)],
+                    chain=chain)
+            for aid in dep.frag_actor_ids.get(u_fid, []):
+                a = actors_by_id.get(aid)
+                if a is not None:
+                    a.fence_exempt = True
+        else:
+            sharded.mesh_chain = chain
+        reg = getattr(env.coord, "register_mesh_chain", None)
+        if reg is not None:
+            c_aids = dep.frag_actor_ids.get(c_fid, [])
+            reg(chain, (u_fid, c_fid), hollow,
+                c_aids[0] if c_aids else -1)
+            if chain not in dep.mesh_chains:
+                dep.mesh_chains.append(chain)
 
 
 def _build_fragment_actor(graph, env, dep, channels, built_schema,
@@ -422,6 +528,7 @@ def build_graph(graph: StreamGraph, env: BuildEnv) -> Deployment:
             env.pending_source_queues[q_before:])
     dep.source_queues = list(env.pending_source_queues)
     dep.enumerators = list(env.pending_enumerators)
+    _fuse_mesh_chains(dep, graph, env, consumers)
     dep.rebuild_info = {"graph": graph, "env": env, "channels": channels,
                         "built_schema": built_schema,
                         "consumers": consumers}
@@ -492,6 +599,10 @@ def rebuild_fragment(dep: Deployment, fid: int) -> list[Actor]:
     new_queues = env.pending_source_queues[q_before:]
     dep.frag_source_queues[fid] = list(new_queues)
     dep.source_queues.extend(new_queues)
+    # re-fuse: a rebuilt producer re-hollows against the surviving
+    # consumer; a rebuilt consumer re-installs preludes from the
+    # surviving producer's stages (idempotent for untouched chains)
+    _fuse_mesh_chains(dep, graph, env, consumers)
     return new_actors
 
 
@@ -747,7 +858,7 @@ def _build_hash_agg(args, inputs, ctx: ActorCtx, key):
     if md > 1:
         from ..parallel.mesh import make_mesh
         from ..stream.sharded_agg import ShardedHashAggExecutor
-        return ShardedHashAggExecutor(
+        ex = ShardedHashAggExecutor(
             inputs[0], args["group_key_indices"], args["agg_calls"],
             mesh=make_mesh(md),
             capacity=args.get("capacity", 1 << 16) // md,
@@ -756,7 +867,13 @@ def _build_hash_agg(args, inputs, ctx: ActorCtx, key):
             cleaning_watermark_col=args.get("cleaning_watermark_col"),
             watchdog_interval=args.get("watchdog_interval", 1),
             mesh_shuffle=bool(args.get("mesh_shuffle", True)),
-            mesh_shuffle_slack=args.get("mesh_shuffle_slack", 0))
+            mesh_shuffle_slack=args.get("mesh_shuffle_slack", 0),
+            mesh_shuffle_adaptive=bool(
+                args.get("mesh_shuffle_adaptive", True)))
+        # per-statement chain-fusion opt-out (streaming_mesh_chain=0):
+        # the post-build fusion pass reads this off the executor
+        ex.mesh_chain_fuse = bool(args.get("mesh_chain", True))
+        return ex
     return HashAggExecutor(
         inputs[0], args["group_key_indices"], args["agg_calls"],
         capacity=args.get("capacity", 1 << 16),
@@ -814,7 +931,9 @@ def _build_sorted_join(args, inputs, ctx: ActorCtx, key):
         cls = ShardedSortedJoinExecutor
         extra = dict(mesh=make_mesh(md),
                      mesh_shuffle=bool(args.get("mesh_shuffle", True)),
-                     mesh_shuffle_slack=args.get("mesh_shuffle_slack", 0))
+                     mesh_shuffle_slack=args.get("mesh_shuffle_slack", 0),
+                     mesh_shuffle_adaptive=bool(
+                         args.get("mesh_shuffle_adaptive", True)))
     return cls(
         inputs[0], inputs[1], **extra,
         left_key_indices=args["left_key_indices"],

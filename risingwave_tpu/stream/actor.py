@@ -43,6 +43,12 @@ class Actor:
         self.dispatcher = dispatcher
         self.collector = collector
         self.rows_processed = 0
+        # per-chain epoch fence (plan/build._fuse_mesh_chains): a HOLLOW
+        # producer actor dispatches no device programs of its own — its
+        # stages run inside the downstream fused program, whose actor's
+        # fence covers the whole chain — so its barrier path skips the
+        # token gather + block
+        self.fence_exempt = False
         # per-actor instrument bundle (stream/monitor.py ActorObs);
         # attached/removed by the coordinator's StreamingStats
         self.obs = None
@@ -121,8 +127,12 @@ class Actor:
                 # Blocking runs in a worker thread so other actors keep
                 # draining.
                 from .executor import gather_fence_tokens
-                tokens = [last_token] if last_token is not None else []
-                tokens.extend(gather_fence_tokens(self.consumer))
+                if self.fence_exempt:
+                    tokens = []
+                else:
+                    tokens = ([last_token]
+                              if last_token is not None else [])
+                    tokens.extend(gather_fence_tokens(self.consumer))
                 t_fence = mono() if obs is not None else 0
                 for tok in tokens:
                     if hasattr(tok, "block_until_ready"):

@@ -72,6 +72,7 @@ class Channel:
         self._replay = None               # deque to deliver before queue
         self._last_seq = 0                # max seq ever delivered
         self._stale_ceiling = None        # drop dead-epoch barriers below
+        self._skip_refs = None            # chunk ids preloaded downstream
 
     # ------------------------------------------------------------ replay
     def enable_replay(self) -> None:
@@ -120,8 +121,10 @@ class Channel:
         self._replay = None
         self._base_barrier = None
         self._stale_ceiling = None
+        self._skip_refs = None
 
-    def begin_replay(self, stale_ceiling: Optional[int] = None) -> int:
+    def begin_replay(self, stale_ceiling: Optional[int] = None,
+                     skip_refs: Optional[set] = None) -> int:
         """Arm re-delivery of the buffered suffix to the next consumer.
         Prepends a synthetic INITIAL barrier at the committed point (the
         rebuilt chain's executors init their state tables and reload
@@ -137,9 +140,18 @@ class Channel:
         replaying dead barriers would leave its merge peer one barrier
         short forever. A producer that was parked mid-epoch may even
         dispatch a dead barrier AFTER the rebuild — the ceiling filter
-        catches that too."""
+        catches that too.
+
+        `skip_refs` (channel-free mesh replay): object identities of
+        chunks the rebuilt consumer already holds — preloaded straight
+        from the crashed executor's MeshIngestLog into its pending queue
+        — so re-delivering them here would double-apply. The replay
+        buffer holds the SAME objects by reference, so identity matching
+        is exact; barriers and watermarks still replay for epoch
+        alignment. Consumed on match (each ref skips once)."""
         assert self._buf is not None, "replay not enabled on this channel"
         self._stale_ceiling = stale_ceiling
+        self._skip_refs = set(skip_refs) if skip_refs else None
         items = deque(self._buf)
         base = self._base_barrier
         if base is not None:
@@ -189,6 +201,10 @@ class Channel:
             if seq is not None and seq > self._last_seq:
                 self._last_seq = seq
             if self._is_stale(msg):
+                continue
+            skips = getattr(self, "_skip_refs", None)
+            if skips and id(msg) in skips:
+                skips.discard(id(msg))  # consumer preloaded this chunk
                 continue
             return msg
         if self._buf is None:

@@ -28,6 +28,16 @@ from ..ops.jit_state import jit_state
 
 
 class HopWindowExecutor(StatelessUnaryExecutor):
+    # Mesh-chain fusion: hollow hop passes raw chunks through; the K-fold
+    # expansion runs per-shard inside the downstream fused program (see
+    # ProjectExecutor — same contract; hop is row-wise per input row, the
+    # K copies of a row stay on the producing shard until the shuffle).
+    mesh_hollow = False
+    mesh_chain_hop = None
+
+    def mesh_prelude_fn(self):
+        return self._step_impl
+
     def __init__(self, input: Executor, time_col: int,
                  window_slide_us: int, window_size_us: int,
                  output_indices: Sequence[int] | None = None):
@@ -77,6 +87,12 @@ class HopWindowExecutor(StatelessUnaryExecutor):
     async def execute(self):
         async for msg in self.input.execute():
             if isinstance(msg, StreamChunk):
+                if self.mesh_hollow:
+                    yield msg       # expansion runs fused downstream
+                    continue
+                if self.mesh_chain_hop is not None:
+                    from .monitor import mesh_host_round_trip
+                    mesh_host_round_trip(self.mesh_chain_hop)
                 yield self._step(msg)
             elif isinstance(msg, Watermark):
                 wm = self.map_watermark(msg)

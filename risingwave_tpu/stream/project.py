@@ -25,6 +25,22 @@ from ..ops.jit_state import jit_state
 
 
 class ProjectExecutor(StatelessUnaryExecutor):
+    # Mesh-chain fusion (plan/build._fuse_mesh_chains): a hollow project
+    # passes chunks through UNTOUCHED — its _step_impl runs instead as a
+    # prelude INSIDE the downstream sharded executor's fused shard_map
+    # program (zero host hops). Watermark mapping stays host-side active:
+    # watermarks are control metadata in output coordinates either way.
+    mesh_hollow = False
+    mesh_chain_hop: Optional[str] = None  # chain label when registered un-hollowed
+
+    def mesh_prelude_fn(self):
+        """Pure chunk->chunk map safe to run per-SHARD inside shard_map.
+
+        Project qualifies: row-wise, no cross-row structure. (Filter does
+        NOT — its UD/UI pair fixup reads the neighbouring row via roll,
+        which breaks when an update pair straddles a shard-slice edge.)"""
+        return self._step_impl
+
     def __init__(self, input: Executor, exprs: Sequence[Expr],
                  names: Optional[Sequence[str]] = None,
                  watermark_mapping: Optional[dict[int, int]] = None,
@@ -52,6 +68,11 @@ class ProjectExecutor(StatelessUnaryExecutor):
         return StreamChunk(cols, chunk.ops, chunk.vis, self.schema)
 
     def map_chunk(self, chunk):
+        if self.mesh_hollow:
+            return chunk            # prelude runs fused downstream
+        if self.mesh_chain_hop is not None:
+            from .monitor import mesh_host_round_trip
+            mesh_host_round_trip(self.mesh_chain_hop)
         return self._step(chunk)
 
     def map_watermark(self, wm: Watermark):

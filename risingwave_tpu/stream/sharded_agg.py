@@ -126,7 +126,8 @@ class ShardedHashAggExecutor(HashAggExecutor):
                  cleaning_watermark_col: Optional[int] = None,
                  watchdog_interval: Optional[int] = 1,
                  mesh_shuffle: bool = True,
-                 mesh_shuffle_slack: int = 0):
+                 mesh_shuffle_slack: int = 0,
+                 mesh_shuffle_adaptive: bool = True):
         self.mesh = mesh
         self.n_shards = mesh.shape[VNODE_AXIS]
         self._routing = jnp.asarray(vnode_to_shard(self.n_shards))
@@ -139,6 +140,25 @@ class ShardedHashAggExecutor(HashAggExecutor):
                 "unchecked and a checkpoint could commit with rows "
                 "missing; transfer-free pipelines must use slack 0 "
                 "(zero-drop sizing)")
+        # adaptive shuffle slack (ROADMAP 3c): send-bucket capacity derived
+        # from OBSERVED per-destination demand (watchdog-fetched max fill,
+        # asymmetric EWMA + peak floor), instead of the manual slack var.
+        # Engages only under zero-drop default sizing (manual slack stays
+        # an override) and only with the watchdog fetch active — overflow
+        # under an adapted cap still fail-stops, recovery replays, and the
+        # fresh executor restarts at zero-drop sizing.
+        self.mesh_shuffle_adaptive = (bool(mesh_shuffle_adaptive)
+                                      and self.mesh_shuffle_slack == 0
+                                      and watchdog_interval is not None)
+        self._cap_hint: Optional[int] = None
+        self._fill_ewma = 0.0
+        self._fill_peak = 0
+        self._fill_obs = 0
+        # mesh-chain fusion (plan/build._fuse_mesh_chains): hollow producer
+        # stage impls run INSIDE the fused program, before the shuffle
+        self._mesh_preludes: tuple = ()
+        self.mesh_chain: Optional[str] = None
+        self._replay_preload: list = []
         # fused-plane dispatch count (one per interval batch in steady
         # state): tests and scripts/mesh_profile.py assert the fused
         # exchange actually engaged
@@ -181,16 +201,9 @@ class ShardedHashAggExecutor(HashAggExecutor):
         # routes rows to their owner shard, then the local hash table
         # applies exactly the owned rows. `dropped` accumulates shuffle
         # overflow per shard; the barrier watchdog fail-stops on it.
-        def apply_fused(state, overflow, dropped, chunk):
-            st, ov, dr, occ = self._fused_step(
-                state, overflow[0], dropped[0], chunk)
-            return st, ov[None], dr[None], occ[None]
-
-        self._apply_fused = jit_state(shard_map(
-            apply_fused, in_specs=(shard, shard, shard, shard),
-            out_specs=(shard, shard, shard, shard), **mesh_kw),
-            donate_argnums=(0, 1, 2), name="sharded_agg_apply_fused")
-        # interval-batched fused scans, keyed by batch size k
+        # per-chunk fused programs, keyed by the adaptive cap hint active
+        # at trace time (None = zero-drop sizing); scans keyed (k, hint)
+        self._fused_applies: dict = {}
         self._fused_scans: dict = {}
 
         def flush_sharded(state):
@@ -222,14 +235,15 @@ class ShardedHashAggExecutor(HashAggExecutor):
             return self._purge(state)
         self._rehash = rehash_same_capacity
 
-        def watchdog_sharded(ov, occ, dr):
+        def watchdog_sharded(ov, occ, dr, so):
             total_ov = jax.lax.psum(ov[0], VNODE_AXIS)
             max_occ = jax.lax.pmax(occ[0], VNODE_AXIS)
             total_dr = jax.lax.psum(dr[0], VNODE_AXIS)
-            return jnp.stack([total_ov, max_occ, total_dr])[None]
+            max_fill = jax.lax.pmax(so[0], VNODE_AXIS)
+            return jnp.stack([total_ov, max_occ, total_dr, max_fill])[None]
 
         self._watchdog_pack = jit_state(shard_map(
-            watchdog_sharded, in_specs=(shard, shard, shard),
+            watchdog_sharded, in_specs=(shard, shard, shard, shard),
             out_specs=shard,
             **mesh_kw), name="sharded_agg_watchdog_pack")
 
@@ -256,20 +270,82 @@ class ShardedHashAggExecutor(HashAggExecutor):
             jnp.zeros(self.n_shards, dtype=jnp.int32), sharding)
         self._dropped_dev = jax.device_put(
             jnp.zeros(self.n_shards, dtype=jnp.int32), sharding)
+        # max send-bucket DEMAND seen since the last watchdog fetch — the
+        # adaptive slack signal (reset to fresh zeros at each fetch)
+        self._send_occ_dev = jax.device_put(
+            jnp.zeros(self.n_shards, dtype=jnp.int32), sharding)
 
     # ------------------------------------------------ fused mesh shuffle
+    def set_mesh_preludes(self, fns, chain: Optional[str] = None) -> None:
+        """Install hollow producer-stage impls (project / hop_window
+        `_step_impl`s, root-to-source order reversed so the source-most
+        runs first) to execute INSIDE the fused program, upstream of the
+        shuffle. Must install before the first fused trace — the compiled
+        programs close over the prelude list."""
+        assert self.mesh_shuffle_applies == 0, \
+            "mesh preludes must install before the first fused dispatch"
+        self._mesh_preludes = tuple(fns)
+        self.mesh_chain = chain
+
+    def _prelude_host(self, chunk: StreamChunk) -> StreamChunk:
+        """Per-chunk host fallback: run the hollowed producer stages
+        eagerly so the replicated-mask path sees the transformed schema
+        it expects. Counted as host round trips by the caller."""
+        for fn in self._mesh_preludes:
+            chunk = fn(chunk)
+        return chunk
+
+    def _count_host_hop(self, n: int = 1) -> None:
+        if self.mesh_chain is not None:
+            from .monitor import mesh_host_round_trip
+            mesh_host_round_trip(self.mesh_chain, n)
+
+    def _trace_cap(self, local_rows: int) -> int:
+        """Per-(src,dst) send capacity at TRACE time: the manual slack
+        override wins; otherwise the adaptive hint (2x pow2-quantized
+        observed peak demand) once enough barriers have been observed;
+        zero-drop sizing until then."""
+        if not self.mesh_shuffle_adaptive or self._cap_hint is None:
+            return shuffle_cap_out(local_rows, self.n_shards,
+                                   self.mesh_shuffle_slack)
+        return min(local_rows, max(64, self._cap_hint))
+
     def _fused_step(self, state, overflow, dropped, chunk):
-        """One chunk's shuffle + apply, INSIDE shard_map (per-shard
-        views; `chunk` fields are this shard's local [L] row slices).
-        Shapes are static under trace, so the per-pair send capacity
-        re-derives per chunk-capacity signature."""
-        cap = shuffle_cap_out(chunk.capacity, self.n_shards,
-                              self.mesh_shuffle_slack)
-        local, n_drop = mesh_ingest_chunk(
+        """One chunk's preludes + shuffle + apply, INSIDE shard_map
+        (per-shard views; `chunk` fields are this shard's local [L] row
+        slices). Hollow producer stages run here first — device-resident,
+        zero host hops — then the in-mesh all_to_all routes the
+        transformed rows to their owner shards. Shapes are static under
+        trace, so the per-pair send capacity re-derives per
+        chunk-capacity signature (and per adaptive cap hint)."""
+        for fn in self._mesh_preludes:
+            chunk = fn(chunk)
+        cap = self._trace_cap(chunk.capacity)
+        local, n_drop, fill = mesh_ingest_chunk(
             chunk, self.group_key_indices, self._routing, VNODE_AXIS,
             self.n_shards, cap)
         st, ov, occ = self._apply_impl(state, overflow, local)
-        return st, ov, (dropped + n_drop).astype(dropped.dtype), occ
+        return (st, ov, (dropped + n_drop).astype(dropped.dtype), occ,
+                fill)
+
+    def _get_fused_apply(self):
+        prog = self._fused_applies.get(self._cap_hint)
+        if prog is not None:
+            return prog
+        shard = P(VNODE_AXIS)
+
+        def apply_fused(state, overflow, dropped, sendocc, chunk):
+            st, ov, dr, occ, fill = self._fused_step(
+                state, overflow[0], dropped[0], chunk)
+            so = jnp.maximum(sendocc[0], fill)
+            return st, ov[None], dr[None], occ[None], so[None]
+
+        prog = jit_state(shard_map(
+            apply_fused, mesh=self.mesh,
+            in_specs=(shard,) * 5, out_specs=(shard,) * 5),
+            donate_argnums=(0, 1, 2, 3), name="sharded_agg_apply_fused")
+        self._fused_applies[self._cap_hint] = prog
+        return prog
 
     def _make_fused_scan(self, k: int):
         """k identically-shaped chunks of one barrier interval, applied
@@ -279,24 +355,27 @@ class ShardedHashAggExecutor(HashAggExecutor):
         regardless of shard count."""
         shard = P(VNODE_AXIS)
 
-        def scan_body(state, overflow, dropped, *chunks):
+        def scan_body(state, overflow, dropped, sendocc, *chunks):
             stacked = jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *chunks)
 
             def step(carry, chunk):
-                st, ov, dr = carry
-                st, ov2, dr2, occ = self._fused_step(st, ov, dr, chunk)
-                return (st, ov2.astype(ov.dtype), dr2), occ
+                st, ov, dr, so = carry
+                st, ov2, dr2, occ, fill = self._fused_step(
+                    st, ov, dr, chunk)
+                return (st, ov2.astype(ov.dtype), dr2,
+                        jnp.maximum(so, fill)), occ
 
-            (st, ov, dr), occs = jax.lax.scan(
-                step, (state, overflow[0], dropped[0]), stacked)
-            return st, ov[None], dr[None], occs[-1][None]
+            (st, ov, dr, so), occs = jax.lax.scan(
+                step, (state, overflow[0], dropped[0], sendocc[0]),
+                stacked)
+            return st, ov[None], dr[None], occs[-1][None], so[None]
 
         return jit_state(shard_map(
             scan_body, mesh=self.mesh,
-            in_specs=(shard, shard, shard) + (shard,) * k,
-            out_specs=(shard, shard, shard, shard)),
-            donate_argnums=(0, 1, 2),
+            in_specs=(shard, shard, shard, shard) + (shard,) * k,
+            out_specs=(shard, shard, shard, shard, shard)),
+            donate_argnums=(0, 1, 2, 3),
             name=f"sharded_agg_apply_fused_scan{k}")
 
     def _fused_eligible(self, chunk: StreamChunk) -> bool:
@@ -308,10 +387,17 @@ class ShardedHashAggExecutor(HashAggExecutor):
     def _apply_chunk_raw(self, chunk: StreamChunk) -> None:
         if self._fused_eligible(chunk):
             (self.state, self._overflow_dev, self._dropped_dev,
-             self._occ_dev) = self._apply_fused(
-                self.state, self._overflow_dev, self._dropped_dev, chunk)
+             self._occ_dev, self._send_occ_dev) = self._get_fused_apply()(
+                self.state, self._overflow_dev, self._dropped_dev,
+                self._send_occ_dev, chunk)
             self.mesh_shuffle_applies += 1
         else:
+            # per-chunk host-plane fallback: a chain member couldn't stay
+            # fused, so the hollowed producer stages (if any) run here on
+            # the host and the crossing is counted against the chain
+            if self._mesh_preludes:
+                chunk = self._prelude_host(chunk)
+            self._count_host_hop()
             self.state, self._overflow_dev, self._occ_dev = self._apply(
                 self.state, self._overflow_dev, chunk)
         self._applied_since_flush = True
@@ -328,11 +414,21 @@ class ShardedHashAggExecutor(HashAggExecutor):
         self._pending_chunks = []
         # replay point: retain the interval's ingest BEFORE the fused
         # program consumes it (references only — chunks are never
-        # donated on the ingest path)
+        # donated on the ingest path). With preludes installed, the RAW
+        # source chunk is the replay point — re-running the fused program
+        # re-runs the hollowed producer stages too.
         for ch in p:
             self.ingest_log.note(ch)
-        if len(p) == 1 or not self._fused_eligible(p[0]):
-            self._mem_check_reload(p)
+        # replay preloads bypass _enqueue_chunk's shape splitting, so the
+        # scan's jnp.stack needs an explicit uniformity check here
+        uniform = len({(c.capacity, len(c.columns),
+                        tuple(col.valid is not None for col in c.columns))
+                       for c in p}) == 1
+        if len(p) == 1 or not self._fused_eligible(p[0]) or not uniform:
+            if not self._mesh_preludes:
+                # raw-schema chunks under preludes would confuse the
+                # spill reload walk; the sharded agg never spills anyway
+                self._mem_check_reload(p)
             for ch in p:
                 self._apply_chunk_raw(ch)
             return
@@ -345,16 +441,33 @@ class ShardedHashAggExecutor(HashAggExecutor):
                                  jnp.zeros(last.capacity, dtype=bool),
                                  last.schema)
             p = p + [filler] * (k - len(p))
-        self._mem_check_reload(p)
-        scan = self._fused_scans.get(k)
+        if not self._mesh_preludes:
+            self._mem_check_reload(p)
+        scan = self._fused_scans.get((k, self._cap_hint))
         if scan is None:
             scan = self._make_fused_scan(k)
-            self._fused_scans[k] = scan
+            self._fused_scans[(k, self._cap_hint)] = scan
         (self.state, self._overflow_dev, self._dropped_dev,
-         self._occ_dev) = scan(self.state, self._overflow_dev,
-                               self._dropped_dev, *p)
+         self._occ_dev, self._send_occ_dev) = scan(
+            self.state, self._overflow_dev, self._dropped_dev,
+            self._send_occ_dev, *p)
         self.mesh_shuffle_applies += 1
         self._applied_since_flush = True
+
+    def preload_replay(self, chunks) -> None:
+        """Channel-free mesh replay (ROADMAP 3d): the uncommitted ingest
+        suffix captured from the crashed executor's MeshIngestLog (plus
+        its undrained pending chunks) is fed straight into the fused
+        program — staged here, installed into the pending queue by
+        `recover()` at the INITIAL barrier (AFTER the durable state
+        rebuild; the INITIAL's own drain runs before recover, so
+        prepending now would apply the suffix to pre-recovery state),
+        then re-run as one fused scan at the next barrier and re-noted
+        into the fresh log by that drain. The frontier channels skip
+        these chunks by identity (Channel.begin_replay skip_refs);
+        barriers and watermarks still replay through them for epoch
+        alignment."""
+        self._replay_preload = list(chunks)
 
     # ------------------------------------------------------------ state
     def _initial_state(self, capacity: int) -> AggState:
@@ -471,6 +584,14 @@ class ShardedHashAggExecutor(HashAggExecutor):
         and the slices concatenate along the mesh axis. The durable
         persist path is the parent's unchanged — its snapshot-diff view
         is shape-agnostic over the global [S*C] arrays."""
+        # channel-free mesh replay: install the preloaded ingest suffix
+        # now that the durable state rebuild is about to run on pre-crash
+        # committed state (the INITIAL barrier's drain already ran, so
+        # these apply in one fused scan at the NEXT barrier).
+        preload = getattr(self, "_replay_preload", None)
+        if preload:
+            self._pending_chunks = list(preload) + self._pending_chunks
+            self._replay_preload = []
         if self.state_table is None:
             return
         rows = [r for _, r in self.state_table.iter_all()]
@@ -521,11 +642,41 @@ class ShardedHashAggExecutor(HashAggExecutor):
     def memory_evict(self, target_bytes: int, epoch: int) -> int:
         return 0
 
+    def _note_send_fill(self, fill: int) -> None:
+        """Adaptive slack observation (barrier-collection cadence): track
+        the max per-destination send demand with an ASYMMETRIC EWMA —
+        jumps up instantly on a larger fill (overflow safety beats
+        smoothing), decays slowly on smaller ones — plus an all-time peak
+        floor. The cap hint is 2x the pow2-ceiling of the worst signal
+        and only engages after 3 observations, so caps never shrink below
+        twice the worst demand ever seen; a workload whose skew suddenly
+        doubles past that still fail-stops and replays at zero-drop."""
+        if not self.mesh_shuffle_adaptive:
+            return
+        if fill > self._fill_ewma:
+            self._fill_ewma = float(fill)
+        else:
+            self._fill_ewma = 0.8 * self._fill_ewma + 0.2 * fill
+        self._fill_peak = max(self._fill_peak, fill)
+        self._fill_obs += 1
+        if self._fill_obs < 3:
+            return
+        worst = max(self._fill_ewma, float(self._fill_peak), 1.0)
+        self._cap_hint = 1 << (int(2 * worst) - 1).bit_length()
+
     def _check_watchdog(self) -> None:
         vals = np.asarray(self._watchdog_pack(self._overflow_dev,
                                               self._occ_dev,
-                                              self._dropped_dev))[0]
-        n_un, occ, n_drop = int(vals[0]), int(vals[1]), int(vals[2])
+                                              self._dropped_dev,
+                                              self._send_occ_dev))[0]
+        n_un, occ, n_drop, fill = (int(vals[0]), int(vals[1]),
+                                   int(vals[2]), int(vals[3]))
+        self._note_send_fill(fill)
+        # the pack donated nothing, but the interval's demand signal is
+        # consumed: start the next observation window from zero
+        sharding = NamedSharding(self.mesh, P(VNODE_AXIS))
+        self._send_occ_dev = jax.device_put(
+            jnp.zeros(self.n_shards, dtype=jnp.int32), sharding)
         if n_drop:
             # fail-stop BEFORE this epoch's checkpoint commits: a row the
             # shuffle dropped was never applied, so committing would make

@@ -60,7 +60,7 @@ def _vec_n(state: SortedSideState) -> SortedSideState:
 class ShardedSortedJoinExecutor(SortedJoinExecutor):
     def __init__(self, left: Executor, right: Executor, mesh: Mesh,
                  mesh_shuffle: bool = True, mesh_shuffle_slack: int = 0,
-                 **kwargs):
+                 mesh_shuffle_adaptive: bool = True, **kwargs):
         self.mesh = mesh
         self.n_shards = mesh.shape[VNODE_AXIS]
         self._routing = jnp.asarray(vnode_to_shard(self.n_shards))
@@ -74,6 +74,17 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
                 "unchecked — transfer-free pipelines must use slack 0 "
                 "(zero-drop sizing)")
         self.mesh_shuffle_applies = 0
+        # adaptive shuffle slack + mesh-chain preludes: same contract as
+        # ShardedHashAggExecutor (the agg carries the full commentary)
+        self.mesh_shuffle_adaptive = (
+            bool(mesh_shuffle_adaptive) and self.mesh_shuffle_slack == 0
+            and kwargs.get("watchdog_interval", 1) is not None)
+        self._cap_hint = None
+        self._fill_ewma = 0.0
+        self._fill_peak = 0
+        self._fill_obs = 0
+        self._mesh_preludes: dict = {}   # side -> tuple of prelude fns
+        self.mesh_chain = None
         # mesh-plane replay point (sharded_agg.py MeshIngestLog): the
         # uncommitted (side, chunk) ingest suffix, held by reference
         from .sharded_agg import MeshIngestLog
@@ -113,10 +124,12 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
         # owned rows. `dropped` (arg 3) accumulates shuffle overflow per
         # shard for the barrier watchdog's fail-stop.
         def make_apply_fused(side, mf):
-            def apply_fused(own, other, errs, dropped, chunk, wm):
-                cap = shuffle_cap_out(chunk.capacity, self.n_shards,
-                                      self.mesh_shuffle_slack)
-                local, n_drop = mesh_ingest_chunk(
+            def apply_fused(own, other, errs, dropped, sendocc, chunk,
+                            wm):
+                for fn in self._mesh_preludes.get(side, ()):
+                    chunk = fn(chunk)
+                cap = self._trace_cap(chunk.capacity)
+                local, n_drop, fill = mesh_ingest_chunk(
                     chunk, self.key_indices[side], self._routing,
                     VNODE_AXIS, self.n_shards, cap)
                 out = self._apply_impl(_scalar_n(own), _scalar_n(other),
@@ -125,13 +138,16 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
                 own2, odeg, cols, ops, vis, errs2, _ = out
                 return (_vec_n(own2), odeg, cols, ops, vis, errs2[None],
                         (dropped[0] + n_drop)[None],
+                        jnp.maximum(sendocc[0], fill)[None],
                         own2.n.reshape((1,)))
-            # donation: the error + shuffle-drop accumulators (threaded);
-            # side states stay aliased by the snapshot diff base (_snap)
+            # donation: the error + shuffle-drop + send-demand
+            # accumulators (threaded); side states stay aliased by the
+            # snapshot diff base (_snap)
             return jit_state(shard_map(
                 apply_fused, mesh=mesh,
-                in_specs=(shard, shard, shard, shard, shard, repl),
-                out_specs=(shard,) * 8), donate_argnums=(2, 3),
+                in_specs=(shard, shard, shard, shard, shard, shard,
+                          repl),
+                out_specs=(shard,) * 9), donate_argnums=(2, 3, 4),
                 name=f"sharded_join_apply_fused_s{side}")
 
         # sharded programs trace per (side, match_factor, fused): the
@@ -144,7 +160,9 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
             mf = match_factor or self.match_factors[side]
             fused = (self.mesh_shuffle
                      and chunk.capacity % self.n_shards == 0)
-            key = (side, mf, fused)
+            # programs also key by the adaptive cap hint active at trace
+            # time (None = zero-drop sizing)
+            key = (side, mf, fused, self._cap_hint if fused else None)
             if key not in applies:
                 applies[key] = (make_apply_fused(side, mf) if fused
                                 else make_apply(side, mf))
@@ -154,12 +172,30 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
                 # MeshIngestLog — the mesh-plane uncommitted suffix)
                 self.ingest_log.note((side, chunk))
                 (own2, odeg, cols, ops, vis, errs2, self._dropped_dev,
-                 n) = applies[key](own, other, errs, self._dropped_dev,
-                                   chunk, wm)
+                 self._send_occ_dev, n) = applies[key](
+                    own, other, errs, self._dropped_dev,
+                    self._send_occ_dev, chunk, wm)
                 self.mesh_shuffle_applies += 1
                 return own2, odeg, cols, ops, vis, errs2, n
+            # per-chunk host-plane fallback: hollowed producer stages (if
+            # any) run here eagerly; the crossing counts against the chain
+            if self._mesh_preludes.get(side):
+                for fn in self._mesh_preludes[side]:
+                    chunk = fn(chunk)
+            if self.mesh_chain is not None:
+                from .monitor import mesh_host_round_trip
+                mesh_host_round_trip(self.mesh_chain)
             return applies[key](own, other, errs, chunk, wm)
         self._apply = apply_dispatch
+
+        def set_mesh_preludes(side, fns, chain=None):
+            assert self.mesh_shuffle_applies == 0, \
+                "mesh preludes must install before the first fused " \
+                "dispatch"
+            self._mesh_preludes[side] = tuple(fns)
+            if chain is not None:
+                self.mesh_chain = chain
+        self.set_mesh_preludes = set_mesh_preludes
 
         def make_evict(side):
             def evict_sharded(own, wm, kh):
@@ -184,11 +220,15 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
         # would delete it out from under the watchdog fetch
         self._dropped_dev = jax.device_put(
             jnp.zeros(self.n_shards, dtype=jnp.int32), sharding)
+        self._send_occ_dev = jax.device_put(
+            jnp.zeros(self.n_shards, dtype=jnp.int32), sharding)
         self.sides = [self._sharded_empty(s) for s in (LEFT, RIGHT)]
-        # one packed fetch per barrier: summed errs + shuffle drops
+        # one packed fetch per barrier: summed errs + shuffle drops +
+        # max send-bucket demand (the adaptive slack signal)
         self._watchdog_pack_sh = jit_state(
-            lambda errs, dr: jnp.concatenate(
-                [jnp.sum(errs, axis=0), jnp.sum(dr)[None]]),
+            lambda errs, dr, so: jnp.concatenate(
+                [jnp.sum(errs, axis=0), jnp.sum(dr)[None],
+                 jnp.max(so)[None]]),
             name="sharded_join_watchdog_pack")
 
     def _sharded_empty(self, side: int) -> SortedSideState:
@@ -357,10 +397,39 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
         return [int(vals[:S].max()), int(vals[S:].max())]
 
     # --------------------------------------------------------- watchdog
+    def _trace_cap(self, local_rows: int) -> int:
+        """Send capacity at trace time: manual slack override, else the
+        adaptive hint (sharded_agg._trace_cap, same contract)."""
+        if not self.mesh_shuffle_adaptive or self._cap_hint is None:
+            return shuffle_cap_out(local_rows, self.n_shards,
+                                   self.mesh_shuffle_slack)
+        return min(local_rows, max(64, self._cap_hint))
+
+    def _note_send_fill(self, fill: int) -> None:
+        """Asymmetric EWMA + peak floor over the observed per-destination
+        demand (sharded_agg._note_send_fill carries the commentary)."""
+        if not self.mesh_shuffle_adaptive:
+            return
+        if fill > self._fill_ewma:
+            self._fill_ewma = float(fill)
+        else:
+            self._fill_ewma = 0.8 * self._fill_ewma + 0.2 * fill
+        self._fill_peak = max(self._fill_peak, fill)
+        self._fill_obs += 1
+        if self._fill_obs < 3:
+            return
+        worst = max(self._fill_ewma, float(self._fill_peak), 1.0)
+        self._cap_hint = 1 << (int(2 * worst) - 1).bit_length()
+
     def _check_watchdog(self) -> None:
         vals = np.asarray(self._watchdog_pack_sh(self._errs_dev,
-                                                 self._dropped_dev))
-        n_mo, n_miss, n_ro, n_drop = (int(x) for x in vals)
+                                                 self._dropped_dev,
+                                                 self._send_occ_dev))
+        n_mo, n_miss, n_ro, n_drop, fill = (int(x) for x in vals)
+        self._note_send_fill(fill)
+        sharding = NamedSharding(self.mesh, P(VNODE_AXIS))
+        self._send_occ_dev = jax.device_put(
+            jnp.zeros(self.n_shards, dtype=jnp.int32), sharding)
         if n_drop:
             # fail-stop before this epoch's checkpoint commits (same
             # contract as the sharded agg's shuffle-overflow check)

@@ -721,6 +721,7 @@ async def main() -> int:
 
     frag_p50 = _p50(frag_runs)
     cone_p50 = _p50(cone_runs)
+    mesh_p50 = _p50(mesh_runs)
     worker_p50 = _p50([dcn])
     full_p50 = _p50(full_runs)
     stall = by_name["channel_stall"]
@@ -750,10 +751,15 @@ async def main() -> int:
         "stall_no_recovery": stall["recoveries"] == 0,
         "fragment_recovery_p50_s": round(frag_p50, 5),
         "cone_recovery_p50_s": round(cone_p50, 5),
+        "mesh_recovery_p50_s": round(mesh_p50, 5),
         "worker_recovery_p50_s": round(worker_p50, 5),
         "full_recovery_p50_s": round(full_p50, 5),
         "fragment_beats_full": frag_p50 < full_p50,
         "cone_beats_full": cone_p50 < full_p50,
+        # channel-free mesh replay: the rebuilt fused executor preloads
+        # the MeshIngestLog suffix (one fused scan, no per-chunk channel
+        # re-delivery), so the mesh radius must stay cheaper than full
+        "mesh_beats_full": mesh_p50 < full_p50,
         "worker_beats_full": worker_p50 < full_p50,
         "fragment_under_budget": frag_p50 < FRAGMENT_P50_BUDGET_S,
         "scope_labels_in_metrics": all(

@@ -1,19 +1,29 @@
-"""Mesh-execution gate — fused mesh fragment vs host-exchange path.
+"""Mesh-execution gate — fused mesh-resident CHAIN vs host paths.
 
-Runs the same q7-shaped windowed-agg SQL twice on an 8-device VIRTUAL
-CPU mesh (`--xla_force_host_platform_device_count=8` — no TPU needed):
+Runs the same q7-shaped windowed-agg SQL three ways on an 8-device
+VIRTUAL CPU mesh (`--xla_force_host_platform_device_count=8` — no TPU
+needed):
 
-  host   SET streaming_parallelism = 8          8 actors, HashDispatcher
+  host         SET streaming_parallelism = 8    8 actors, HashDispatcher
                                                 + host channels + Merge
-  mesh   SET streaming_parallelism_devices = 8  ONE actor, the whole
-                                                exchange -> sharded-agg
-                                                chain fused into one
-                                                shard_map program per
-                                                barrier interval
-                                                (lax.all_to_all shuffle)
+  mesh_unfused SET streaming_parallelism_devices = 8
+               SET streaming_mesh_chain = 0     the PR 8 per-fragment
+                                                plane: producer stages
+                                                run on the host per
+                                                chunk, the sharded agg
+                                                re-ingests each interval
+  mesh         SET streaming_parallelism_devices = 8
+                                                the producer -> shuffle
+                                                -> consumer chain fused
+                                                into one shard_map
+                                                program per barrier
+                                                interval — hollow
+                                                producer stages run as
+                                                preludes INSIDE it,
+                                                zero per-chunk host hops
 
 Exit status is 0 iff ALL hold:
-  * BOTH paths' materialized results equal the host recount of the
+  * ALL paths' materialized results equal the host recount of the
     generator prefix at their exact source offsets (sources free-run
     between paced barriers, so offsets are load-dependent; exact
     content equality at the observed offset is the deterministic form
@@ -23,6 +33,11 @@ Exit status is 0 iff ALL hold:
   * the fused plane actually engaged: mesh_shuffle_applies > 0, the
     fragment registered with the coordinator as ONE actor x 8 shards,
     and zero mesh_shuffle_dropped_rows_total
+  * the CHAIN fused: a mesh chain registered in both mesh modes,
+    mesh_host_round_trips_total stays ZERO per fused steady interval,
+    and the unfused plane pays >= 2x the fused plane's per-interval
+    host transfers (>= 2 per interval vs 0 — the two hollowed producer
+    stages' worth)
 
     JAX_PLATFORMS=cpu python scripts/mesh_profile.py
 """
@@ -118,13 +133,18 @@ def _sharded_aggs(session):
 
 async def _run(mode: str) -> dict:
     from risingwave_tpu.frontend import Session
+    from risingwave_tpu.stream.monitor import mesh_host_round_trips
     from risingwave_tpu.utils.metrics import MESH_SHUFFLE_DROPPED
     s = Session()
     await s.execute("SET streaming_durability = 0")
-    if mode == "mesh":
+    if mode.startswith("mesh"):
         await s.execute(f"SET streaming_parallelism_devices = {N_DEVICES}")
     else:
         await s.execute(f"SET streaming_parallelism = {N_DEVICES}")
+    if mode == "mesh_unfused":
+        # PR 8 comparison plane: the chain still registers (so the
+        # host-hop counter runs) but the producer stages stay host-side
+        await s.execute("SET streaming_mesh_chain = 0")
     await s.execute(
         "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
         "chunk_size=256, rate_limit=1024)")
@@ -132,11 +152,14 @@ async def _run(mode: str) -> dict:
     aggs = _sharded_aggs(s)
     n_actors = len(s.coord.actor_ids)
     mesh_frags = dict(s.coord.mesh_fragments)
+    mesh_chains = {c: dict(info) for c, info in s.coord.mesh_chains.items()}
     await s.tick(WARMUP_ROUNDS)
     drop0 = MESH_SHUFFLE_DROPPED.value
     d0 = _dispatches()
+    h0 = mesh_host_round_trips()
     await s.tick(MEASURE_ROUNDS)
     d1 = _dispatches()
+    h1 = mesh_host_round_trips()
     # quiesce BEFORE reading: sources free-run between barriers, so
     # without a Pause the connector offset runs ahead of the last
     # materialized interval and the oracle comparison races (bench.py's
@@ -151,7 +174,9 @@ async def _run(mode: str) -> dict:
         "mode": mode,
         "actors": n_actors,
         "mesh_fragments": {str(a): n for a, (n, _) in mesh_frags.items()},
+        "mesh_chains": mesh_chains,
         "dispatches_per_interval": round((d1 - d0) / MEASURE_ROUNDS, 2),
+        "host_hops_per_interval": round((h1 - h0) / MEASURE_ROUNDS, 2),
         "rows": len(rows),
         "offset": offset,
         "matches_oracle": rows == _oracle(offset),
@@ -165,9 +190,17 @@ async def _run(mode: str) -> dict:
 
 async def main() -> int:
     host = await _run("host")
+    unfused = await _run("mesh_unfused")
     mesh = await _run("mesh")
+    # "host transfers per interval" for the >=2x gate: the counted
+    # per-chunk host-plane crossings; a zero fused count compares
+    # against an >= 2 unfused count (ratio floor of 2 with the 1-hop
+    # denominator clamp)
+    hop_reduction = (unfused["host_hops_per_interval"]
+                     / max(mesh["host_hops_per_interval"], 1.0))
     verdict = {
         "results_identical_to_oracle": (host["matches_oracle"]
+                                        and unfused["matches_oracle"]
                                         and mesh["matches_oracle"]),
         "dispatch_reduction": round(
             host["dispatches_per_interval"]
@@ -179,8 +212,15 @@ async def main() -> int:
                     for n in mesh["mesh_fragments"].values())),
         "fused_plane_engaged": mesh["fused_applies"] > 0,
         "zero_shuffle_drops": mesh["shuffle_dropped"] == 0,
+        "chain_registered": (
+            any(i["hollow"] for i in mesh["mesh_chains"].values())
+            and any(not i["hollow"]
+                    for i in unfused["mesh_chains"].values())),
+        "zero_host_hops_fused": mesh["host_hops_per_interval"] == 0,
+        "host_hop_reduction": round(hop_reduction, 2),
     }
     print(json.dumps(host))
+    print(json.dumps(unfused))
     print(json.dumps(mesh))
     print(json.dumps({"verdict": verdict}))
     ok = (verdict["results_identical_to_oracle"]
@@ -189,8 +229,11 @@ async def main() -> int:
           and verdict["one_actor_covers_8_shards"]
           and verdict["fused_plane_engaged"]
           and verdict["zero_shuffle_drops"]
+          and verdict["chain_registered"]
+          and verdict["zero_host_hops_fused"]
+          and hop_reduction >= 2.0
           and mesh["rows"] > 0 and host["offset"] > 0
-          and mesh["offset"] > 0)
+          and unfused["offset"] > 0 and mesh["offset"] > 0)
     return 0 if ok else 1
 
 

@@ -398,3 +398,213 @@ def test_compile_cache_namespaced_by_backend_and_machine(tmp_path,
         assert cc.machine_fingerprint() == fp
     finally:
         jax.config.update("jax_compilation_cache_dir", orig)
+
+
+# ------------------------------------------------- mesh-resident chains
+
+CHAIN_SQL = ("SELECT auction, window_end, max(price) AS maxprice, "
+             "count(*) AS n "
+             f"FROM TUMBLE(bid, date_time, {W}) "
+             "GROUP BY auction, window_end")
+
+
+async def _chain_session(store=None, pre=()):
+    from risingwave_tpu.frontend import Session
+    s = Session(store=store)
+    if store is None:
+        await s.execute("SET streaming_durability = 0")
+    await s.execute("SET streaming_parallelism_devices = 8")
+    for stmt in pre:
+        await s.execute(stmt)
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=256, rate_limit=1024)")
+    await s.execute(f"CREATE MATERIALIZED VIEW m AS {CHAIN_SQL}")
+    return s
+
+
+def _chain_agg(s):
+    aggs = []
+    for roots in s.catalog.mvs["m"].deployment.roots.values():
+        for root in roots:
+            node = root
+            while node is not None:
+                if isinstance(node, ShardedHashAggExecutor):
+                    aggs.append(node)
+                node = getattr(node, "input", None)
+    assert len(aggs) == 1
+    return aggs[0]
+
+
+def _chain_oracle(n):
+    """Host recount of the first n bid rows for CHAIN_SQL."""
+    from oracle import nexmark_prefix
+    cols = nexmark_prefix("bid", n)
+    auction, price, ts = cols[0], cols[2], cols[5]
+    we = ts - ts % W + W
+    agg: dict = {}
+    for a, w, p in zip(auction, we, price):
+        k = (int(a), int(w))
+        m, cnt = agg.get(k, (0, 0))
+        agg[k] = (max(m, int(p)), cnt + 1)
+    return sorted((a, w, m, cnt) for (a, w), (m, cnt) in agg.items())
+
+
+async def _quiesce(s):
+    from risingwave_tpu.stream.message import PauseMutation
+    b = await s.coord.inject_barrier(mutation=PauseMutation())
+    await s.coord.wait_collected(b)
+
+
+def _chain_rows(s):
+    return sorted(s.query("SELECT auction, window_end, maxprice, n FROM m"))
+
+
+async def test_mesh_chain_fused_zero_host_hops_one_dispatch():
+    """Tentpole contract: the q7-shaped source -> project -> sharded-agg
+    chain fuses — producer stages hollow into preludes of the consumer's
+    shard_map program, ZERO per-chunk host hops per steady interval,
+    exactly one fused dispatch per interval, and the materialized rows
+    are bit-identical to the single-device recount at the quiesced
+    offset."""
+    from risingwave_tpu.stream.monitor import mesh_host_round_trips
+    from risingwave_tpu.stream.source import SourceExecutor
+    s = await _chain_session()
+    chains = dict(s.coord.mesh_chains)
+    assert len(chains) == 1
+    (chain, info), = chains.items()
+    assert info["hollow"], "chain must hollow by default"
+    agg = _chain_agg(s)
+    assert agg.mesh_chain == chain and len(agg._mesh_preludes) == 2, \
+        "both producer project stages must install as preludes"
+    h0 = mesh_host_round_trips()
+    a0 = agg.mesh_shuffle_applies
+    await s.tick(4)
+    assert mesh_host_round_trips() - h0 == 0, \
+        "fused steady interval must not touch the host per chunk"
+    assert agg.mesh_shuffle_applies - a0 == 4, \
+        "one fused dispatch per barrier interval"
+    await _quiesce(s)
+    srcs = [node for roots in s.catalog.mvs["m"].deployment.roots.values()
+            for root in roots
+            for node in _iter_chain(root)
+            if isinstance(node, SourceExecutor)]
+    offset = max(g.connector.offset for g in srcs)
+    assert _chain_rows(s) == _chain_oracle(offset) and offset > 0
+    await s.drop_all()
+    assert not s.coord.mesh_chains, "drop must unregister the chain"
+
+
+def _iter_chain(root):
+    node = root
+    while node is not None:
+        yield node
+        node = getattr(node, "input", None)
+
+
+async def test_mesh_chain_unfused_fallback_identical():
+    """SET streaming_mesh_chain = 0: the chain still registers (the
+    host-hop counter runs — that is the PR 8 comparison plane) but the
+    producer stages stay host-side, pay counted per-chunk hops, and the
+    results stay bit-identical."""
+    from risingwave_tpu.stream.monitor import mesh_host_round_trips
+    from risingwave_tpu.stream.source import SourceExecutor
+    s = await _chain_session(pre=("SET streaming_mesh_chain = 0",))
+    (chain, info), = dict(s.coord.mesh_chains).items()
+    assert not info["hollow"]
+    agg = _chain_agg(s)
+    assert agg.mesh_chain == chain and not agg._mesh_preludes
+    h0 = mesh_host_round_trips(chain)
+    await s.tick(3)
+    assert mesh_host_round_trips(chain) - h0 > 0, \
+        "un-hollowed producer stages must count host hops"
+    await _quiesce(s)
+    srcs = [node for roots in s.catalog.mvs["m"].deployment.roots.values()
+            for root in roots
+            for node in _iter_chain(root)
+            if isinstance(node, SourceExecutor)]
+    offset = max(g.connector.offset for g in srcs)
+    assert _chain_rows(s) == _chain_oracle(offset) and offset > 0
+    await s.drop_all()
+
+
+async def test_mesh_chain_crash_recovers_fused_with_preload(tmp_path):
+    """Crash the fused consumer actor mid-stream: mesh-scope recovery
+    rebuilds it, the chain re-fuses (preludes reinstalled, hollow
+    producers intact), the captured MeshIngestLog suffix preloads into
+    the rebuilt fused program (channel-free replay — zero host hops
+    through recovery), and the MV converges bit-identical to the host
+    recount at the committed offset."""
+    from oracle import committed_offsets
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+    from risingwave_tpu.stream.monitor import mesh_host_round_trips
+    store = HummockStateStore(LocalFsObjectStore(str(tmp_path / "d")))
+    s = await _chain_session(store=store)
+    (chain, info), = dict(s.coord.mesh_chains).items()
+    assert info["hollow"]
+    h0 = mesh_host_round_trips(chain)
+    await s.tick(3)
+    dep = s.catalog.mvs["m"].deployment
+    by_id = {a.actor_id: i for i, a in enumerate(dep.actors)}
+    victim = dep.tasks[by_id[info["consumer_actor"]]]
+    victim.cancel()
+    try:
+        await victim
+    except (asyncio.CancelledError, Exception):
+        pass
+    await s.tick(3, max_recoveries=8)
+    assert s.recoveries >= 1
+    assert s.last_recovery["scope"] == "mesh"
+    (chain2, info2), = dict(s.coord.mesh_chains).items()
+    assert chain2 == chain and info2["hollow"], \
+        "recovery must re-fuse the chain"
+    agg = _chain_agg(s)
+    assert len(agg._mesh_preludes) == 2
+    assert mesh_host_round_trips(chain) - h0 == 0, \
+        "channel-free replay must not reintroduce per-chunk host hops"
+    await _quiesce(s)
+    offset = committed_offsets(s, "m")["bid"]
+    assert _chain_rows(s) == _chain_oracle(offset) and offset > 0
+    await s.drop_all()
+
+
+async def test_adaptive_shuffle_slack_sizes_from_observed_occupancy():
+    """Adaptive slack (no manual streaming_mesh_shuffle_slack): after a
+    few watchdog observations the executor derives a power-of-two cap
+    hint >= 2x the worst observed per-(src,dst) send-bucket demand, keeps
+    zero-drop semantics, and stays bit-identical to the single-device
+    plane."""
+    msgs = q7_messages(seed=13, intervals=5, chunks_per=2)
+    mesh = make_mesh(8)
+    sh = ShardedHashAggExecutor(
+        ScriptSource(BID, msgs), [2],
+        [AggCall(AggKind.MAX, 1, BID[1].data_type, append_only=True),
+         count_star()],
+        mesh=mesh, capacity=64)
+    assert sh.mesh_shuffle_adaptive, "adaptive sizing must be the default"
+    before = MESH_SHUFFLE_DROPPED.value
+    got = changelog(await drive(sh))
+    assert MESH_SHUFFLE_DROPPED.value == before
+    assert sh._fill_obs >= 3 and sh._cap_hint is not None
+    # power of two, floored at 2x the all-time peak demand
+    hint = sh._cap_hint
+    assert hint & (hint - 1) == 0
+    assert hint >= 2 * sh._fill_peak > 0
+    plain = HashAggExecutor(
+        ScriptSource(BID, msgs), [2],
+        [AggCall(AggKind.MAX, 1, BID[1].data_type, append_only=True),
+         count_star()],
+        capacity=512)
+    want = changelog(await drive(plain))
+    assert got == want and len(got) > 0
+
+
+async def test_manual_slack_overrides_adaptive():
+    """An explicit streaming_mesh_shuffle_slack keeps the PR 8 manual
+    sizing — adaptive derivation stays off."""
+    mesh = make_mesh(8)
+    sh = ShardedHashAggExecutor(
+        ScriptSource(BID, []), [0], [count_star()], mesh=mesh,
+        capacity=32, mesh_shuffle_slack=4)
+    assert not sh.mesh_shuffle_adaptive
+    assert sh.mesh_shuffle_slack == 4

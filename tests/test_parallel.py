@@ -40,7 +40,7 @@ def test_bucket_by_dest_roundtrip():
     vals = jnp.asarray(rng.integers(0, 1000, n, dtype=np.int64))
     dest = jnp.asarray(rng.integers(0, n_dest, n, dtype=np.int32))
     vis = jnp.asarray(rng.random(n) < 0.8)
-    (send,), send_vis, dropped = bucket_by_dest([vals], vis, dest, n_dest, cap)
+    (send,), send_vis, dropped, _occ = bucket_by_dest([vals], vis, dest, n_dest, cap)
     assert int(dropped) == 0
     # multiset of visible values preserved, each in its dest bucket
     for d in range(n_dest):
@@ -54,9 +54,10 @@ def test_bucket_overflow_counted():
     vals = jnp.arange(n, dtype=jnp.int64)
     dest = jnp.zeros(n, dtype=jnp.int32)  # all to dest 0, cap 4 -> 12 dropped
     vis = jnp.ones(n, dtype=bool)
-    _, send_vis, dropped = bucket_by_dest([vals], vis, dest, n_dest, cap)
+    _, send_vis, dropped, occ = bucket_by_dest([vals], vis, dest, n_dest, cap)
     assert int(dropped) == n - cap
     assert int(send_vis.sum()) == cap
+    assert int(occ) == n  # demand is pre-cap: all 16 rows wanted dest 0
 
 
 def test_shuffle_by_vnode_routes_to_owner():
@@ -70,7 +71,7 @@ def test_shuffle_by_vnode_routes_to_owner():
     vis_np = rng.random(per_shard * N_SHARDS) < 0.9
 
     def step(keys, vals, vis):
-        recv, recv_vis, dropped = shuffle_by_vnode(
+        recv, recv_vis, dropped, _occ = shuffle_by_vnode(
             [keys, vals], vis, key_columns=[keys],
             vnode_to_shard_table=routing, axis_name=VNODE_AXIS,
             n_shards=N_SHARDS, cap_out=cap)
@@ -101,3 +102,40 @@ def test_shuffle_by_vnode_routes_to_owner():
         want_mask = vis_np & (expect_owner == s)
         want = sorted(zip(keys_np[want_mask].tolist(), vals_np[want_mask].tolist()))
         assert got == want, f"shard {s} row set mismatch"
+
+
+def test_mesh_ingest_noshuffle_passthrough():
+    """key_indices=None is the mesh-to-mesh NoShuffle leg (upstream
+    shards already own their rows under the downstream distribution):
+    the local slice passes through untouched — no collective, zero
+    drops, occupancy = total visible rows."""
+    from risingwave_tpu.common import DataType, schema as mk_schema
+    from risingwave_tpu.common.chunk import StreamChunk
+    from risingwave_tpu.parallel.exchange import mesh_ingest_chunk
+
+    mesh = make_mesh(N_SHARDS)
+    n = 16 * N_SHARDS
+    sch = mk_schema(("k", DataType.INT64), ("v", DataType.INT64))
+    rng = np.random.default_rng(3)
+    k = rng.integers(0, 100, n).astype(np.int64)
+    v = rng.integers(0, 100, n).astype(np.int64)
+    ch = StreamChunk.from_numpy(sch, [k, v], capacity=n)
+
+    def step(chunk):
+        out, dropped, occ = mesh_ingest_chunk(
+            chunk, None, None, VNODE_AXIS, N_SHARDS, 16)
+        return (out, jax.lax.psum(dropped, VNODE_AXIS),
+                jax.lax.psum(occ, VNODE_AXIS))
+
+    sharding = NamedSharding(mesh, P(VNODE_AXIS))
+    dev = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), ch)
+    f = jax.jit(shard_map(step, mesh=mesh,
+                          in_specs=(P(VNODE_AXIS),),
+                          out_specs=(P(VNODE_AXIS), P(), P())))
+    out, dropped, occ = f(dev)
+    assert int(dropped) == 0
+    assert int(occ) == n
+    np.testing.assert_array_equal(np.asarray(out.columns[0].data), k)
+    np.testing.assert_array_equal(np.asarray(out.columns[1].data), v)
+    np.testing.assert_array_equal(np.asarray(out.vis), np.asarray(ch.vis))

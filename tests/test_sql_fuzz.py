@@ -86,7 +86,7 @@ async def test_streaming_vs_batch_differential():
                     "bidder, price FROM bid")
 
     passed, skipped = 0, 0
-    for i in range(24):
+    for i in range(20):
         sql_text, has_agg = _rand_query(rng, i)
         name = f"fz{i}"
         try:
@@ -124,7 +124,7 @@ async def test_streaming_vs_batch_join_differential():
 
     passed = 0
     saw_null = False
-    for i in range(6):
+    for i in range(5):
         m = rng.randint(3, 17)
         lf = rng.randint(2, 5)
         rf = rng.randint(2, 5)
