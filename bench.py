@@ -63,7 +63,8 @@ QUERY_BUDGET_S = {"q1": 60.0, "q5": 150.0, "q7": 150.0, "q8": 170.0,
                   "q17": 150.0, "q7d": 150.0, "q7_kill": 150.0,
                   "q7_kill_interior": 150.0, "q7_kill_worker": 200.0,
                   "q5_8chip": 150.0, "q7_8chip": 150.0,
-                  "q5_fused": 150.0, "q7_fused": 150.0}
+                  "q5_fused": 150.0, "q7_fused": 150.0,
+                  "q5_topn_8chip": 150.0}
 # Baseline inputs are fixed (they don't depend on the device run), so the
 # orchestrator computes all four baselines in PARALLEL CPU subprocesses
 # while the device queries run serially.
@@ -409,6 +410,39 @@ async def bench_q5_fused(progress: dict) -> None:
     the mesh_host_round_trips_total counter riding in the result as
     host_hops_per_interval."""
     await _bench_sql(progress, _q5_ddl(mesh_devices=8), interval_s=0.2,
+                     track_host_hops=True)
+
+
+def _q5_topn_ddl() -> list:
+    """q5-shaped top-N (ROADMAP item 3 follow-through): per-key counts
+    feeding a global ORDER BY n DESC LIMIT 10 in one statement — the agg
+    shards over the mesh as usual and the TopN deploys as ONE actor
+    whose retractable snapshot-diff store shards over the same 8 devices
+    (stream-key shuffle, per-shard local rank, candidate all_gather).
+    The group key is auction % 2^16: the retractable store retains every
+    live group, so a free-running bench needs a BOUNDED key space (the
+    hop-window q5 bounds it by watermark cleaning instead)."""
+    return [
+        "SET streaming_parallelism_devices = 8",
+        "SET streaming_durability = 0",
+        "SET streaming_watchdog = 0",
+        f"SET streaming_agg_capacity = {1 << 18}",
+        f"SET streaming_top_n_capacity = {1 << 17}",
+        ("CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+         "chunk_size=32768, inter_event_us=2, emit_watermarks=1)"),
+        ("CREATE SINK q5t AS SELECT auction % 65536 AS a, count(*) AS n "
+         "FROM bid GROUP BY auction % 65536 "
+         "ORDER BY n DESC LIMIT 10 "
+         "WITH (connector='blackhole_device')"),
+    ]
+
+
+async def bench_q5_topn_8chip(progress: dict) -> None:
+    """q5-shaped top-N on the 8-device mesh: source -> sharded count
+    agg -> sharded retractable TopN, with the projection prelude chain
+    hollowed into the fused per-interval programs. Emitted as
+    nexmark_q5_topn_rows_per_sec_8chip plus host_hops_per_interval."""
+    await _bench_sql(progress, _q5_topn_ddl(), interval_s=0.2,
                      track_host_hops=True)
 
 
@@ -1094,6 +1128,7 @@ QUERIES = {"q1": bench_q1, "q5": bench_q5, "q7": bench_q7,
            "q7_kill_worker": _q7_kill_victim("worker"),
            "q5_8chip": bench_q5_8chip, "q7_8chip": bench_q7_8chip,
            "q5_fused": bench_q5_fused, "q7_fused": bench_q7_fused,
+           "q5_topn_8chip": bench_q5_topn_8chip,
            "broker_ingest": bench_broker_ingest}
 NORTH_STAR = ("q7", "q8")
 
@@ -1370,6 +1405,12 @@ def _emit_combined(results: dict, note: str = "",
             if "host_hops_per_interval" in rf:
                 out[f"nexmark_{q}_fused_host_hops_per_interval"] = \
                     rf["host_hops_per_interval"]
+    rt = results.get("q5_topn_8chip")
+    if rt and rt.get("rows_per_sec"):
+        out["nexmark_q5_topn_rows_per_sec_8chip"] = rt["rows_per_sec"]
+        if "host_hops_per_interval" in rt:
+            out["nexmark_q5_topn_host_hops_per_interval"] = \
+                rt["host_hops_per_interval"]
     if extra:
         out.update(extra)
     if note:
@@ -1425,7 +1466,8 @@ def main() -> None:
     n_devices = int(m_dev.group(1)) if m_dev else 0
     query_list = ["q1", "q5", "q7", "q8", "q17", "q7d", "q7_kill"]
     if n_devices >= 8:
-        query_list += ["q5_8chip", "q7_8chip", "q5_fused", "q7_fused"]
+        query_list += ["q5_8chip", "q7_8chip", "q5_fused", "q7_fused",
+                       "q5_topn_8chip"]
     for q in query_list:
         remaining = GLOBAL_BUDGET_S - (time.perf_counter() - t0) - 10
         if remaining <= 40:   # a query needs import+compile time to matter

@@ -1,11 +1,11 @@
 from .ir import Expr, InputRef, Literal, FuncCall, call, col, lit
 from .agg import AggCall, AggKind, AggSpec, count_star, agg_max, agg_min, agg_sum
-from .functions import registered_functions
+from .registry import KernelEntry, entries, kernel, registered_functions
 
 __all__ = [
     "Expr", "InputRef", "Literal", "FuncCall", "call", "col", "lit",
     "AggCall", "AggKind", "AggSpec", "count_star", "agg_max", "agg_min",
-    "agg_sum", "registered_functions",
+    "agg_sum", "registered_functions", "KernelEntry", "entries", "kernel",
 ]
 
 from . import strings as _strings  # registers string kernels

@@ -1,11 +1,14 @@
-"""Scalar function registry — vectorized jnp kernels.
+"""Scalar function kernels — bodies behind the declarative registry.
 
 The reference generates ~600 typed kernels with the `#[function("add(*int,
-*int)->auto")]` proc-macro (src/expr/macro/, impl/src/scalar/). Here a kernel
-is a plain python function over `Column`s traced by XLA; type dispatch is
-trace-time (dtype promotion below), so one registration covers all numeric
-widths — the macro expansion the reference does at compile time, jnp does by
-promotion.
+*int)->auto")]` proc-macro (src/expr/macro/, impl/src/scalar/). Here each
+kernel is a plain python function over `Column`s traced by XLA and DECLARED
+via `registry.kernel` with its type rule and input-kind signature — one
+table entry per function, consumed by the batch evaluator, plan-time type
+inference, and the mesh prelude/fused-program builder alike (see
+registry.py). Type dispatch is trace-time (dtype promotion), so one entry
+covers all numeric widths — the macro expansion the reference does at
+compile time, jnp does by promotion.
 
 Null discipline: `strict` wraps a data-only kernel with AND-of-valids
 propagation (reference strict eval, expr/mod.rs:167); non-strict kernels
@@ -15,50 +18,19 @@ semantics.
 
 from __future__ import annotations
 
-from typing import Callable, Sequence
-
 import jax.numpy as jnp
 
 from ..common.chunk import Column
 from ..common.types import DataType
+from .registry import (  # noqa: F401  (re-exported: legacy import surface)
+    _and_valid, case_rule, fixed, infer_ret_type, kernel, lookup, promote,
+    registered_functions, strict,
+)
 
-_REGISTRY: dict[str, Callable] = {}
-
-
-def register(name: str):
-    def deco(fn):
-        _REGISTRY[name] = fn
-        return fn
-    return deco
-
-
-def lookup(name: str) -> Callable:
-    try:
-        return _REGISTRY[name]
-    except KeyError:
-        raise NotImplementedError(f"scalar function {name!r} not registered") from None
-
-
-def registered_functions() -> list[str]:
-    return sorted(_REGISTRY)
-
-
-# ---------------------------------------------------------------- helpers
-
-def _and_valid(cols: Sequence[Column]):
-    valid = None
-    for c in cols:
-        if c.valid is not None:
-            valid = c.valid if valid is None else (valid & c.valid)
-    return valid
-
-
-def strict(fn):
-    """Lift a data-only kernel to null-propagating (strict) semantics."""
-    def wrapped(node, cols: Sequence[Column]) -> Column:
-        data = fn(node, *[c.data for c in cols])
-        return Column(data, _and_valid(cols))
-    return wrapped
+_BOOL = fixed(DataType.BOOLEAN)
+_I64 = fixed(DataType.INT64)
+_F64 = fixed(DataType.FLOAT64)
+_TS = fixed(DataType.TIMESTAMP)
 
 
 def _cast_to(data, dtype: DataType):
@@ -67,25 +39,25 @@ def _cast_to(data, dtype: DataType):
 
 # ------------------------------------------------------------- arithmetic
 
-@register("add")
+@kernel("add", input_kinds=("num", "num"))
 @strict
 def _add(node, a, b):
     return (a + b).astype(node.ret_type.jnp_dtype)
 
 
-@register("subtract")
+@kernel("subtract", input_kinds=("num", "num"))
 @strict
 def _sub(node, a, b):
     return (a - b).astype(node.ret_type.jnp_dtype)
 
 
-@register("multiply")
+@kernel("multiply", input_kinds=("num", "num"))
 @strict
 def _mul(node, a, b):
     return (a * b).astype(node.ret_type.jnp_dtype)
 
 
-@register("divide")
+@kernel("divide", input_kinds=("num", "num"))
 def _div(node, cols):
     a, b = cols[0].data, cols[1].data
     valid = _and_valid(cols)
@@ -101,7 +73,7 @@ def _div(node, cols):
     return Column(out, valid)
 
 
-@register("modulus")
+@kernel("modulus", input_kinds=("num", "num"))
 def _mod(node, cols):
     a, b = cols[0].data, cols[1].data
     valid = _and_valid(cols)
@@ -111,13 +83,13 @@ def _mod(node, cols):
     return Column(out, valid)
 
 
-@register("neg")
+@kernel("neg", input_kinds=("num",))
 @strict
 def _neg(node, a):
     return -a
 
 
-@register("abs")
+@kernel("abs", input_kinds=("num",))
 @strict
 def _abs(node, a):
     return jnp.abs(a)
@@ -131,15 +103,21 @@ def _cmp(op):
         return op(a, b)
     return fn
 
-register("equal")(_cmp(lambda a, b: a == b))
-register("not_equal")(_cmp(lambda a, b: a != b))
-register("less_than")(_cmp(lambda a, b: a < b))
-register("less_than_or_equal")(_cmp(lambda a, b: a <= b))
-register("greater_than")(_cmp(lambda a, b: a > b))
-register("greater_than_or_equal")(_cmp(lambda a, b: a >= b))
+kernel("equal", type_rule=_BOOL,
+       input_kinds=("num", "num"))(_cmp(lambda a, b: a == b))
+kernel("not_equal", type_rule=_BOOL,
+       input_kinds=("num", "num"))(_cmp(lambda a, b: a != b))
+kernel("less_than", type_rule=_BOOL,
+       input_kinds=("num", "num"))(_cmp(lambda a, b: a < b))
+kernel("less_than_or_equal", type_rule=_BOOL,
+       input_kinds=("num", "num"))(_cmp(lambda a, b: a <= b))
+kernel("greater_than", type_rule=_BOOL,
+       input_kinds=("num", "num"))(_cmp(lambda a, b: a > b))
+kernel("greater_than_or_equal", type_rule=_BOOL,
+       input_kinds=("num", "num"))(_cmp(lambda a, b: a >= b))
 
 
-@register("greatest")
+@kernel("greatest", input_kinds=("num",), variadic=True)
 @strict
 def _greatest(node, *args):
     out = args[0]
@@ -148,7 +126,7 @@ def _greatest(node, *args):
     return out
 
 
-@register("least")
+@kernel("least", input_kinds=("num",), variadic=True)
 @strict
 def _least(node, *args):
     out = args[0]
@@ -160,7 +138,7 @@ def _least(node, *args):
 # ---------------------------------------------------------------- boolean
 # Kleene three-valued logic (reference: impl/src/scalar/conjunction.rs)
 
-@register("and")
+@kernel("and", type_rule=_BOOL, input_kinds=("bool", "bool"))
 def _and(node, cols):
     a, b = cols
     av, bv = a.valid_mask(), b.valid_mask()
@@ -174,7 +152,7 @@ def _and(node, cols):
     return Column(data, valid)
 
 
-@register("or")
+@kernel("or", type_rule=_BOOL, input_kinds=("bool", "bool"))
 def _or(node, cols):
     a, b = cols
     av, bv = a.valid_mask(), b.valid_mask()
@@ -187,19 +165,19 @@ def _or(node, cols):
     return Column(data, valid)
 
 
-@register("not")
+@kernel("not", type_rule=_BOOL, input_kinds=("bool",))
 @strict
 def _not(node, a):
     return ~a
 
 
-@register("is_null")
+@kernel("is_null", type_rule=_BOOL, input_kinds=("any",))
 def _is_null(node, cols):
     (a,) = cols
     return Column(~a.valid_mask(), None)
 
 
-@register("is_not_null")
+@kernel("is_not_null", type_rule=_BOOL, input_kinds=("any",))
 def _is_not_null(node, cols):
     (a,) = cols
     return Column(a.valid_mask(), None)
@@ -207,7 +185,7 @@ def _is_not_null(node, cols):
 
 # ------------------------------------------------------------ conditional
 
-@register("case")
+@kernel("case", type_rule=case_rule, input_kinds=("bool", "any"), variadic=True)
 def _case(node, cols):
     """case(cond1, val1, cond2, val2, ..., [else]) — first-match wins."""
     n = len(cols)
@@ -226,7 +204,7 @@ def _case(node, cols):
     return Column(out, valid)
 
 
-@register("hll_estimate")
+@kernel("hll_estimate", type_rule=_I64, input_kinds=("num",), variadic=True)
 def _hll_estimate(node, cols):
     from .hll import estimate_from_words_jnp
     out = estimate_from_words_jnp([c.data for c in cols])
@@ -236,7 +214,7 @@ def _hll_estimate(node, cols):
     return Column(out, valid)
 
 
-@register("coalesce")
+@kernel("coalesce", input_kinds=("any",), variadic=True)
 def _coalesce(node, cols):
     out = cols[-1].data.astype(node.ret_type.jnp_dtype)
     valid = cols[-1].valid_mask()
@@ -249,7 +227,7 @@ def _coalesce(node, cols):
 
 # ------------------------------------------------------------------- cast
 
-@register("cast")
+@kernel("cast", input_kinds=("any",))
 def _cast(node, cols):
     (a,) = cols
     src = a.data
@@ -264,108 +242,40 @@ def _cast(node, cols):
 # --------------------------------------------------------------- datetime
 # Timestamps are int64 microseconds; intervals are int64 microseconds.
 
-@register("tumble_start")
+@kernel("tumble_start", type_rule=_TS, input_kinds=("ts", "interval"))
 @strict
 def _tumble_start(node, ts, interval):
     return ts - ts % interval
 
 
-@register("tumble_end")
+@kernel("tumble_end", type_rule=_TS, input_kinds=("ts", "interval"))
 @strict
 def _tumble_end(node, ts, interval):
     return ts - ts % interval + interval
 
 
-@register("extract_epoch")
+@kernel("extract_epoch", type_rule=_I64, input_kinds=("ts",))
 @strict
 def _extract_epoch(node, ts):
     return ts // 1_000_000
 
 
-# ---------------------------------------------------------- type inference
-
-_CMP_FNS = {
-    "equal", "not_equal", "less_than", "less_than_or_equal",
-    "greater_than", "greater_than_or_equal",
-}
-_BOOL_FNS = {"and", "or", "not", "is_null", "is_not_null"}
-_NUMERIC_ORDER = [
-    DataType.BOOLEAN, DataType.INT16, DataType.INT32, DataType.INT64,
-    DataType.DECIMAL, DataType.FLOAT32, DataType.FLOAT64,
-]
-
-
-def _promote(types) -> DataType:
-    best = DataType.INT16
-    for t in types:
-        if t in (DataType.TIMESTAMP, DataType.TIMESTAMPTZ, DataType.DATE,
-                 DataType.TIME, DataType.INTERVAL):
-            return t
-        if t not in _NUMERIC_ORDER:
-            return t
-        if _NUMERIC_ORDER.index(t) > _NUMERIC_ORDER.index(best):
-            best = t
-    return best
-
-
-_FLOAT_FNS = {"sqrt", "cbrt", "exp", "ln", "log10", "sin", "cos", "tan",
-              "atan", "pow"}
-_EXTRACT_FNS = {"extract_epoch", "extract_year", "extract_month",
-                "extract_day", "extract_hour", "extract_minute",
-                "extract_second", "extract_dow"}
-
-
-def infer_ret_type(name: str, args) -> DataType:
-    from .strings import STRING_FNS, STRING_PREDS
-    if name in STRING_PREDS:
-        return DataType.BOOLEAN
-    if name in STRING_FNS:
-        return DataType.VARCHAR
-    if name in ("length", "char_length", "ascii"):
-        return DataType.INT64
-    if name in _CMP_FNS or name in _BOOL_FNS:
-        return DataType.BOOLEAN
-    if name in ("is_null", "is_not_null"):
-        return DataType.BOOLEAN
-    if name == "hll_estimate":
-        return DataType.INT64
-    if name == "case":
-        n = len(args)
-        vals = [args[2 * i + 1] for i in range(n // 2)]
-        if n % 2 == 1:
-            vals.append(args[-1])
-        ts = [a.ret_type for a in vals]
-        if all(t == ts[0] for t in ts):
-            return ts[0]     # _promote would degrade BOOLEAN to INT16
-        return _promote(ts)
-    if name in ("tumble_start", "tumble_end") or name.startswith("date_trunc_"):
-        return DataType.TIMESTAMP
-    if name in _EXTRACT_FNS:
-        return DataType.INT64
-    if name in _FLOAT_FNS:
-        return DataType.FLOAT64
-    if name == "divide":
-        t = _promote([a.ret_type for a in args])
-        return t
-    return _promote([a.ret_type for a in args])
-
-
 # ------------------------------------------------- numeric breadth
 # (reference impl/src/scalar/{arithmetic_op,round,exp,pow,trigonometric}.rs)
 
-@register("floor")
+@kernel("floor", input_kinds=("num",))
 @strict
 def _floor(node, a):
     return jnp.floor(a).astype(node.ret_type.jnp_dtype)
 
 
-@register("ceil")
+@kernel("ceil", input_kinds=("num",))
 @strict
 def _ceil(node, a):
     return jnp.ceil(a).astype(node.ret_type.jnp_dtype)
 
 
-@register("round")
+@kernel("round", input_kinds=("num",))
 @strict
 def _round(node, a):
     # PG/reference round halves AWAY from zero (round.rs); jnp.round is
@@ -377,109 +287,109 @@ def _round(node, a):
         node.ret_type.jnp_dtype)
 
 
-@register("trunc")
+@kernel("trunc", input_kinds=("num",))
 @strict
 def _trunc(node, a):
     return jnp.trunc(a).astype(node.ret_type.jnp_dtype)
 
 
-@register("sign")
+@kernel("sign", input_kinds=("num",))
 @strict
 def _sign(node, a):
     return jnp.sign(a).astype(node.ret_type.jnp_dtype)
 
 
-@register("pow")
+@kernel("pow", type_rule=_F64, input_kinds=("num", "num"))
 @strict
 def _pow(node, a, b):
     return jnp.power(a.astype(jnp.float64), b).astype(node.ret_type.jnp_dtype)
 
 
-@register("sqrt")
+@kernel("sqrt", type_rule=_F64, input_kinds=("num",))
 @strict
 def _sqrt(node, a):
     return jnp.sqrt(a.astype(jnp.float64))
 
 
-@register("cbrt")
+@kernel("cbrt", type_rule=_F64, input_kinds=("num",))
 @strict
 def _cbrt(node, a):
     return jnp.cbrt(a.astype(jnp.float64))
 
 
-@register("exp")
+@kernel("exp", type_rule=_F64, input_kinds=("num",))
 @strict
 def _exp(node, a):
     return jnp.exp(a.astype(jnp.float64))
 
 
-@register("ln")
+@kernel("ln", type_rule=_F64, input_kinds=("num",))
 @strict
 def _ln(node, a):
     return jnp.log(a.astype(jnp.float64))
 
 
-@register("log10")
+@kernel("log10", type_rule=_F64, input_kinds=("num",))
 @strict
 def _log10(node, a):
     return jnp.log10(a.astype(jnp.float64))
 
 
-@register("sin")
+@kernel("sin", type_rule=_F64, input_kinds=("num",))
 @strict
 def _sin(node, a):
     return jnp.sin(a.astype(jnp.float64))
 
 
-@register("cos")
+@kernel("cos", type_rule=_F64, input_kinds=("num",))
 @strict
 def _cos(node, a):
     return jnp.cos(a.astype(jnp.float64))
 
 
-@register("tan")
+@kernel("tan", type_rule=_F64, input_kinds=("num",))
 @strict
 def _tan(node, a):
     return jnp.tan(a.astype(jnp.float64))
 
 
-@register("atan")
+@kernel("atan", type_rule=_F64, input_kinds=("num",))
 @strict
 def _atan(node, a):
     return jnp.arctan(a.astype(jnp.float64))
 
 
-@register("bitwise_and")
+@kernel("bitwise_and", input_kinds=("num", "num"))
 @strict
 def _bit_and(node, a, b):
     return a & b
 
 
-@register("bitwise_or")
+@kernel("bitwise_or", input_kinds=("num", "num"))
 @strict
 def _bit_or(node, a, b):
     return a | b
 
 
-@register("bitwise_xor")
+@kernel("bitwise_xor", input_kinds=("num", "num"))
 @strict
 def _bit_xor(node, a, b):
     return a ^ b
 
 
-@register("bitwise_not")
+@kernel("bitwise_not", input_kinds=("num",))
 @strict
 def _bit_not(node, a):
     return jnp.invert(a)
 
 
-@register("bitwise_shift_left")
+@kernel("bitwise_shift_left", input_kinds=("num", "num"))
 @strict
 def _shl(node, a, b):
     return jnp.left_shift(a, b)
 
 
-@register("bitwise_shift_right")
+@kernel("bitwise_shift_right", input_kinds=("num", "num"))
 @strict
 def _shr(node, a, b):
     return jnp.right_shift(a, b)
@@ -519,49 +429,49 @@ def _days_and_us(ts):
     return days, ts - days * _US_PER_DAY
 
 
-@register("extract_year")
+@kernel("extract_year", type_rule=_I64, input_kinds=("ts",))
 @strict
 def _extract_year(node, ts):
     y, _, _ = _civil_from_days(_days_and_us(ts)[0])
     return y.astype(jnp.int64)
 
 
-@register("extract_month")
+@kernel("extract_month", type_rule=_I64, input_kinds=("ts",))
 @strict
 def _extract_month(node, ts):
     _, m, _ = _civil_from_days(_days_and_us(ts)[0])
     return m.astype(jnp.int64)
 
 
-@register("extract_day")
+@kernel("extract_day", type_rule=_I64, input_kinds=("ts",))
 @strict
 def _extract_day(node, ts):
     _, _, d = _civil_from_days(_days_and_us(ts)[0])
     return d.astype(jnp.int64)
 
 
-@register("extract_hour")
+@kernel("extract_hour", type_rule=_I64, input_kinds=("ts",))
 @strict
 def _extract_hour(node, ts):
     return jnp.floor_divide(_days_and_us(ts)[1],
                             3_600_000_000).astype(jnp.int64)
 
 
-@register("extract_minute")
+@kernel("extract_minute", type_rule=_I64, input_kinds=("ts",))
 @strict
 def _extract_minute(node, ts):
     return jnp.mod(jnp.floor_divide(_days_and_us(ts)[1], 60_000_000),
                    60).astype(jnp.int64)
 
 
-@register("extract_second")
+@kernel("extract_second", type_rule=_I64, input_kinds=("ts",))
 @strict
 def _extract_second(node, ts):
     return jnp.mod(jnp.floor_divide(_days_and_us(ts)[1], 1_000_000),
                    60).astype(jnp.int64)
 
 
-@register("extract_dow")
+@kernel("extract_dow", type_rule=_I64, input_kinds=("ts",))
 @strict
 def _extract_dow(node, ts):
     # 1970-01-01 was a Thursday (dow 4, Sunday = 0)
@@ -578,11 +488,9 @@ _TRUNC_US = {
 }
 
 
-@register("date_trunc_second")
-@register("date_trunc_minute")
-@register("date_trunc_hour")
-@register("date_trunc_day")
-@register("date_trunc_week")
+@kernel("date_trunc_second", "date_trunc_minute", "date_trunc_hour",
+        "date_trunc_day", "date_trunc_week", type_rule=_TS,
+        input_kinds=("ts",))
 def _date_trunc(node, cols):
     unit = node.name.rsplit("_", 1)[1]
     us = _TRUNC_US[unit]

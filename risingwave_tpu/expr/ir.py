@@ -92,7 +92,7 @@ class FuncCall(Expr):
     ret_type: DataType
 
     def eval(self, columns):
-        from .functions import lookup
+        from .registry import lookup
         arg_cols = [a.eval(columns) for a in self.args]
         return lookup(self.name)(self, arg_cols)
 
@@ -118,7 +118,7 @@ def _lit(v) -> Expr:
 
 def call(name: str, *args) -> FuncCall:
     """Build a FuncCall with inferred return type."""
-    from .functions import infer_ret_type
+    from .registry import infer_ret_type
     args = tuple(_lit(a) for a in args)
     return FuncCall(name, args, infer_ret_type(name, args))
 

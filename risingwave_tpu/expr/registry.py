@@ -1,0 +1,172 @@
+"""Declarative JIT kernel registry — ONE table: name -> kernel + type rule.
+
+The reference engine scales its ~600-kernel scalar library through a
+single `#[function("add(int, int) -> auto")]` registry (src/expr/macro/,
+SURVEY §2.4): a kernel is declared ONCE with its signature and every
+consumer — batch eval, stream eval, codegen — goes through the table.
+Here the same idea lands as a declarative python table: a `KernelEntry`
+carries the pure jax kernel, its TYPE RULE, and its input-kind signature,
+and every consumer is a table lookup:
+
+  * the batch Column evaluator (`FuncCall.eval` in ir.py),
+  * return-type inference at plan time (`call()` -> `infer_ret_type`),
+  * the mesh prelude / fused-program builder — hollowed Project/HopWindow
+    stages trace the SAME kernels inside the consumer's `shard_map`
+    program, so a registered kernel fuses into the mesh plane for free.
+
+A new scalar function is one `@kernel(...)` registration (kernel body +
+type rule + input kinds); no per-function lowering exists anywhere else.
+
+Null discipline: `strict` lifts a data-only kernel to AND-of-valids
+propagation (reference strict eval, expr/mod.rs:167); non-strict kernels
+(bool ops, case, coalesce, is_null) manage validity themselves with
+Kleene semantics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+from ..common.chunk import Column
+from ..common.types import DataType
+
+# ------------------------------------------------------------- type rules
+# A type rule is (name, args) -> DataType where args are Expr nodes with
+# a .ret_type. Combinators below cover the whole built-in library; a
+# bespoke callable is fine for anything irregular (see `case_rule`).
+
+_NUMERIC_ORDER = [
+    DataType.BOOLEAN, DataType.INT16, DataType.INT32, DataType.INT64,
+    DataType.DECIMAL, DataType.FLOAT32, DataType.FLOAT64,
+]
+
+
+def _promote(types) -> DataType:
+    best = DataType.INT16
+    for t in types:
+        if t in (DataType.TIMESTAMP, DataType.TIMESTAMPTZ, DataType.DATE,
+                 DataType.TIME, DataType.INTERVAL):
+            return t
+        if t not in _NUMERIC_ORDER:
+            return t
+        if _NUMERIC_ORDER.index(t) > _NUMERIC_ORDER.index(best):
+            best = t
+    return best
+
+
+def promote(name: str, args) -> DataType:
+    """Default rule: numeric promotion over the argument types."""
+    return _promote([a.ret_type for a in args])
+
+
+def fixed(dt: DataType):
+    """Rule: the function always returns `dt`."""
+    def rule(name: str, args) -> DataType:
+        return dt
+    return rule
+
+
+def case_rule(name: str, args) -> DataType:
+    """case(c1, v1, ..., [else]) — common type of the VALUE branches."""
+    n = len(args)
+    vals = [args[2 * i + 1] for i in range(n // 2)]
+    if n % 2 == 1:
+        vals.append(args[-1])
+    ts = [a.ret_type for a in vals]
+    if all(t == ts[0] for t in ts):
+        return ts[0]     # _promote would degrade BOOLEAN to INT16
+    return _promote(ts)
+
+
+# ------------------------------------------------------------- the table
+
+@dataclass(frozen=True)
+class KernelEntry:
+    name: str
+    kernel: Callable        # (node, cols: Sequence[Column]) -> Column
+    type_rule: Callable     # (name, args) -> DataType
+    input_kinds: tuple      # ("num", "num"), ("str", "lit"), ... or ()
+    variadic: bool = False
+
+
+_TABLE: dict[str, KernelEntry] = {}
+_loaded = False
+
+
+def kernel(*names: str, type_rule: Optional[Callable] = None,
+           input_kinds: Sequence[str] = (), variadic: bool = False):
+    """Register a kernel under one or more names.
+
+    The decorated callable has the evaluator signature
+    `(node, cols: Sequence[Column]) -> Column`; wrap a data-only body
+    with `strict` for AND-of-valids null propagation."""
+    rule = type_rule if type_rule is not None else promote
+
+    def deco(fn):
+        for nm in names:
+            _TABLE[nm] = KernelEntry(nm, fn, rule, tuple(input_kinds),
+                                     variadic)
+        return fn
+    return deco
+
+
+def _ensure_loaded() -> None:
+    # registrations live in functions.py / strings.py as import side
+    # effects; lazy so `registry` itself has no import cycle
+    global _loaded
+    if not _loaded:
+        _loaded = True
+        from . import functions, strings  # noqa: F401
+
+
+def lookup(name: str) -> Callable:
+    _ensure_loaded()
+    try:
+        return _TABLE[name].kernel
+    except KeyError:
+        raise NotImplementedError(
+            f"scalar function {name!r} not registered") from None
+
+
+def entry(name: str) -> KernelEntry:
+    _ensure_loaded()
+    return _TABLE[name]
+
+
+def entries() -> list:
+    """All registered entries — the sweep surface for differential tests
+    and the mesh program builder's capability listing."""
+    _ensure_loaded()
+    return [_TABLE[k] for k in sorted(_TABLE)]
+
+
+def registered_functions() -> list:
+    _ensure_loaded()
+    return sorted(_TABLE)
+
+
+def infer_ret_type(name: str, args) -> DataType:
+    _ensure_loaded()
+    e = _TABLE.get(name)
+    if e is not None:
+        return e.type_rule(name, args)
+    return promote(name, args)
+
+
+# ---------------------------------------------------------- null helpers
+
+def _and_valid(cols: Sequence[Column]):
+    valid = None
+    for c in cols:
+        if c.valid is not None:
+            valid = c.valid if valid is None else (valid & c.valid)
+    return valid
+
+
+def strict(fn):
+    """Lift a data-only kernel to null-propagating (strict) semantics."""
+    def wrapped(node, cols: Sequence[Column]) -> Column:
+        data = fn(node, *[c.data for c in cols])
+        return Column(data, _and_valid(cols))
+    return wrapped

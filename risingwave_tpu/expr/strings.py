@@ -28,7 +28,11 @@ import numpy as np
 
 from ..common.chunk import Column
 from ..common.types import GLOBAL_DICT, DataType
-from .functions import register, strict, _and_valid
+from .registry import _and_valid, fixed, kernel, strict
+
+_VARCHAR = fixed(DataType.VARCHAR)
+_BOOL = fixed(DataType.BOOLEAN)
+_I64 = fixed(DataType.INT64)
 
 # (key, dict_len) -> device mapping array
 _MAP_CACHE: dict = {}
@@ -56,7 +60,7 @@ def _gather(arr, ids):
 
 
 def _str_to_str(name, py_fn):
-    @register(name)
+    @kernel(name, type_rule=_VARCHAR, input_kinds=("str",))
     @strict
     def _impl(node, ids, _name=name, _fn=py_fn):
         m = _mapping(("s2s", _name),
@@ -76,15 +80,14 @@ _str_to_str("md5", lambda s: __import__("hashlib").md5(
     s.encode()).hexdigest())
 
 
-@register("length")
-@register("char_length")
+@kernel("length", "char_length", type_rule=_I64, input_kinds=("str",))
 @strict
 def _length(node, ids):
     m = _mapping(("len",), len, np.int64)
     return _gather(m, ids)
 
 
-@register("ascii")
+@kernel("ascii", type_rule=_I64, input_kinds=("str",))
 @strict
 def _ascii(node, ids):
     m = _mapping(("ascii",), lambda s: ord(s[0]) if s else 0, np.int64)
@@ -102,7 +105,7 @@ def _literal_arg(node, pos: int, what: str) -> str:
 
 def _str_pred(name, build_pred):
     """String predicate with a LITERAL second argument -> bool mapping."""
-    @register(name)
+    @kernel(name, type_rule=_BOOL, input_kinds=("str", "lit"))
     def _impl(node, cols, _name=name, _build=build_pred):
         pat = _literal_arg(node, 1, "pattern")
         pred = _build(pat)
@@ -125,7 +128,8 @@ _str_pred("ends_with", lambda p: (lambda s: s.endswith(p)))
 _str_pred("contains", lambda p: (lambda s: p in s))
 
 
-@register("substr")
+@kernel("substr", type_rule=_VARCHAR, input_kinds=("str", "lit"),
+        variadic=True)
 @strict
 def _substr(node, ids, *_rest):
     """substr(s, start[, count]) with LITERAL positions (1-based, PG)."""

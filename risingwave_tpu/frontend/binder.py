@@ -1307,13 +1307,29 @@ class StreamPlanner:
             if any(w.kind == "lead" for w in windows):
                 raise BindError(
                     "EMIT ON WINDOW CLOSE cannot finalize lead()")
+        ow_args = dict(
+            partition_by=partition_by, order_specs=order_specs,
+            windows=windows, pk_indices=list(sk),
+            capacity=self.cfg("streaming_over_window_capacity", 1 << 14),
+            durable=self.durable())
+        if not eowc:
+            # mesh mode: partitions shard over the device mesh inside
+            # ONE executor (partition-key routing keeps frames local);
+            # the EOWC variant stays single-device (frontier state is
+            # host-ordered)
+            ow_args.update(
+                mesh_devices=self.cfg("streaming_parallelism_devices", 1),
+                mesh_shuffle=self.cfg("streaming_mesh_shuffle", 1),
+                mesh_shuffle_slack=self.cfg(
+                    "streaming_mesh_shuffle_slack", 0),
+                mesh_shuffle_adaptive=self.cfg(
+                    "streaming_mesh_shuffle_adaptive", 1),
+                mesh_chain=self.cfg("streaming_mesh_chain", 1),
+                watchdog_interval=(
+                    1 if self.cfg("streaming_watchdog", 1) else None))
         frag.root = Node(
-            "eowc_over_window" if eowc else "general_over_window", dict(
-                partition_by=partition_by, order_specs=order_specs,
-                windows=windows, pk_indices=list(sk),
-                capacity=self.cfg("streaming_over_window_capacity",
-                                  1 << 14),
-                durable=self.durable()), inputs=(frag.root,))
+            "eowc_over_window" if eowc else "general_over_window",
+            ow_args, inputs=(frag.root,))
         in_width = len(scope.schema)
         win_fields = []
         out_sch = list(scope.schema)
@@ -1395,12 +1411,26 @@ class StreamPlanner:
         # the TopN is a SINGLETON fragment (default parallelism=1)
         # downstream of the (possibly hash-parallel) input: per-shard
         # top-Ns would union to up to limit*parallelism wrong rows
-        # (reference: StreamTopN is a singleton below the hash agg)
+        # (reference: StreamTopN is a singleton below the hash agg).
+        # Mesh mode: still ONE actor, but the store shards over the
+        # N-device mesh inside the executor (stream-key routing +
+        # candidate all_gather keep the global rank exact)
+        md = self.cfg("streaming_parallelism_devices", 1)
+        wd = 1 if self.cfg("streaming_watchdog", 1) else None
         top = self.graph.add(Fragment(self.fid(), Node(
             "retract_top_n", dict(
                 group_key_indices=(), order_specs=order_specs,
                 limit=limit, offset=offset, durable=self.durable(),
-                pk_indices=list(pk_hint)),
+                pk_indices=list(pk_hint),
+                capacity=self.cfg("streaming_top_n_capacity", 1 << 14),
+                mesh_devices=md,
+                mesh_shuffle=self.cfg("streaming_mesh_shuffle", 1),
+                mesh_shuffle_slack=self.cfg(
+                    "streaming_mesh_shuffle_slack", 0),
+                mesh_shuffle_adaptive=self.cfg(
+                    "streaming_mesh_shuffle_adaptive", 1),
+                mesh_chain=self.cfg("streaming_mesh_chain", 1),
+                watchdog_interval=wd),
             inputs=(Exchange(fid),)), dispatch="simple"))
         # ranks can change retroactively: no watermark survives a TopN
         return top.fid, names, types, pk_hint, False, frozenset()

@@ -146,6 +146,7 @@ class Session:
         # unfused baseline scripts/mesh_profile.py compares against).
         "streaming_mesh_chain": (1, int),
         "streaming_over_window_capacity": (1 << 14, int),
+        "streaming_top_n_capacity": (1 << 14, int),
         "streaming_dynamic_filter_capacity": (1 << 14, int),
         # "host:port" of a running fragment worker
         # (python -m risingwave_tpu.worker): join fragments deploy there

@@ -273,6 +273,83 @@ def _register_mesh(dep: Deployment, env: BuildEnv, root,
             return                  # one registration per actor
 
 
+def _fuse_join_sides(dep: Deployment, graph, env, consumers, c_fid, frag,
+                     join, actors_by_id) -> None:
+    """Two-input chain fusion for the sharded join: hollow eligible
+    producer chains on BOTH sides independently. Each side gets its own
+    per-side chain (`f<u>-f<c>s<side>`): the sides' producers differ, so
+    one side may hollow while the other keeps its host stages — the
+    fused program runs whichever preludes installed for the side it is
+    tracing. Side order comes from the plan tree: the sorted_join node's
+    input legs, each a direct Exchange leaf (an in-fragment subtree
+    between exchange and join disqualifies that side — the built input
+    is then not a ChannelInput)."""
+    if not getattr(join, "mesh_shuffle", False):
+        return
+    legs = getattr(join, "inputs", ())
+    if len(legs) != 2 or any(type(i).__name__ != "ChannelInput"
+                             for i in legs):
+        return
+
+    def find_join(n):
+        if isinstance(n, Exchange):
+            return None
+        if n.kind == "sorted_join":
+            return n
+        for i in n.inputs:
+            r = find_join(i)
+            if r is not None:
+                return r
+        return None
+
+    jnode = find_join(frag.root)
+    if jnode is None or len(jnode.inputs) != 2 \
+            or not all(isinstance(i, Exchange) for i in jnode.inputs):
+        return
+    hollow = bool(getattr(join, "mesh_chain_fuse", True))
+    for side, leg in enumerate(jnode.inputs):
+        u_fid = leg.upstream
+        uf = graph.fragments.get(u_fid)
+        if (uf is None or uf.parallelism != 1
+                or getattr(uf, "remote_worker", None)
+                or len(consumers.get(u_fid, ())) != 1
+                or len(dep.roots.get(u_fid, ())) != 1):
+            continue
+        stages, p_node = [], dep.roots[u_fid][0]
+        while p_node is not None and hasattr(p_node, "mesh_prelude_fn"):
+            stages.append(p_node)
+            p_node = getattr(p_node, "input", None)
+        if not stages or not (isinstance(p_node, SourceExecutor)
+                              or type(p_node).__name__ == "ChannelInput"):
+            continue
+        chain = f"f{u_fid}-f{c_fid}s{side}"
+        for s in stages:
+            s.mesh_chain_hop = chain
+            if hollow:
+                s.mesh_hollow = True
+        if hollow:
+            if not join._mesh_preludes.get(side):
+                join.set_mesh_preludes(
+                    side, [s.mesh_prelude_fn() for s in reversed(stages)],
+                    chain=chain)
+            for aid in dep.frag_actor_ids.get(u_fid, []):
+                a = actors_by_id.get(aid)
+                if a is not None:
+                    a.fence_exempt = True
+        else:
+            # host-plane fallback hops count against ONE chain name per
+            # executor (last side registered); both chains still appear
+            # in the coordinator's registry for the topology view
+            join.mesh_chain = chain
+        reg = getattr(env.coord, "register_mesh_chain", None)
+        if reg is not None:
+            c_aids = dep.frag_actor_ids.get(c_fid, [])
+            reg(chain, (u_fid, c_fid), hollow,
+                c_aids[0] if c_aids else -1)
+            if chain not in dep.mesh_chains:
+                dep.mesh_chains.append(chain)
+
+
 def _fuse_mesh_chains(dep: Deployment, graph, env, consumers) -> None:
     """Mesh-resident pipelines: extend the per-fragment mesh plane to a
     whole producer -> shuffle -> consumer CHAIN. A singleton producer
@@ -307,15 +384,20 @@ def _fuse_mesh_chains(dep: Deployment, graph, env, consumers) -> None:
         if f is None or len(roots) != 1 \
                 or getattr(f, "remote_worker", None):
             continue
-        # consumer: first sharded executor in the chain, agg form only
-        # (dict-valued _mesh_preludes marks the join's per-side variant —
-        # its sides rarely meet the single-edge rule; per-chunk fallback
-        # keeps semantics there)
+        # consumer: first sharded executor in the chain. Tuple-valued
+        # _mesh_preludes is the single-input form (agg / top-N /
+        # over-window); dict-valued marks the join's per-side variant,
+        # which runs its own two-input eligibility walk
         sharded, node = None, roots[0]
         while node is not None:
             if isinstance(getattr(node, "_mesh_preludes", None), tuple) \
                     and getattr(node, "mesh", None) is not None:
                 sharded = node
+                break
+            if isinstance(getattr(node, "_mesh_preludes", None), dict) \
+                    and getattr(node, "mesh", None) is not None:
+                _fuse_join_sides(dep, graph, env, consumers, c_fid, f,
+                                 node, actors_by_id)
                 break
             node = getattr(node, "input", None)
         if sharded is None or not getattr(sharded, "mesh_shuffle", False):
@@ -934,7 +1016,7 @@ def _build_sorted_join(args, inputs, ctx: ActorCtx, key):
                      mesh_shuffle_slack=args.get("mesh_shuffle_slack", 0),
                      mesh_shuffle_adaptive=bool(
                          args.get("mesh_shuffle_adaptive", True)))
-    return cls(
+    ex = cls(
         inputs[0], inputs[1], **extra,
         left_key_indices=args["left_key_indices"],
         right_key_indices=args["right_key_indices"],
@@ -954,6 +1036,11 @@ def _build_sorted_join(args, inputs, ctx: ActorCtx, key):
         state_tables=state_tables,
         temporal=args.get("temporal", False),
         watchdog_interval=args.get("watchdog_interval", 1))
+    if md > 1:
+        # per-statement chain-fusion opt-out, read by _fuse_mesh_chains'
+        # two-input walk (join-side producer hollowing)
+        ex.mesh_chain_fuse = bool(args.get("mesh_chain", True))
+    return ex
 
 
 @register_builder("group_top_n")
@@ -983,6 +1070,24 @@ def _build_general_over_window(args, inputs, ctx: ActorCtx, key):
     if args.get("durable"):
         st = ctx.env.state_table(ctx.table_id(key), inputs[0].schema, pk,
                                  vnode_bitmap=ctx.vnode_bitmap)
+    md = args.get("mesh_devices", 1)
+    # no partition axis -> nothing to shard on: stay single-device
+    if md > 1 and args["partition_by"]:
+        from ..parallel.mesh import make_mesh
+        from ..stream.sharded_over_window import ShardedOverWindowExecutor
+        ex = ShardedOverWindowExecutor(
+            inputs[0], args["partition_by"], args["order_specs"],
+            args["windows"],
+            capacity=args.get("capacity", 1 << 14) // md,
+            state_table=st, pk_indices=pk,
+            watchdog_interval=args.get("watchdog_interval", 1),
+            mesh=make_mesh(md),
+            mesh_shuffle=bool(args.get("mesh_shuffle", True)),
+            mesh_shuffle_slack=args.get("mesh_shuffle_slack", 0),
+            mesh_shuffle_adaptive=bool(
+                args.get("mesh_shuffle_adaptive", True)))
+        ex.mesh_chain_fuse = bool(args.get("mesh_chain", True))
+        return ex
     return GeneralOverWindowExecutor(
         inputs[0], args["partition_by"], args["order_specs"],
         args["windows"], capacity=args.get("capacity", 1 << 14),
@@ -1145,6 +1250,26 @@ def _build_retract_top_n(args, inputs, ctx: ActorCtx, key):
     if args.get("durable"):
         st = ctx.env.state_table(ctx.table_id(key), inputs[0].schema, pk,
                                  vnode_bitmap=ctx.vnode_bitmap)
+    md = args.get("mesh_devices", 1)
+    if md > 1:
+        from ..parallel.mesh import make_mesh
+        from ..stream.sharded_top_n import ShardedTopNExecutor
+        ex = ShardedTopNExecutor(
+            inputs[0], args.get("group_key_indices", ()),
+            order_col=args.get("order_col"),
+            order_specs=args.get("order_specs"),
+            limit=args["limit"], offset=args.get("offset", 0),
+            descending=args.get("descending", False),
+            capacity=args.get("capacity", 1 << 14) // md,
+            state_table=st, pk_indices=pk,
+            watchdog_interval=args.get("watchdog_interval", 1),
+            mesh=make_mesh(md),
+            mesh_shuffle=bool(args.get("mesh_shuffle", True)),
+            mesh_shuffle_slack=args.get("mesh_shuffle_slack", 0),
+            mesh_shuffle_adaptive=bool(
+                args.get("mesh_shuffle_adaptive", True)))
+        ex.mesh_chain_fuse = bool(args.get("mesh_chain", True))
+        return ex
     return RetractableTopNExecutor(
         inputs[0], args.get("group_key_indices", ()),
         order_col=args.get("order_col"),

@@ -32,5 +32,7 @@ from .misc import (
     ValuesExecutor, WatermarkFilterExecutor,
 )
 from .general_over_window import GeneralOverWindowExecutor, WindowSpec  # noqa: E402,F401
+from .sharded_top_n import ShardedTopNExecutor  # noqa: E402,F401
+from .sharded_over_window import ShardedOverWindowExecutor  # noqa: E402,F401
 from .dynamic import DynamicFilterExecutor, NowExecutor  # noqa: E402,F401
 from .project_set import ProjectSetExecutor  # noqa: E402,F401

@@ -63,6 +63,15 @@ class NoOpExecutor(StatelessUnaryExecutor):
     """Identity passthrough (reference no_op.rs — plan-shape padding)."""
 
     identity = "NoOp"
+    # Mesh-chain fusion: identity is trivially safe per-shard, so NoOp
+    # plan padding must not break the prelude-capable producer walk
+    # (q5's source -> project -> NoOp leg). It does no device work, so
+    # un-hollowed NoOps never count a host round trip either.
+    mesh_hollow = False
+    mesh_chain_hop = None
+
+    def mesh_prelude_fn(self):
+        return lambda chunk: chunk
 
     def map_chunk(self, chunk: StreamChunk) -> StreamChunk:
         return chunk

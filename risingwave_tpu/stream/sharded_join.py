@@ -123,10 +123,15 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
         # vnode, then the local sorted state probes/updates exactly the
         # owned rows. `dropped` (arg 3) accumulates shuffle overflow per
         # shard for the barrier watchdog's fail-stop.
-        def make_apply_fused(side, mf):
+        def make_apply_fused(side, mf, use_preludes):
             def apply_fused(own, other, errs, dropped, sendocc, chunk,
                             wm):
-                for fn in self._mesh_preludes.get(side, ()):
+                # preludes transform RAW source chunks; recovery's state
+                # replay feeds rows already in join-input schema, so its
+                # trace (use_preludes=False) must skip them
+                pres = (self._mesh_preludes.get(side, ())
+                        if use_preludes else ())
+                for fn in pres:
                     chunk = fn(chunk)
                 cap = self._trace_cap(chunk.capacity)
                 local, n_drop, fill = mesh_ingest_chunk(
@@ -160,17 +165,24 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
             mf = match_factor or self.match_factors[side]
             fused = (self.mesh_shuffle
                      and chunk.capacity % self.n_shards == 0)
+            # state replay (recover) feeds join-schema rows, not raw
+            # source chunks: skip chain preludes AND the ingest log
+            use_pre = not getattr(self, "_state_replay", False)
             # programs also key by the adaptive cap hint active at trace
             # time (None = zero-drop sizing)
-            key = (side, mf, fused, self._cap_hint if fused else None)
+            key = (side, mf, fused, self._cap_hint if fused else None,
+                   use_pre)
             if key not in applies:
-                applies[key] = (make_apply_fused(side, mf) if fused
-                                else make_apply(side, mf))
+                applies[key] = (make_apply_fused(side, mf, use_pre)
+                                if fused else make_apply(side, mf))
             if fused:
                 # replay point: retain the ingest by reference before
                 # the fused program consumes it (sharded_agg.py
-                # MeshIngestLog — the mesh-plane uncommitted suffix)
-                self.ingest_log.note((side, chunk))
+                # MeshIngestLog — the mesh-plane uncommitted suffix).
+                # State-replay chunks are NOT raw ingest and must not
+                # be re-notable.
+                if use_pre:
+                    self.ingest_log.note((side, chunk))
                 (own2, odeg, cols, ops, vis, errs2, self._dropped_dev,
                  self._send_occ_dev, n) = applies[key](
                     own, other, errs, self._dropped_dev,
@@ -179,10 +191,10 @@ class ShardedSortedJoinExecutor(SortedJoinExecutor):
                 return own2, odeg, cols, ops, vis, errs2, n
             # per-chunk host-plane fallback: hollowed producer stages (if
             # any) run here eagerly; the crossing counts against the chain
-            if self._mesh_preludes.get(side):
+            if use_pre and self._mesh_preludes.get(side):
                 for fn in self._mesh_preludes[side]:
                     chunk = fn(chunk)
-            if self.mesh_chain is not None:
+            if use_pre and self.mesh_chain is not None:
                 from .monitor import mesh_host_round_trip
                 mesh_host_round_trip(self.mesh_chain)
             return applies[key](own, other, errs, chunk, wm)

@@ -770,25 +770,32 @@ class SortedJoinExecutor(Executor):
         # other side; overflow here would silently corrupt degrees, and
         # the barrier watchdog fail-stops on the counter if it ever trips
         mf = max(self.match_factor, 64)
-        for s in (RIGHT, LEFT):
-            rows = rows_by_side[s]
-            sch = self.inputs[s].schema
-            for i in range(0, len(rows), batch):
-                part = rows[i:i + batch]
-                arrays = [np.asarray([r[k] for r in part],
-                                     dtype=f.data_type.np_dtype)
-                          for k, f in enumerate(sch)]
-                cap = 1 << max(1, (len(part) - 1).bit_length())
-                out = self._apply(
-                    self.sides[s], self.sides[1 - s], self._errs_dev,
-                    StreamChunk.from_numpy(sch, arrays, capacity=cap),
-                    jnp.int64(NO_WATERMARK), side=s, match_factor=mf)
-                self.sides[s] = out[0]
-                o = self.sides[1 - s]
-                self.sides[1 - s] = SortedSideState(
-                    o.khash, o.cols, o.valids, out[1], o.n)
-                self._errs_dev = out[5]
-                self._n_dev[s] = out[6]
+        # flag read by the sharded dispatch: replay rows are already in
+        # join-input schema, so chain preludes (raw-chunk transforms)
+        # and the mesh ingest log must not see them
+        self._state_replay = True
+        try:
+            for s in (RIGHT, LEFT):
+                rows = rows_by_side[s]
+                sch = self.inputs[s].schema
+                for i in range(0, len(rows), batch):
+                    part = rows[i:i + batch]
+                    arrays = [np.asarray([r[k] for r in part],
+                                         dtype=f.data_type.np_dtype)
+                              for k, f in enumerate(sch)]
+                    cap = 1 << max(1, (len(part) - 1).bit_length())
+                    out = self._apply(
+                        self.sides[s], self.sides[1 - s], self._errs_dev,
+                        StreamChunk.from_numpy(sch, arrays, capacity=cap),
+                        jnp.int64(NO_WATERMARK), side=s, match_factor=mf)
+                    self.sides[s] = out[0]
+                    o = self.sides[1 - s]
+                    self.sides[1 - s] = SortedSideState(
+                        o.khash, o.cols, o.valids, out[1], o.n)
+                    self._errs_dev = out[5]
+                    self._n_dev[s] = out[6]
+        finally:
+            self._state_replay = False
         self._snap = [self.sides[LEFT], self.sides[RIGHT]]
 
     # ------------------------------------------------- HBM memory manager

@@ -23,6 +23,11 @@ logstore gate use):
                    the fused program re-runs from the committed epoch
                    over the replayed ingest instead of tearing the
                    deployment down
+  mesh_topn_crash  the q5 lowering: the SHARDED TOP-N actor (ORDER BY n
+                   DESC LIMIT k over the retracting agg changelog,
+                   streaming_parallelism_devices=2) crashes ->
+                   scope=MESH; recovery re-plans it sharded and the
+                   rows re-characterize against the upstream recount
   dcn_drop         2-WORKER cluster run: one DCN output leg severed
                    mid-epoch -> scope=WORKER: the dead leg's consumer
                    closure rebuilds in place, the surviving producer
@@ -159,10 +164,10 @@ def _oracle(offset: int) -> Counter:
     return out
 
 
-def _committed_offset(session) -> int:
+def _committed_offset(session, mv: str = "q7w") -> int:
     from risingwave_tpu.state.storage_table import StorageTable
     from risingwave_tpu.stream.source import SourceExecutor
-    dep = session.catalog.mvs["q7w"].deployment
+    dep = session.catalog.mvs[mv].deployment
     for roots in dep.roots.values():
         for root in roots:
             node = root
@@ -556,6 +561,85 @@ def _mesh_actor(session) -> int:
     return dep.mesh_actor_ids[0]
 
 
+async def _run_mesh_topn_crash(tmp: str) -> dict:
+    """scope=MESH for the q5 lowering: crash the SHARDED TOP-N actor
+    (ORDER BY n DESC LIMIT k over a retracting agg changelog, lowered
+    onto the device mesh). Recovery must rebuild only the mesh radius,
+    re-plan the executor SHARDED (durable full-input store + ingest
+    replay), and converge: the top-N rows must characterize exactly
+    against the batch recount of the upstream MV, which itself must
+    match the generator-prefix recount at the committed offset."""
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+    from risingwave_tpu.stream.sharded_top_n import ShardedTopNExecutor
+    k = 5
+    store = HummockStateStore(
+        LocalFsObjectStore(os.path.join(tmp, "mesh_topn_crash")))
+    s = Session(store=store)
+    await s.execute("SET streaming_parallelism_devices = 2")
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=128, rate_limit=512)")
+    await s.execute("CREATE MATERIALIZED VIEW counts AS SELECT auction "
+                    "AS a, count(*) AS n FROM bid GROUP BY auction")
+    await s.execute("CREATE MATERIALIZED VIEW t5 AS SELECT a, n FROM "
+                    f"counts ORDER BY n DESC LIMIT {k}")
+    await s.tick(3)
+    dep = s.catalog.mvs["t5"].deployment
+    assert dep.mesh_actor_ids, "top-N did not deploy on the mesh"
+    victim = dep.mesh_actor_ids[0]
+    await s.execute(
+        f"SET fault_injection = 'actor_crash:actor={victim},at=2'")
+    await s.tick(5, max_recoveries=4)
+    await s.execute("SET fault_injection = ''")
+    await s.tick(2)
+
+    replanned = []
+    for roots in s.catalog.mvs["t5"].deployment.roots.values():
+        for root in roots:
+            node = root
+            while node is not None:
+                if isinstance(node, ShardedTopNExecutor):
+                    replanned.append(node)
+                node = getattr(node, "input", None)
+
+    # characterization: order-key vector vs the batch engine's recount
+    # of the upstream MV (ties at the k-boundary may pick either key),
+    # every (a, n) pair present upstream, and the upstream MV anchored
+    # to the generator prefix at its committed offset
+    got = s.query("SELECT a, n FROM t5 ORDER BY 2 DESC, 1")
+    want = s.query(f"SELECT a, n FROM counts ORDER BY 2 DESC, 1 LIMIT {k}")
+    base = dict(s.query("SELECT a, n FROM counts"))
+    import numpy as np
+    from risingwave_tpu.connectors import NexmarkGenerator
+    offset = _committed_offset(s, mv="counts")
+    gen = NexmarkGenerator("bid", chunk_size=max(256, offset))
+    auction = np.asarray(gen.next_chunk().columns[0].data)[:offset]
+    recount = Counter(auction.tolist())
+    converged = (
+        [n for _, n in got] == [n for _, n in want]
+        and len(got) == min(k, len(base))
+        and all(base.get(a) == n for a, n in got)
+        and base == {int(a): int(n) for a, n in recount.items()})
+    total_actors = sorted(
+        a.actor_id
+        for f in list(s.catalog.mvs.values()) + list(s.catalog.sinks.values())
+        for a in f.deployment.actors)
+    out = {
+        "fault": "mesh_topn_crash",
+        "converged": converged,
+        "offset": offset,
+        "mv_rows": len(got),
+        "recoveries": s.recoveries,
+        "last_recovery": s.last_recovery,
+        "total_actors": total_actors,
+        "replanned_sharded": bool(replanned)
+        and all(t.mesh_shuffle for t in replanned),
+    }
+    await s.drop_all()
+    return out
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -688,6 +772,7 @@ async def main() -> int:
         "mesh_crash", tmp,
         lambda s: f"actor_crash:actor={_mesh_actor(s)},at=2",
         pre_ddl=("SET streaming_parallelism_devices = 2",)))
+    mesh_topn = await _run_mesh_topn_crash(tmp)
     results.append(await _run_fault(
         "upload_fail", tmp, lambda s: "upload_fail:at=1"))
     results.append(await _run_fault(
@@ -701,7 +786,7 @@ async def main() -> int:
     results.append(await _run_fault(
         "upload_delay", tmp, lambda s: "upload_delay:at=1,ms=400"))
     dcn = await _run_cluster_dcn(tmp)
-    results_cluster = [dcn]
+    results_cluster = [dcn, mesh_topn]
     broker_results = await _run_broker_faults(tmp)
     storage_results, storage_verdict = await _run_storage_faults(tmp)
     for r in (results + results_cluster + broker_results
@@ -711,7 +796,7 @@ async def main() -> int:
     by_name = {r["fault"]: r for r in results}
     frag_runs = [by_name["mv_actor_crash"], by_name["poison_chunk"]]
     cone_runs = [by_name["interior_crash"]]
-    mesh_runs = [by_name["mesh_crash"]]
+    mesh_runs = [by_name["mesh_crash"], mesh_topn]
     full_runs = [by_name["upload_fail"], by_name["kill_during_recovery"]]
     contained = frag_runs + cone_runs + mesh_runs + [dcn]
 
@@ -771,7 +856,10 @@ async def main() -> int:
         "healthz_last_recovery": all(
             r["healthz_last_recovery"] is not None
             and "scope" in r["healthz_last_recovery"]
-            for r in frag_runs + cone_runs + mesh_runs + full_runs),
+            for r in frag_runs + cone_runs + [by_name["mesh_crash"]]
+            + full_runs),
+        # the q5 lowering's crash run must come back SHARDED
+        "mesh_topn_replanned_sharded": mesh_topn["replanned_sharded"],
         # external ingress/egress faults take the fail-stop -> recovery
         # path (never a hang) and converge exactly-once
         "broker_faults_converged": all(

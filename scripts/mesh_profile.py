@@ -38,6 +38,12 @@ Exit status is 0 iff ALL hold:
     and the unfused plane pays >= 2x the fused plane's per-interval
     host transfers (>= 2 per interval vs 0 — the two hollowed producer
     stages' worth)
+  * the q5-shaped top-N (ORDER BY n DESC LIMIT 10 over the retracting
+    agg changelog) mesh-lowers: exactly ONE fused top-N dispatch per
+    barrier interval, >= 3x fewer dispatches/interval than the
+    single-device plan (topn_host: 8 actors, one chip), zero shuffle
+    drops, and both planes match the characterization oracle at their
+    exact offsets
 
     JAX_PLATFORMS=cpu python scripts/mesh_profile.py
 """
@@ -94,6 +100,38 @@ def _oracle(n: int) -> list:
         m, cnt = agg.get(k, (0, 0))
         agg[k] = (max(m, int(p)), cnt + 1)
     return sorted((a, w, m, cnt) for (a, w), (m, cnt) in agg.items())
+
+
+TOPN_K = 10
+# q5-shaped top-N: ORDER BY n DESC LIMIT k over a retracting agg
+# changelog. Small source chunks mean many chunks per interval: the
+# single-device plane pays per-chunk dispatches the fused mesh plane
+# collapses into scan-batched programs per interval.
+TOPN_AGG_SQL = ("SELECT auction AS a, count(*) AS n FROM bid "
+                "GROUP BY auction")
+TOPN_SQL = f"SELECT a, n FROM counts ORDER BY n DESC LIMIT {TOPN_K}"
+
+
+def _topn_check(rows, offset: int) -> bool:
+    """Characterization oracle for the q5 top-N at an exact offset:
+    every materialized (a, n) matches the host recount, the order-key
+    multiset equals the recount's top-k (ties at the boundary may pick
+    either key — all executors share the same hash tie-break, so any
+    one run is bit-identical to a single-device run over the same
+    chunks), and the row count is exactly min(k, groups)."""
+    import numpy as np
+    from risingwave_tpu.connectors import NexmarkGenerator
+    gen = NexmarkGenerator("bid", chunk_size=max(256, offset))
+    c = gen.next_chunk()
+    auction = np.asarray(c.columns[0].data)[:offset]
+    cnt: dict = {}
+    for a in auction:
+        cnt[int(a)] = cnt.get(int(a), 0) + 1
+    want_ns = sorted(cnt.values(), reverse=True)[:TOPN_K]
+    got_ns = sorted((int(n) for _, n in rows), reverse=True)
+    return (got_ns == want_ns
+            and all(cnt.get(int(a)) == int(n) for a, n in rows)
+            and len(rows) == min(TOPN_K, len(cnt)))
 
 
 def _dispatches() -> int:
@@ -188,6 +226,75 @@ async def _run(mode: str) -> dict:
     return out
 
 
+def _sharded_topns(session):
+    from risingwave_tpu.stream.sharded_top_n import ShardedTopNExecutor
+    out = []
+    for mv in session.catalog.mvs.values():
+        for roots in mv.deployment.roots.values():
+            for root in roots:
+                node = root
+                while node is not None:
+                    if isinstance(node, ShardedTopNExecutor):
+                        out.append(node)
+                    node = getattr(node, "input", None)
+    return out
+
+
+async def _run_topn(mode: str) -> dict:
+    """q5-shaped top-N over the retracting agg changelog: `topn_host`
+    deploys the single-DEVICE plan (8 host actors, every dispatch lands
+    on one chip — the same baseline the q7 gate uses), `topn_mesh` the
+    fused mesh fragments over 8 devices."""
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.stream.message import PauseMutation
+    from risingwave_tpu.utils.metrics import MESH_SHUFFLE_DROPPED
+    s = Session()
+    await s.execute("SET streaming_durability = 0")
+    if mode == "topn_mesh":
+        await s.execute(f"SET streaming_parallelism_devices = {N_DEVICES}")
+    else:
+        await s.execute(f"SET streaming_parallelism = {N_DEVICES}")
+    # the top-N store retains the FULL agg changelog input (retraction
+    # support), i.e. one row per distinct auction — size it above the
+    # distinct-key count at the offsets this run reaches
+    await s.execute("SET streaming_top_n_capacity = 65536")
+    # small chunks: the generator is throughput-bound, so chunk_size
+    # sets the per-interval CHUNK count — the axis the fused plane
+    # collapses (scan-batched ingest) and the single-device plane pays
+    # per chunk
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=64, rate_limit=4096)")
+    await s.execute(f"CREATE MATERIALIZED VIEW counts AS {TOPN_AGG_SQL}")
+    await s.execute(f"CREATE MATERIALIZED VIEW t10 AS {TOPN_SQL}")
+    tops = _sharded_topns(s)
+    await s.tick(WARMUP_ROUNDS)
+    drop0 = MESH_SHUFFLE_DROPPED.value
+    d0 = _dispatches()
+    a0 = sum(t.mesh_shuffle_applies for t in tops)
+    await s.tick(MEASURE_ROUNDS)
+    d1 = _dispatches()
+    a1 = sum(t.mesh_shuffle_applies for t in tops)
+    b = await s.coord.inject_barrier(mutation=PauseMutation())
+    await s.coord.wait_collected(b)
+    rows = s.query("SELECT a, n FROM t10")
+    offset = max(g.connector.offset for g in _sources(s))
+    out = {
+        "mode": mode,
+        "actors": len(s.coord.actor_ids),
+        "dispatches_per_interval": round((d1 - d0) / MEASURE_ROUNDS, 2),
+        "topn_fused_dispatches_per_interval": round(
+            (a1 - a0) / MEASURE_ROUNDS, 2),
+        "rows": len(rows),
+        "offset": offset,
+        "matches_oracle": _topn_check(rows, offset),
+        "sharded_topns": len(tops),
+        "shuffle_dropped": int(MESH_SHUFFLE_DROPPED.value - drop0),
+    }
+    await s.drop_all()
+    return out
+
+
 async def main() -> int:
     host = await _run("host")
     unfused = await _run("mesh_unfused")
@@ -198,6 +305,10 @@ async def main() -> int:
     # denominator clamp)
     hop_reduction = (unfused["host_hops_per_interval"]
                      / max(mesh["host_hops_per_interval"], 1.0))
+    t_host = await _run_topn("topn_host")
+    t_mesh = await _run_topn("topn_mesh")
+    topn_reduction = (t_host["dispatches_per_interval"]
+                      / max(t_mesh["dispatches_per_interval"], 1e-9))
     verdict = {
         "results_identical_to_oracle": (host["matches_oracle"]
                                         and unfused["matches_oracle"]
@@ -218,10 +329,19 @@ async def main() -> int:
                     for i in unfused["mesh_chains"].values())),
         "zero_host_hops_fused": mesh["host_hops_per_interval"] == 0,
         "host_hop_reduction": round(hop_reduction, 2),
+        "topn_matches_oracle": (t_host["matches_oracle"]
+                                and t_mesh["matches_oracle"]),
+        "topn_dispatch_reduction": round(topn_reduction, 2),
+        "topn_one_fused_dispatch_per_interval": (
+            t_mesh["sharded_topns"] == 1
+            and t_mesh["topn_fused_dispatches_per_interval"] == 1.0),
+        "topn_zero_shuffle_drops": t_mesh["shuffle_dropped"] == 0,
     }
     print(json.dumps(host))
     print(json.dumps(unfused))
     print(json.dumps(mesh))
+    print(json.dumps(t_host))
+    print(json.dumps(t_mesh))
     print(json.dumps({"verdict": verdict}))
     ok = (verdict["results_identical_to_oracle"]
           and mesh["dispatches_per_interval"]
@@ -233,7 +353,13 @@ async def main() -> int:
           and verdict["zero_host_hops_fused"]
           and hop_reduction >= 2.0
           and mesh["rows"] > 0 and host["offset"] > 0
-          and unfused["offset"] > 0 and mesh["offset"] > 0)
+          and unfused["offset"] > 0 and mesh["offset"] > 0
+          and verdict["topn_matches_oracle"]
+          and topn_reduction >= 3.0
+          and verdict["topn_one_fused_dispatch_per_interval"]
+          and verdict["topn_zero_shuffle_drops"]
+          and t_mesh["rows"] > 0 and t_host["offset"] > 0
+          and t_mesh["offset"] > 0)
     return 0 if ok else 1
 
 

@@ -1,0 +1,763 @@
+"""FROZEN pre-refactor expression evaluator — differential-test baseline.
+
+Byte-for-byte snapshot of expr/functions.py + expr/strings.py as of the
+commit BEFORE the declarative kernel-registry refactor, with imports made
+absolute and the two modules concatenated so the snapshot is self-contained
+(its own _REGISTRY). tests/test_kernel_registry.py sweeps every registered
+kernel in the live registry against this module on identical chunks and
+requires bit-exact agreement (data, validity, and inferred return type).
+
+DO NOT EDIT except to regenerate against a known-good evaluator.
+"""
+
+from __future__ import annotations
+
+import re  # noqa: E402  (strings kernels)
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+
+from risingwave_tpu.common.chunk import Column
+from risingwave_tpu.common.types import DataType
+
+_REGISTRY: dict[str, Callable] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def lookup(name: str) -> Callable:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise NotImplementedError(f"scalar function {name!r} not registered") from None
+
+
+def registered_functions() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------- helpers
+
+def _and_valid(cols: Sequence[Column]):
+    valid = None
+    for c in cols:
+        if c.valid is not None:
+            valid = c.valid if valid is None else (valid & c.valid)
+    return valid
+
+
+def strict(fn):
+    """Lift a data-only kernel to null-propagating (strict) semantics."""
+    def wrapped(node, cols: Sequence[Column]) -> Column:
+        data = fn(node, *[c.data for c in cols])
+        return Column(data, _and_valid(cols))
+    return wrapped
+
+
+def _cast_to(data, dtype: DataType):
+    return data.astype(dtype.jnp_dtype)
+
+
+# ------------------------------------------------------------- arithmetic
+
+@register("add")
+@strict
+def _add(node, a, b):
+    return (a + b).astype(node.ret_type.jnp_dtype)
+
+
+@register("subtract")
+@strict
+def _sub(node, a, b):
+    return (a - b).astype(node.ret_type.jnp_dtype)
+
+
+@register("multiply")
+@strict
+def _mul(node, a, b):
+    return (a * b).astype(node.ret_type.jnp_dtype)
+
+
+@register("divide")
+def _div(node, cols):
+    a, b = cols[0].data, cols[1].data
+    valid = _and_valid(cols)
+    if node.ret_type.is_float:
+        zero = b == 0
+        out = jnp.where(zero, 0.0, a / jnp.where(zero, 1, b)).astype(node.ret_type.jnp_dtype)
+    else:
+        zero = b == 0
+        out = jnp.where(zero, 0, a // jnp.where(zero, 1, b)).astype(node.ret_type.jnp_dtype)
+    # division by zero -> NULL (non-strict error handling: per-row error => NULL,
+    # reference NonStrictExpression, expr/mod.rs:182)
+    valid = (~zero) if valid is None else (valid & ~zero)
+    return Column(out, valid)
+
+
+@register("modulus")
+def _mod(node, cols):
+    a, b = cols[0].data, cols[1].data
+    valid = _and_valid(cols)
+    zero = b == 0
+    out = jnp.where(zero, 0, a % jnp.where(zero, 1, b)).astype(node.ret_type.jnp_dtype)
+    valid = (~zero) if valid is None else (valid & ~zero)
+    return Column(out, valid)
+
+
+@register("neg")
+@strict
+def _neg(node, a):
+    return -a
+
+
+@register("abs")
+@strict
+def _abs(node, a):
+    return jnp.abs(a)
+
+
+# ------------------------------------------------------------- comparison
+
+def _cmp(op):
+    @strict
+    def fn(node, a, b):
+        return op(a, b)
+    return fn
+
+register("equal")(_cmp(lambda a, b: a == b))
+register("not_equal")(_cmp(lambda a, b: a != b))
+register("less_than")(_cmp(lambda a, b: a < b))
+register("less_than_or_equal")(_cmp(lambda a, b: a <= b))
+register("greater_than")(_cmp(lambda a, b: a > b))
+register("greater_than_or_equal")(_cmp(lambda a, b: a >= b))
+
+
+@register("greatest")
+@strict
+def _greatest(node, *args):
+    out = args[0]
+    for a in args[1:]:
+        out = jnp.maximum(out, a)
+    return out
+
+
+@register("least")
+@strict
+def _least(node, *args):
+    out = args[0]
+    for a in args[1:]:
+        out = jnp.minimum(out, a)
+    return out
+
+
+# ---------------------------------------------------------------- boolean
+# Kleene three-valued logic (reference: impl/src/scalar/conjunction.rs)
+
+@register("and")
+def _and(node, cols):
+    a, b = cols
+    av, bv = a.valid_mask(), b.valid_mask()
+    data = a.data & b.data
+    # NULL unless: any FALSE operand (result FALSE) or both valid
+    false_a = av & ~a.data
+    false_b = bv & ~b.data
+    valid = false_a | false_b | (av & bv)
+    if a.valid is None and b.valid is None:
+        valid = None
+    return Column(data, valid)
+
+
+@register("or")
+def _or(node, cols):
+    a, b = cols
+    av, bv = a.valid_mask(), b.valid_mask()
+    data = a.data | b.data
+    true_a = av & a.data
+    true_b = bv & b.data
+    valid = true_a | true_b | (av & bv)
+    if a.valid is None and b.valid is None:
+        valid = None
+    return Column(data, valid)
+
+
+@register("not")
+@strict
+def _not(node, a):
+    return ~a
+
+
+@register("is_null")
+def _is_null(node, cols):
+    (a,) = cols
+    return Column(~a.valid_mask(), None)
+
+
+@register("is_not_null")
+def _is_not_null(node, cols):
+    (a,) = cols
+    return Column(a.valid_mask(), None)
+
+
+# ------------------------------------------------------------ conditional
+
+@register("case")
+def _case(node, cols):
+    """case(cond1, val1, cond2, val2, ..., [else]) — first-match wins."""
+    n = len(cols)
+    has_else = n % 2 == 1
+    pairs = (n - 1) // 2 if has_else else n // 2
+    if has_else:
+        out, valid = cols[-1].data.astype(node.ret_type.jnp_dtype), cols[-1].valid_mask()
+    else:
+        out = jnp.zeros_like(cols[1].data, dtype=node.ret_type.jnp_dtype)
+        valid = jnp.zeros(cols[1].capacity, dtype=bool)
+    for i in reversed(range(pairs)):
+        cond, val = cols[2 * i], cols[2 * i + 1]
+        hit = cond.valid_mask() & cond.data
+        out = jnp.where(hit, val.data.astype(node.ret_type.jnp_dtype), out)
+        valid = jnp.where(hit, val.valid_mask(), valid)
+    return Column(out, valid)
+
+
+@register("hll_estimate")
+def _hll_estimate(node, cols):
+    from risingwave_tpu.expr.hll import estimate_from_words_jnp
+    out = estimate_from_words_jnp([c.data for c in cols])
+    valid = cols[0].valid_mask()
+    for c in cols[1:]:
+        valid = valid & c.valid_mask()
+    return Column(out, valid)
+
+
+@register("coalesce")
+def _coalesce(node, cols):
+    out = cols[-1].data.astype(node.ret_type.jnp_dtype)
+    valid = cols[-1].valid_mask()
+    for c in reversed(cols[:-1]):
+        cv = c.valid_mask()
+        out = jnp.where(cv, c.data.astype(node.ret_type.jnp_dtype), out)
+        valid = cv | valid
+    return Column(out, valid)
+
+
+# ------------------------------------------------------------------- cast
+
+@register("cast")
+def _cast(node, cols):
+    (a,) = cols
+    src = a.data
+    dst = node.ret_type
+    if dst is DataType.BOOLEAN:
+        out = src != 0
+    else:
+        out = src.astype(dst.jnp_dtype)
+    return Column(out, a.valid)
+
+
+# --------------------------------------------------------------- datetime
+# Timestamps are int64 microseconds; intervals are int64 microseconds.
+
+@register("tumble_start")
+@strict
+def _tumble_start(node, ts, interval):
+    return ts - ts % interval
+
+
+@register("tumble_end")
+@strict
+def _tumble_end(node, ts, interval):
+    return ts - ts % interval + interval
+
+
+@register("extract_epoch")
+@strict
+def _extract_epoch(node, ts):
+    return ts // 1_000_000
+
+
+# ---------------------------------------------------------- type inference
+
+_CMP_FNS = {
+    "equal", "not_equal", "less_than", "less_than_or_equal",
+    "greater_than", "greater_than_or_equal",
+}
+_BOOL_FNS = {"and", "or", "not", "is_null", "is_not_null"}
+_NUMERIC_ORDER = [
+    DataType.BOOLEAN, DataType.INT16, DataType.INT32, DataType.INT64,
+    DataType.DECIMAL, DataType.FLOAT32, DataType.FLOAT64,
+]
+
+
+def _promote(types) -> DataType:
+    best = DataType.INT16
+    for t in types:
+        if t in (DataType.TIMESTAMP, DataType.TIMESTAMPTZ, DataType.DATE,
+                 DataType.TIME, DataType.INTERVAL):
+            return t
+        if t not in _NUMERIC_ORDER:
+            return t
+        if _NUMERIC_ORDER.index(t) > _NUMERIC_ORDER.index(best):
+            best = t
+    return best
+
+
+_FLOAT_FNS = {"sqrt", "cbrt", "exp", "ln", "log10", "sin", "cos", "tan",
+              "atan", "pow"}
+_EXTRACT_FNS = {"extract_epoch", "extract_year", "extract_month",
+                "extract_day", "extract_hour", "extract_minute",
+                "extract_second", "extract_dow"}
+
+
+def infer_ret_type(name: str, args) -> DataType:
+    pass  # STRING_FNS / STRING_PREDS defined below (concatenated)
+    if name in STRING_PREDS:
+        return DataType.BOOLEAN
+    if name in STRING_FNS:
+        return DataType.VARCHAR
+    if name in ("length", "char_length", "ascii"):
+        return DataType.INT64
+    if name in _CMP_FNS or name in _BOOL_FNS:
+        return DataType.BOOLEAN
+    if name in ("is_null", "is_not_null"):
+        return DataType.BOOLEAN
+    if name == "hll_estimate":
+        return DataType.INT64
+    if name == "case":
+        n = len(args)
+        vals = [args[2 * i + 1] for i in range(n // 2)]
+        if n % 2 == 1:
+            vals.append(args[-1])
+        ts = [a.ret_type for a in vals]
+        if all(t == ts[0] for t in ts):
+            return ts[0]     # _promote would degrade BOOLEAN to INT16
+        return _promote(ts)
+    if name in ("tumble_start", "tumble_end") or name.startswith("date_trunc_"):
+        return DataType.TIMESTAMP
+    if name in _EXTRACT_FNS:
+        return DataType.INT64
+    if name in _FLOAT_FNS:
+        return DataType.FLOAT64
+    if name == "divide":
+        t = _promote([a.ret_type for a in args])
+        return t
+    return _promote([a.ret_type for a in args])
+
+
+# ------------------------------------------------- numeric breadth
+# (reference impl/src/scalar/{arithmetic_op,round,exp,pow,trigonometric}.rs)
+
+@register("floor")
+@strict
+def _floor(node, a):
+    return jnp.floor(a).astype(node.ret_type.jnp_dtype)
+
+
+@register("ceil")
+@strict
+def _ceil(node, a):
+    return jnp.ceil(a).astype(node.ret_type.jnp_dtype)
+
+
+@register("round")
+@strict
+def _round(node, a):
+    # PG/reference round halves AWAY from zero (round.rs); jnp.round is
+    # banker's half-to-even. Integers round to themselves (a float64
+    # round-trip would corrupt values above 2^53).
+    if jnp.issubdtype(a.dtype, jnp.integer):
+        return a.astype(node.ret_type.jnp_dtype)
+    return jnp.trunc(a + jnp.where(a >= 0, 0.5, -0.5)).astype(
+        node.ret_type.jnp_dtype)
+
+
+@register("trunc")
+@strict
+def _trunc(node, a):
+    return jnp.trunc(a).astype(node.ret_type.jnp_dtype)
+
+
+@register("sign")
+@strict
+def _sign(node, a):
+    return jnp.sign(a).astype(node.ret_type.jnp_dtype)
+
+
+@register("pow")
+@strict
+def _pow(node, a, b):
+    return jnp.power(a.astype(jnp.float64), b).astype(node.ret_type.jnp_dtype)
+
+
+@register("sqrt")
+@strict
+def _sqrt(node, a):
+    return jnp.sqrt(a.astype(jnp.float64))
+
+
+@register("cbrt")
+@strict
+def _cbrt(node, a):
+    return jnp.cbrt(a.astype(jnp.float64))
+
+
+@register("exp")
+@strict
+def _exp(node, a):
+    return jnp.exp(a.astype(jnp.float64))
+
+
+@register("ln")
+@strict
+def _ln(node, a):
+    return jnp.log(a.astype(jnp.float64))
+
+
+@register("log10")
+@strict
+def _log10(node, a):
+    return jnp.log10(a.astype(jnp.float64))
+
+
+@register("sin")
+@strict
+def _sin(node, a):
+    return jnp.sin(a.astype(jnp.float64))
+
+
+@register("cos")
+@strict
+def _cos(node, a):
+    return jnp.cos(a.astype(jnp.float64))
+
+
+@register("tan")
+@strict
+def _tan(node, a):
+    return jnp.tan(a.astype(jnp.float64))
+
+
+@register("atan")
+@strict
+def _atan(node, a):
+    return jnp.arctan(a.astype(jnp.float64))
+
+
+@register("bitwise_and")
+@strict
+def _bit_and(node, a, b):
+    return a & b
+
+
+@register("bitwise_or")
+@strict
+def _bit_or(node, a, b):
+    return a | b
+
+
+@register("bitwise_xor")
+@strict
+def _bit_xor(node, a, b):
+    return a ^ b
+
+
+@register("bitwise_not")
+@strict
+def _bit_not(node, a):
+    return jnp.invert(a)
+
+
+@register("bitwise_shift_left")
+@strict
+def _shl(node, a, b):
+    return jnp.left_shift(a, b)
+
+
+@register("bitwise_shift_right")
+@strict
+def _shr(node, a, b):
+    return jnp.right_shift(a, b)
+
+
+# ------------------------------------------------- datetime breadth
+# Timestamps are int64 microseconds since the unix epoch (common/types.py);
+# calendar fields use the branchless civil-from-days algorithm (Howard
+# Hinnant's date algorithms — pure integer arithmetic, vectorizes on TPU).
+# Reference: impl/src/scalar/{extract,date_trunc,tumble}.rs.
+
+_US_PER_DAY = 86_400_000_000
+
+
+def _civil_from_days(z):
+    """days since epoch -> (year, month, day), vectorized int math."""
+    z = z + 719_468
+    # floor_divide already floors toward -inf; Hinnant's (z - 146096)
+    # adjustment is only for TRUNCATING division and would double-correct
+    era = jnp.floor_divide(z, 146_097)
+    doe = z - era * 146_097
+    yoe = jnp.floor_divide(
+        doe - jnp.floor_divide(doe, 1460) + jnp.floor_divide(doe, 36_524)
+        - jnp.floor_divide(doe, 146_096), 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + jnp.floor_divide(yoe, 4)
+                 - jnp.floor_divide(yoe, 100))
+    mp = jnp.floor_divide(5 * doy + 2, 153)
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def _days_and_us(ts):
+    days = jnp.floor_divide(ts, _US_PER_DAY)
+    return days, ts - days * _US_PER_DAY
+
+
+@register("extract_year")
+@strict
+def _extract_year(node, ts):
+    y, _, _ = _civil_from_days(_days_and_us(ts)[0])
+    return y.astype(jnp.int64)
+
+
+@register("extract_month")
+@strict
+def _extract_month(node, ts):
+    _, m, _ = _civil_from_days(_days_and_us(ts)[0])
+    return m.astype(jnp.int64)
+
+
+@register("extract_day")
+@strict
+def _extract_day(node, ts):
+    _, _, d = _civil_from_days(_days_and_us(ts)[0])
+    return d.astype(jnp.int64)
+
+
+@register("extract_hour")
+@strict
+def _extract_hour(node, ts):
+    return jnp.floor_divide(_days_and_us(ts)[1],
+                            3_600_000_000).astype(jnp.int64)
+
+
+@register("extract_minute")
+@strict
+def _extract_minute(node, ts):
+    return jnp.mod(jnp.floor_divide(_days_and_us(ts)[1], 60_000_000),
+                   60).astype(jnp.int64)
+
+
+@register("extract_second")
+@strict
+def _extract_second(node, ts):
+    return jnp.mod(jnp.floor_divide(_days_and_us(ts)[1], 1_000_000),
+                   60).astype(jnp.int64)
+
+
+@register("extract_dow")
+@strict
+def _extract_dow(node, ts):
+    # 1970-01-01 was a Thursday (dow 4, Sunday = 0)
+    days = _days_and_us(ts)[0]
+    return jnp.mod(days + 4, 7).astype(jnp.int64)
+
+
+_TRUNC_US = {
+    "second": 1_000_000,
+    "minute": 60_000_000,
+    "hour": 3_600_000_000,
+    "day": _US_PER_DAY,
+    "week": 7 * _US_PER_DAY,
+}
+
+
+@register("date_trunc_second")
+@register("date_trunc_minute")
+@register("date_trunc_hour")
+@register("date_trunc_day")
+@register("date_trunc_week")
+def _date_trunc(node, cols):
+    unit = node.name.rsplit("_", 1)[1]
+    us = _TRUNC_US[unit]
+    ts = cols[0]
+    off = 3 * _US_PER_DAY if unit == "week" else 0  # weeks start Monday
+    data = (jnp.floor_divide(ts.data + off, us)) * us - off
+    return Column(data.astype(node.ret_type.jnp_dtype), ts.valid)
+
+
+# ======================================================================
+# strings.py snapshot
+# ======================================================================
+
+
+
+
+import numpy as np
+
+from risingwave_tpu.common.types import GLOBAL_DICT
+
+# (key, dict_len) -> device mapping array
+_MAP_CACHE: dict = {}
+
+
+def _mapping(key, fn, np_dtype):
+    d = GLOBAL_DICT
+    snapshot = list(d._strings)          # fn may insert (string results)
+    n = len(snapshot)
+    cached = _MAP_CACHE.get(key)
+    if cached is not None and cached[0] == n:
+        return cached[1]
+    vals = np.asarray([fn(s) for s in snapshot], dtype=np_dtype)
+    if n == 0:
+        vals = np.zeros(1, dtype=np_dtype)
+    # cache NUMPY, never device values: _mapping may run inside a jit
+    # trace, and a cached traced constant would escape its trace
+    _MAP_CACHE[key] = (n, vals)
+    return vals
+
+
+def _gather(arr, ids):
+    arr = jnp.asarray(arr)
+    return arr[jnp.clip(ids, 0, arr.shape[0] - 1)]
+
+
+def _str_to_str(name, py_fn):
+    @register(name)
+    @strict
+    def _impl(node, ids, _name=name, _fn=py_fn):
+        m = _mapping(("s2s", _name),
+                     lambda s: GLOBAL_DICT.get_or_insert(_fn(s)),
+                     np.int32)
+        return _gather(m, ids)
+    return _impl
+
+
+_str_to_str("lower", str.lower)
+_str_to_str("upper", str.upper)
+_str_to_str("trim", str.strip)
+_str_to_str("ltrim", str.lstrip)
+_str_to_str("rtrim", str.rstrip)
+_str_to_str("reverse", lambda s: s[::-1])
+_str_to_str("md5", lambda s: __import__("hashlib").md5(
+    s.encode()).hexdigest())
+
+
+@register("length")
+@register("char_length")
+@strict
+def _length(node, ids):
+    m = _mapping(("len",), len, np.int64)
+    return _gather(m, ids)
+
+
+@register("ascii")
+@strict
+def _ascii(node, ids):
+    m = _mapping(("ascii",), lambda s: ord(s[0]) if s else 0, np.int64)
+    return _gather(m, ids)
+
+
+def _literal_arg(node, pos: int, what: str) -> str:
+    from risingwave_tpu.expr.ir import Literal
+    a = node.args[pos]
+    if not isinstance(a, Literal) or not isinstance(a.value, str):
+        raise NotImplementedError(
+            f"{node.name} needs a string literal {what} (got {a!r})")
+    return a.value
+
+
+def _str_pred(name, build_pred):
+    """String predicate with a LITERAL second argument -> bool mapping."""
+    @register(name)
+    def _impl(node, cols, _name=name, _build=build_pred):
+        pat = _literal_arg(node, 1, "pattern")
+        pred = _build(pat)
+        m = _mapping((_name, pat), lambda s: bool(pred(s)), np.bool_)
+        data = _gather(m, cols[0].data)
+        return Column(data, _and_valid(cols[:1]))
+    return _impl
+
+
+def _like_matcher(pattern: str):
+    rx = re.compile("".join(
+        ".*" if ch == "%" else "." if ch == "_" else re.escape(ch)
+        for ch in pattern) + r"\Z", re.S)
+    return lambda s: rx.match(s) is not None
+
+
+_str_pred("like", _like_matcher)
+_str_pred("starts_with", lambda p: (lambda s: s.startswith(p)))
+_str_pred("ends_with", lambda p: (lambda s: s.endswith(p)))
+_str_pred("contains", lambda p: (lambda s: p in s))
+
+
+@register("substr")
+@strict
+def _substr(node, ids, *_rest):
+    """substr(s, start[, count]) with LITERAL positions (1-based, PG)."""
+    from risingwave_tpu.expr.ir import Literal
+    start = node.args[1]
+    if not isinstance(start, Literal):
+        raise NotImplementedError("substr needs literal positions")
+    s0 = int(start.value)
+    cnt = None
+    if len(node.args) > 2:
+        c = node.args[2]
+        if not isinstance(c, Literal):
+            raise NotImplementedError("substr needs literal positions")
+        cnt = int(c.value)
+
+    def f(s):
+        begin = max(0, s0 - 1)
+        out = s[begin:begin + cnt] if cnt is not None else s[begin:]
+        return GLOBAL_DICT.get_or_insert(out)
+    m = _mapping(("substr", s0, cnt), f, np.int32)
+    return _gather(m, ids)
+
+
+STRING_FNS = ("lower", "upper", "trim", "ltrim", "rtrim", "reverse",
+              "md5", "substr")
+STRING_PREDS = ("like", "starts_with", "ends_with", "contains")
+
+
+def numpy_string_eval(node, ids: np.ndarray) -> np.ndarray:
+    """Serving-path evaluation: the SAME mappings, gathered in numpy."""
+    name = node.name
+    if name in ("length", "char_length"):
+        m = _mapping(("len",), len, np.int64)
+    elif name == "ascii":
+        m = _mapping(("ascii",), lambda s: ord(s[0]) if s else 0, np.int64)
+    elif name in STRING_PREDS:
+        pat = _literal_arg(node, 1, "pattern")
+        builders = {"like": _like_matcher,
+                    "starts_with": lambda p: (lambda s: s.startswith(p)),
+                    "ends_with": lambda p: (lambda s: s.endswith(p)),
+                    "contains": lambda p: (lambda s: p in s)}
+        pred = builders[name](pat)
+        m = _mapping((name, pat), lambda s: bool(pred(s)), np.bool_)
+    elif name == "substr":
+        from risingwave_tpu.expr.ir import Literal
+        s0 = int(node.args[1].value)
+        cnt = int(node.args[2].value) if len(node.args) > 2 else None
+
+        def f(s):
+            begin = max(0, s0 - 1)
+            out = s[begin:begin + cnt] if cnt is not None else s[begin:]
+            return GLOBAL_DICT.get_or_insert(out)
+        m = _mapping(("substr", s0, cnt), f, np.int32)
+    else:
+        fns = {"lower": str.lower, "upper": str.upper, "trim": str.strip,
+               "ltrim": str.lstrip, "rtrim": str.rstrip,
+               "reverse": lambda s: s[::-1],
+               "md5": lambda s: __import__("hashlib").md5(
+                   s.encode()).hexdigest()}
+        m = _mapping(("s2s", name),
+                     lambda s, _f=fns[name]: GLOBAL_DICT.get_or_insert(
+                         _f(s)), np.int32)
+    return m[np.clip(ids, 0, len(m) - 1)]

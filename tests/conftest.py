@@ -21,6 +21,16 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache (utils/compile_cache.py, same as the
+# bench/CI gates): every test builds fresh executors, so identical
+# q7/join/shard_map shapes re-trace in file after file and each pays
+# the same multi-second compile again — the disk cache dedupes those
+# within one suite run (and across runs on the same box). Only the
+# compile is skipped; programs and results are bit-identical.
+from risingwave_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
 import pytest
 
 

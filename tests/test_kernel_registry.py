@@ -1,0 +1,166 @@
+"""Kernel-registry differential test — live registry vs frozen evaluator.
+
+Sweeps EVERY registered kernel against `_frozen_expr_baseline` (a verbatim
+snapshot of expr/functions.py + expr/strings.py from before the declarative
+registry refactor) on identical chunks, and requires bit-exact agreement on
+data, validity, and inferred return type. The registry refactor must be a
+pure re-plumbing: zero behavior change.
+"""
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.common.chunk import Column
+from risingwave_tpu.common.types import GLOBAL_DICT, DataType
+from risingwave_tpu.expr.ir import FuncCall, InputRef, Literal
+from risingwave_tpu.expr.registry import (entries, infer_ret_type, lookup,
+                                          registered_functions)
+
+import _frozen_expr_baseline as frozen
+
+N = 64
+_VOCAB = ["", "a", "ab", "abc", "Abc", "hello world", "  pad  ", "zzz",
+          "b-mid-b", "CASE", "ababab", "x"]
+
+# per-name arity for variadic entries (the sweep needs a concrete call)
+_VARIADIC_ARITY = {"greatest": 3, "least": 3, "case": 5, "coalesce": 3,
+                   "hll_estimate": 4, "substr": 3}
+# per-name literal arguments (position -> Literal)
+_LITERALS = {
+    "like": {1: Literal("%b%", DataType.VARCHAR)},
+    "starts_with": {1: Literal("a", DataType.VARCHAR)},
+    "ends_with": {1: Literal("b", DataType.VARCHAR)},
+    "contains": {1: Literal("b", DataType.VARCHAR)},
+    "substr": {1: Literal(2, DataType.INT64), 2: Literal(3, DataType.INT64)},
+}
+# kernels whose inputs must stay integral even in the float sweep
+_INT_ONLY = {"bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+             "bitwise_shift_left", "bitwise_shift_right", "hll_estimate",
+             "modulus"}
+
+
+def _seed_vocab():
+    for s in _VOCAB:
+        GLOBAL_DICT.get_or_insert(s)
+
+
+def _gen_column(kind, rng, pos, name, float_mode):
+    """Deterministic Column + its InputRef type for one argument slot."""
+    if kind == "bool":
+        data = rng.integers(0, 2, N).astype(bool)
+        dt = DataType.BOOLEAN
+    elif kind == "ts":
+        base = 1_600_000_000_000_000
+        data = base + rng.integers(-2 * 86_400_000_000,
+                                   2 * 86_400_000_000, N)
+        dt = DataType.TIMESTAMP
+    elif kind == "interval":
+        data = np.full(N, 10_000_000, dtype=np.int64)
+        dt = DataType.INTERVAL
+    elif kind == "str":
+        _seed_vocab()
+        ids = np.asarray([GLOBAL_DICT.get_or_insert(s) for s in _VOCAB])
+        data = ids[rng.integers(0, len(ids), N)].astype(np.int32)
+        dt = DataType.VARCHAR
+    else:  # num / any
+        if float_mode and name not in _INT_ONLY:
+            data = rng.normal(0, 100, N)
+            data[:4] = [0.0, -0.5, 0.5, 1.5]   # zeros + tie-rounding cases
+            dt = DataType.FLOAT64
+        else:
+            lo, hi = (0, 8) if name in ("bitwise_shift_left",
+                                        "bitwise_shift_right") and pos == 1 \
+                else (-1000, 1000)
+            data = rng.integers(lo, hi + 1, N)
+            data[:2] = [0, lo]                 # divide/modulus by zero rows
+            dt = DataType.INT64
+    # arg 0 carries a null mask, later args alternate mask/None so both
+    # _and_valid paths (None and array) are exercised
+    valid = None
+    if pos == 0 or pos % 2 == 1:
+        valid = rng.integers(0, 4, N) > 0
+    return Column(np.asarray(data), valid), dt
+
+
+def _build_call(e, rng, float_mode):
+    """-> (FuncCall node, arg Columns) for a registry entry."""
+    kinds = list(e.input_kinds) or ["num"]
+    arity = _VARIADIC_ARITY.get(e.name, len(kinds))
+    if e.name == "case":          # cond, val, cond, val, else
+        kinds = ["bool", "any", "bool", "any", "any"]
+    elif e.variadic:
+        kinds = (kinds + [kinds[-1]] * (arity - len(kinds)))[:arity]
+    lits = _LITERALS.get(e.name, {})
+    args, cols = [], []
+    for i, kind in enumerate(kinds):
+        if i in lits:
+            args.append(lits[i])
+            continue
+        c, dt = _gen_column(kind, rng, len(cols), e.name, float_mode)
+        args.append(InputRef(len(cols), dt))
+        cols.append(c)
+    node = FuncCall(e.name, tuple(args), infer_ret_type(e.name, args))
+    return node, cols
+
+
+def _eval(kernel_fn, node, cols):
+    out = kernel_fn(node, [a.eval(cols) for a in node.args])
+    data = np.asarray(out.data)
+    valid = None if out.valid is None else np.asarray(out.valid)
+    return data, valid
+
+
+def _assert_identical(name, live, base):
+    ld, lv = live
+    bd, bv = base
+    assert ld.dtype == bd.dtype, f"{name}: dtype {ld.dtype} != {bd.dtype}"
+    assert np.array_equal(ld, bd, equal_nan=ld.dtype.kind == "f"), \
+        f"{name}: data diverged"
+    assert (lv is None) == (bv is None), f"{name}: validity shape diverged"
+    if lv is not None:
+        assert np.array_equal(lv, bv), f"{name}: validity diverged"
+
+
+def test_registry_covers_frozen_surface():
+    assert registered_functions() == frozen.registered_functions()
+
+
+@pytest.mark.parametrize("name", frozen.registered_functions())
+def test_kernel_differential(name):
+    from risingwave_tpu.expr.registry import entry
+    e = entry(name)
+    for float_mode in (False, True):
+        rng_l = np.random.default_rng(abs(hash(name)) % (2**32))
+        node, cols = _build_call(e, rng_l, float_mode)
+        live = _eval(lookup(name), node, cols)
+        base = _eval(frozen.lookup(name), node, cols)
+        _assert_identical(f"{name}[float={float_mode}]", live, base)
+        # type rule must match the frozen if-chain inference
+        assert node.ret_type == frozen.infer_ret_type(name, node.args), name
+        if float_mode:
+            break_after = e.input_kinds and all(
+                k not in ("num", "any") for k in e.input_kinds)
+            if break_after:
+                break
+
+
+def test_cast_targets_differential():
+    rng = np.random.default_rng(7)
+    data = rng.integers(-5, 6, N)
+    col = Column(np.asarray(data), rng.integers(0, 3, N) > 0)
+    for dst in (DataType.BOOLEAN, DataType.INT32, DataType.FLOAT64):
+        node = FuncCall("cast", (InputRef(0, DataType.INT64),), dst)
+        _assert_identical(f"cast->{dst}", _eval(lookup("cast"), node, [col]),
+                          _eval(frozen.lookup("cast"), node, [col]))
+
+
+def test_unregistered_function_raises():
+    with pytest.raises(NotImplementedError):
+        lookup("no_such_function")
+
+
+def test_default_type_rule_matches_frozen_promotion():
+    args = (InputRef(0, DataType.INT32), InputRef(1, DataType.FLOAT32))
+    assert infer_ret_type("add", args) == frozen.infer_ret_type("add", args)
+    assert (infer_ret_type("unknown_fn", args)
+            == frozen.infer_ret_type("unknown_fn", args))

@@ -608,3 +608,49 @@ async def test_manual_slack_overrides_adaptive():
         capacity=32, mesh_shuffle_slack=4)
     assert not sh.mesh_shuffle_adaptive
     assert sh.mesh_shuffle_slack == 4
+
+
+# ------------------------------------------- two-input fused join chains
+
+async def test_fused_join_chain_hollows_both_sides_zero_host_hops():
+    """Two-input auto-fusion: the q8-shaped join's per-side producer
+    fragments (TUMBLE projects over each source leg) hollow into
+    per-side preludes of the join's fused shard_map programs — one
+    registered chain per side — and a steady fused interval pays ZERO
+    per-chunk host hops while staying bit-identical to the host recount
+    at the quiesced committed offsets."""
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.stream.monitor import mesh_host_round_trips
+    s = Session()
+    await s.execute("SET streaming_durability = 0")
+    await s.execute("SET streaming_parallelism_devices = 8")
+    await s.execute("SET streaming_join_capacity = 16384")
+    await _mk_join_sources(s)
+    await s.execute(f"CREATE MATERIALIZED VIEW mj AS {JOIN_SQL}")
+    chains = dict(s.coord.mesh_chains)
+    sides = sorted(c for c in chains if c[-2:] in ("s0", "s1"))
+    assert len(sides) == 2, f"expected one chain per join side: {chains}"
+    assert all(chains[c]["hollow"] for c in sides), \
+        "both join sides must hollow by default"
+    joins = [node for roots in
+             s.catalog.mvs["mj"].deployment.roots.values()
+             for root in roots for node in _iter_chain(root)
+             if isinstance(node, ShardedSortedJoinExecutor)]
+    assert len(joins) == 1
+    join = joins[0]
+    assert set(join._mesh_preludes) == {0, 1} \
+        and all(join._mesh_preludes.values()), \
+        "both sides must install prelude stacks"
+    h0 = mesh_host_round_trips()
+    a0 = join.mesh_shuffle_applies
+    await s.tick(3)
+    assert join.mesh_shuffle_applies > a0, "fused join never engaged"
+    assert mesh_host_round_trips() - h0 == 0, \
+        "fused two-input chain must not touch the host per chunk"
+    await _quiesce(s)
+    got = Counter(s.query("SELECT id, window_start FROM mj"))
+    assert got == _join_oracle(s, "mj") and sum(got.values()) > 0
+    await s.drop_all()
+    left = dict(s.coord.mesh_chains)
+    assert not any(c in left for c in sides), \
+        "drop must unregister both side chains"

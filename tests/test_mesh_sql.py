@@ -187,3 +187,132 @@ async def test_mesh_agg_durable_crash_recovery(tmp_path):
         f"{list((exp - got).items())[:3]}")
     assert off > 0
     await s.drop_all()
+
+
+# ----------------------------------------- mesh top-N / over-window
+
+def _iter_chain(root):
+    node = root
+    while node is not None:
+        yield node
+        node = getattr(node, "input", None)
+
+
+async def test_mesh_topn_planned_and_matches_batch_oracle():
+    """q5-shaped top-N over the mesh: ORDER BY n DESC LIMIT 10 over a
+    retracting agg changelog lowers to ShardedTopNExecutor under
+    SET streaming_parallelism_devices, engages the fused shuffle, and
+    the materialized rows characterize exactly against the batch
+    engine's recount of the upstream MV (order-key multiset equality —
+    robust to hash tie-breaks at the boundary)."""
+    from risingwave_tpu.stream.sharded_top_n import ShardedTopNExecutor
+    from risingwave_tpu.stream.retract_top_n import RetractableTopNExecutor
+    s = Session()
+    await _mk_bid(s)
+    await s.execute("SET streaming_parallelism_devices = 8")
+    await s.execute("CREATE MATERIALIZED VIEW counts AS SELECT auction "
+                    "AS a, count(*) AS n FROM bid GROUP BY auction")
+    await s.execute("CREATE MATERIALIZED VIEW t10 AS SELECT a, n FROM "
+                    "counts ORDER BY n DESC LIMIT 10")
+    tops = _executors(s, "t10", ShardedTopNExecutor)
+    assert tops, "mesh session var did not deploy a sharded top-N"
+    await s.execute("SET streaming_parallelism_devices = 1")
+    await s.execute("CREATE MATERIALIZED VIEW u10 AS SELECT a, n FROM "
+                    "counts ORDER BY n DESC LIMIT 3")
+    assert not _executors(s, "u10", ShardedTopNExecutor)
+    assert _executors(s, "u10", RetractableTopNExecutor)
+    await s.tick(4)
+    assert tops[0].mesh_shuffle_applies > 0, "fused top-N never engaged"
+    got = s.query("SELECT a, n FROM t10 ORDER BY 2 DESC, 1")
+    want = s.query("SELECT a, n FROM counts ORDER BY 2 DESC, 1 LIMIT 10")
+    # boundary ties can pick either key; the order-key column must match
+    assert [n for _, n in got] == [n for _, n in want]
+    assert len(got) == 10
+    # non-tied prefix rows must match exactly
+    ns = [n for _, n in want]
+    exact = [i for i, n in enumerate(ns) if ns.count(n) == 1]
+    for i in exact:
+        assert got[i] == want[i]
+    await s.drop_all()
+
+
+async def test_mesh_topn_crash_recovers_mesh_scope(tmp_path):
+    """Crash the sharded top-N actor: mesh-scope recovery rebuilds it
+    sharded (durable full-input store + ingest replay) and the MV
+    converges back onto the batch recount."""
+    import asyncio
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+    from risingwave_tpu.stream.sharded_top_n import ShardedTopNExecutor
+    store = HummockStateStore(LocalFsObjectStore(str(tmp_path / "d")))
+    s = Session(store=store)
+    await _mk_bid(s)
+    await s.execute("SET streaming_parallelism_devices = 8")
+    await s.execute("CREATE MATERIALIZED VIEW counts AS SELECT auction "
+                    "AS a, count(*) AS n FROM bid GROUP BY auction")
+    await s.execute("CREATE MATERIALIZED VIEW t10 AS SELECT a, n FROM "
+                    "counts ORDER BY n DESC LIMIT 10")
+    await s.tick(3)
+    dep = s.catalog.mvs["t10"].deployment
+    vfid = next(fid for fid, roots in dep.roots.items()
+                if any(isinstance(n, ShardedTopNExecutor)
+                       for root in roots for n in _iter_chain(root)))
+    by_id = {a.actor_id: i for i, a in enumerate(dep.actors)}
+    victim = dep.tasks[by_id[dep.frag_actor_ids[vfid][0]]]
+    victim.cancel()
+    try:
+        await victim
+    except (asyncio.CancelledError, Exception):
+        pass
+    await s.tick(3, max_recoveries=8)
+    assert s.recoveries >= 1
+    assert s.last_recovery["scope"] == "mesh", \
+        "sharded top-N crash must recover at mesh scope"
+    tops = _executors(s, "t10", ShardedTopNExecutor)
+    assert tops and tops[0].mesh_shuffle, \
+        "recovery replanned top-N without the mesh"
+    got = s.query("SELECT a, n FROM t10 ORDER BY 2 DESC, 1")
+    want = s.query("SELECT a, n FROM counts ORDER BY 2 DESC, 1 LIMIT 10")
+    assert [n for _, n in got] == [n for _, n in want]
+    assert len(got) == 10
+    await s.drop_all()
+
+
+async def test_mesh_over_window_planned_and_matches_oracle():
+    """PARTITION BY over-window on the mesh: partition-key routing keeps
+    frames shard-local, so the sharded lowering must reproduce the
+    deterministic host oracle (unique ORDER BY key) exactly at the
+    committed offsets."""
+    from risingwave_tpu.stream.sharded_over_window import \
+        ShardedOverWindowExecutor
+    s = Session()
+    await s.execute(
+        "CREATE SOURCE auction WITH (connector='nexmark', "
+        "table='auction', primary_key='id', chunk_size=384, "
+        "rate_limit=768)")
+    await s.execute("SET streaming_parallelism_devices = 8")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW rn AS "
+        "SELECT A.id, A.seller, row_number() OVER "
+        "(PARTITION BY A.seller ORDER BY A.id) AS rn FROM auction A")
+    ows = _executors(s, "rn", ShardedOverWindowExecutor)
+    assert ows, "mesh session var did not deploy a sharded over-window"
+    await s.tick(3)
+    assert ows[0].mesh_shuffle_applies > 0, \
+        "fused over-window never engaged"
+    got = Counter(s.query("SELECT id, seller, rn FROM rn"))
+    from oracle import committed_offsets, nexmark_prefix
+    off = committed_offsets(s, "rn").get("auction", 0)
+    cols = nexmark_prefix("auction", off)
+    per_seller: dict = {}
+    for aid, seller in zip(cols[0], cols[7]):
+        per_seller.setdefault(int(seller), []).append(int(aid))
+    exp = Counter()
+    for seller, ids in per_seller.items():
+        for rank, aid in enumerate(sorted(ids), start=1):
+            exp[(aid, seller, rank)] += 1
+    assert got == exp, (
+        f"sharded over-window diverged: sample "
+        f"{list((got - exp).items())[:3]} / "
+        f"{list((exp - got).items())[:3]}")
+    assert off > 0 and len(exp) > 10
+    await s.drop_all()
