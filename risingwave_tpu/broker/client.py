@@ -129,3 +129,15 @@ class BrokerClient:
 
     def ping(self) -> dict:
         return self.call("ping")
+
+    def set_compaction(self, topic: str, keys: list) -> None:
+        return self.call("set_compaction", topic=topic, keys=list(keys))
+
+    def set_retention_floor(self, topic: str, partition: int,
+                            offset: int) -> dict:
+        return self.call("set_retention_floor", topic=topic,
+                         partition=partition, offset=offset)
+
+    def earliest_offset(self, topic: str, partition: int) -> int:
+        return self.call("earliest_offset", topic=topic,
+                         partition=partition)

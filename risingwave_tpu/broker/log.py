@@ -17,6 +17,15 @@ to the invariants the engine's exactly-once contracts actually consume:
   * segments roll at a size threshold; a segment file is named by the
     base offset of its first record, so a reader locates any offset from
     directory listing alone.
+  * retention drops WHOLE sealed segments below a floor offset pushed by
+    the engine (the minimum offset every consumer has durably
+    checkpointed) — `start_offset` is then the earliest retained record
+    and a reopen seeds itself from the first surviving batch. On a
+    key-compacted topic the dropped range folds into a latest-record-
+    per-key snapshot (`COMPACT.snap`, written atomically BEFORE the
+    segment files go) that fetches below the floor serve in one batch —
+    a new changelog consumer gets net state + tail instead of history
+    from offset 0.
 
 Batch body layout (all big-endian):
 
@@ -52,6 +61,8 @@ class PartitionLog:
         # batch index: (base_offset, n_records, seg_path, file_pos)
         self._index: list[tuple[int, int, str, int]] = []
         self.next_offset = 0
+        # earliest retained record (> 0 once retention dropped segments)
+        self.start_offset = 0
         # metadata of the last readable batch that carried one (the
         # sink's durable sequence number lives here)
         self.last_meta: Optional[dict] = None
@@ -87,6 +98,12 @@ class PartitionLog:
                     meta = (json.loads(body[_HDR.size:
                                             _HDR.size + meta_len])
                             if meta_len else None)
+                    if not self._index:
+                        # first surviving batch seeds the offset space:
+                        # retention may have dropped a whole segment
+                        # prefix, so the log no longer starts at 0
+                        self.start_offset = base
+                        self.next_offset = base
                     if base != self.next_offset:
                         break               # gap: a lost segment prefix
                     self._index.append((base, n, seg_path, pos))
@@ -94,6 +111,16 @@ class PartitionLog:
                     if meta is not None:
                         self.last_meta = meta
                     pos += _FRAME.size + body_len
+        if not self._index:
+            # no surviving batch (fresh dir, or a torn tail emptied the
+            # only segment): the segment NAME still carries the base
+            # offset, so appends continue the dense offset space instead
+            # of restarting at 0 under committed consumer cursors
+            segs = self._segments()
+            if segs:
+                base = int(segs[-1].split(".")[0])
+                self.start_offset = base
+                self.next_offset = base
 
     # ------------------------------------------------------------- append
     def append(self, records: list[bytes],
@@ -154,10 +181,17 @@ class PartitionLog:
         for base, n, seg_path, pos in self._index[lo:]:
             if len(out) >= max_records:
                 break
-            with open(seg_path, "rb") as f:
-                f.seek(pos)
-                body_len, _crc = _FRAME.unpack(f.read(_FRAME.size))
-                body = f.read(body_len)
+            try:
+                with open(seg_path, "rb") as f:
+                    f.seek(pos)
+                    body_len, _crc = _FRAME.unpack(f.read(_FRAME.size))
+                    body = f.read(body_len)
+            except FileNotFoundError:
+                # a racing retention drop removed this (sub-floor)
+                # segment; stop here so the returned records stay
+                # offset-contiguous — the caller refetches above the
+                # new start_offset
+                break
             _base, _n, meta_len = _HDR.unpack_from(body)
             p = _HDR.size + meta_len
             for i in range(n):
@@ -171,3 +205,109 @@ class PartitionLog:
     @property
     def high_watermark(self) -> int:
         return self.next_offset
+
+    # ---------------------------------------------------------- retention
+    _SNAP = "COMPACT.snap"
+
+    def _read_batch_records(self, seg_path: str, pos: int) -> list[bytes]:
+        with open(seg_path, "rb") as f:
+            f.seek(pos)
+            body_len, _crc = _FRAME.unpack(f.read(_FRAME.size))
+            body = f.read(body_len)
+        _base, n, meta_len = _HDR.unpack_from(body)
+        out: list[bytes] = []
+        p = _HDR.size + meta_len
+        for _ in range(n):
+            (ln,) = _REC.unpack_from(body, p)
+            p += _REC.size
+            out.append(body[p:p + ln])
+            p += ln
+        return out
+
+    def drop_segments_below(self, floor: int,
+                            compact_keys: Optional[list] = None) -> int:
+        """Drop the longest PREFIX of whole sealed segments whose every
+        record sits below `floor` (the engine's durable-consumer floor).
+        The active segment never drops; a partially-covered segment
+        blocks the prefix (offsets stay dense). With `compact_keys` the
+        dropped range first folds into the latest-per-key snapshot —
+        written atomically BEFORE any file is removed, so a crash
+        between the two at worst re-folds the same records (idempotent:
+        latest-per-key). Returns the number of segments dropped."""
+        with self._lock:
+            segs = self._segments()
+            if len(segs) <= 1:
+                return 0
+            ends: dict[str, int] = {}
+            for base, n, seg_path, _pos in self._index:
+                name = os.path.basename(seg_path)
+                ends[name] = max(ends.get(name, 0), base + n)
+            drop: list[str] = []
+            for name in segs[:-1]:          # never the active segment
+                end = ends.get(name)
+                if end is not None and end <= floor:
+                    drop.append(name)
+                else:
+                    break
+            if not drop:
+                return 0
+            if compact_keys:
+                self._merge_snapshot(drop, list(compact_keys))
+            dropped = {os.path.join(self.path, n) for n in drop}
+            for p in sorted(dropped):
+                os.remove(p)
+            self._index = [e for e in self._index if e[2] not in dropped]
+            self.start_offset = (self._index[0][0] if self._index
+                                 else self.next_offset)
+            return len(drop)
+
+    def _merge_snapshot(self, drop_names: list[str],
+                        keys: list[str]) -> None:
+        """Fold every record of the to-be-dropped segments into the
+        compacted snapshot: latest JSON record per key tuple wins, a
+        record carrying `__op` (the changelog delete marker —
+        connectors/broker.py encode_row) removes its key. Non-JSON
+        records have no key and are dropped with the history."""
+        snap = self._load_snapshot() or {}
+        dropped = {os.path.join(self.path, n) for n in drop_names}
+        for base, n, seg_path, pos in self._index:
+            if seg_path not in dropped:
+                continue
+            for rec in self._read_batch_records(seg_path, pos):
+                try:
+                    obj = json.loads(rec)
+                except ValueError:
+                    continue
+                if not isinstance(obj, dict):
+                    continue
+                key = json.dumps([obj.get(k) for k in keys])
+                if "__op" in obj:
+                    snap.pop(key, None)
+                else:
+                    snap[key] = rec.decode()   # json => valid utf-8
+        tmp = os.path.join(self.path, self._SNAP + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+            f.flush()
+            if self.fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.path, self._SNAP))
+
+    def _load_snapshot(self) -> Optional[dict]:
+        path = os.path.join(self.path, self._SNAP)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def snapshot_records(self) -> Optional[list[bytes]]:
+        """The compacted prefix as record bytes (net state below
+        `start_offset`), or None when this partition was never
+        key-compacted. Served whole to fetches below the floor."""
+        snap = self._load_snapshot()
+        if snap is None:
+            return None
+        return [s.encode() for s in snap.values()]

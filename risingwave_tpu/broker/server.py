@@ -15,6 +15,7 @@ recovers topics, partition counts, offsets and batch metadata by scan
 from __future__ import annotations
 
 import asyncio
+import json
 import os
 import re
 import threading
@@ -37,11 +38,21 @@ class Broker:
         self.fsync = fsync
         self._lock = threading.Lock()
         self._parts: dict[tuple[str, int], PartitionLog] = {}
+        # topic -> key field names for key-compacted (changelog) topics;
+        # durable in <topic>/_compact.json so a restart keeps compacting
+        self._compact_keys: dict[str, list[str]] = {}
         os.makedirs(root, exist_ok=True)
         for topic in sorted(os.listdir(root)):
             tdir = os.path.join(root, topic)
             if not os.path.isdir(tdir):
                 continue
+            cpath = os.path.join(tdir, "_compact.json")
+            if os.path.exists(cpath):
+                try:
+                    with open(cpath) as f:
+                        self._compact_keys[topic] = list(json.load(f))
+                except (OSError, ValueError):
+                    pass
             for p in sorted(os.listdir(tdir)):
                 if p.startswith("p") and p[1:].isdigit():
                     self._open(topic, int(p[1:]))
@@ -113,13 +124,57 @@ class Broker:
     def fetch(self, topic: str, partition: int, offset: int,
               max_records: int = 256) -> dict:
         log = self._part(topic, partition)
-        recs = log.fetch(int(offset), int(max_records))
+        offset = int(offset)
+        if offset < log.start_offset:
+            # below the retention floor: a key-compacted partition
+            # serves its latest-per-key snapshot in ONE batch (net
+            # state, then the tail from start_offset); a plain one
+            # clamps forward — either way the consumer backfills from
+            # the floor instead of offset 0
+            snap = log.snapshot_records()
+            if snap is not None:
+                return {"records": snap,
+                        "next_offset": log.start_offset,
+                        "high_watermark": log.high_watermark,
+                        "log_start_offset": log.start_offset,
+                        "compacted": True}
+            offset = log.start_offset
+        recs = log.fetch(offset, int(max_records))
         return {"records": recs,
-                "next_offset": int(offset) + len(recs),
-                "high_watermark": log.high_watermark}
+                "next_offset": offset + len(recs),
+                "high_watermark": log.high_watermark,
+                "log_start_offset": log.start_offset}
 
     def high_watermark(self, topic: str, partition: int) -> int:
         return self._part(topic, partition).high_watermark
+
+    # ---------------------------------------------------------- retention
+    def set_compaction(self, topic: str, keys: list) -> None:
+        """Mark `topic` key-compacted: retention folds dropped segments
+        into a latest-record-per-key snapshot instead of discarding
+        them. Durable per topic (_compact.json)."""
+        with self._lock:
+            if self._n_partitions(topic) == 0:
+                raise KeyError(f"unknown topic {topic!r}")
+            self._compact_keys[topic] = [str(k) for k in keys]
+            with open(os.path.join(self.root, topic, "_compact.json"),
+                      "w") as f:
+                json.dump(self._compact_keys[topic], f)
+
+    def set_retention_floor(self, topic: str, partition: int,
+                            offset: int) -> dict:
+        """The engine's durable-consumer floor for one partition: drop
+        whole sealed segments entirely below it (key-compacting them
+        first on a compacted topic). Idempotent; a floor above the high
+        watermark is clamped by the whole-segment rule itself."""
+        log = self._part(topic, partition)
+        dropped = log.drop_segments_below(
+            int(offset), self._compact_keys.get(topic))
+        return {"segments_dropped": dropped,
+                "log_start_offset": log.start_offset}
+
+    def earliest_offset(self, topic: str, partition: int) -> int:
+        return self._part(topic, partition).start_offset
 
     def last_meta(self, topic: str, partition: int) -> Optional[dict]:
         """Metadata of the last durable batch that carried one — where a
@@ -162,7 +217,8 @@ class BrokerServer:
 
     _METHODS = ("create_topic", "add_partitions", "list_partitions",
                 "topics", "append", "fetch", "high_watermark",
-                "last_meta", "ping")
+                "last_meta", "ping", "set_compaction",
+                "set_retention_floor", "earliest_offset")
 
     def __init__(self, broker: Broker, host: str = "127.0.0.1",
                  port: int = 0):

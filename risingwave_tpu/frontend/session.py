@@ -249,6 +249,24 @@ class Session:
         "storage_scrub_interval": (16, int),
         # objects integrity-verified per scrub pulse
         "storage_scrub_batch": (2, int),
+        # background compaction (state/compactor.py): consider a merge
+        # every N collected barriers. 0 disables and falls back to the
+        # inline commit-path merge (standalone-store behavior).
+        "compaction_interval": (1, int),
+        # L0 run count that arms a merge (read amp stays near this)
+        "compaction_l0_trigger": (4, int),
+        # rewrite budget credited per barrier interval — paces merge
+        # work against ingest so compaction can't starve the loop
+        "compaction_budget_bytes": (8 << 20, int),
+        # max L0 runs folded per merge (bounds single-task latency)
+        "compaction_max_runs": (8, int),
+        # broker retention (state/compactor.py): push earliest-durable-
+        # offset floors to brokers every N barriers so they drop whole
+        # sealed segments below every consumer's checkpoint. 0 = off.
+        "broker_retention_interval": (0, int),
+        # backup generations kept point-in-time restorable in the
+        # ledger (RESTORE FROM ... AT GENERATION n)
+        "backup_keep_generations": (8, int),
         # bounded retry budget of the ResilientObjectStore wrapper: a
         # transient PUT/GET absorbs up to N-1 retries (seeded backoff +
         # jitter) below the recovery machinery before it surfaces as a
@@ -346,6 +364,16 @@ class Session:
         if objects is not None and hasattr(objects, "max_attempts"):
             objects.max_attempts = max(
                 1, self.config.get("object_store_retries", 4))
+        comp = getattr(self.coord, "compactor", None)
+        if comp is not None:
+            comp.configure(
+                interval=self.config.get("compaction_interval", 1),
+                l0_trigger=self.config.get("compaction_l0_trigger", 4),
+                budget_bytes=self.config.get("compaction_budget_bytes",
+                                             8 << 20),
+                max_runs=self.config.get("compaction_max_runs", 8))
+            comp.retention.configure(
+                interval=self.config.get("broker_retention_interval", 0))
         if hasattr(self.store, "backup_store"):
             path = self.config.get("backup_path", "")
             if path:
@@ -455,11 +483,23 @@ class Session:
         backup ledger (state/backup.py). Holds the coordinator's rounds
         lock so no sync/compaction/manifest swap runs mid-copy
         (reference: src/storage/backup/src/, the meta snapshot taken
-        under the barrier manager's pause)."""
+        under the barrier manager's pause). Registered in-process
+        brokers' data directories ride the same ledger under
+        `broker/<name>/...` (their batch framing makes a torn active-
+        segment tail harmless on restore, so appends need no quiesce);
+        `extract_backup_prefix` materializes them back."""
+        import os as _os
         from ..state.backup import backup_objects
         objects = getattr(self.store, "objects", None)
         if objects is None:
             raise BindError("backup needs a durable (Hummock) store")
+        from ..broker.server import _INPROC
+        from ..state import LocalFsObjectStore
+        aux = {}
+        for bname, broker in sorted(_INPROC.items()):
+            root = getattr(broker, "root", None)
+            if root and _os.path.isdir(root):
+                aux[f"broker/{bname}"] = LocalFsObjectStore(root)
         async with self.coord._rounds_lock:
             # the rounds lock stops NEW barriers; the background uploader
             # may still hold sealed-but-uncommitted epochs — drain them so
@@ -476,12 +516,17 @@ class Session:
             # the copy itself runs off-loop so pgwire/sinks/actors stay
             # responsive during a large backup
             return await asyncio.to_thread(
-                backup_objects, objects, dest_object_store, extra)
+                backup_objects, objects, dest_object_store, extra, aux,
+                max(1, self.config.get("backup_keep_generations", 8)))
 
-    async def restore_from(self, path: str) -> dict:
-        """Cold-start disaster recovery (RESTORE FROM '<path>'): verify
-        EVERY object of the backup against its ledger checksum, copy the
-        verified set into this session's FRESH primary store, re-point
+    async def restore_from(self, path: str,
+                           generation: Optional[int] = None) -> dict:
+        """Cold-start disaster recovery (RESTORE FROM '<path>'
+        [AT GENERATION <n>]): verify EVERY object of the backup against
+        its ledger checksum, copy the chosen generation's verified set
+        (default: newest; older retained generations resolve
+        superseded bytes from the archive — point-in-time restore)
+        into this session's FRESH primary store, re-point
         the store at the restored manifest, reload the string dictionary
         and DDL log, then replay the DDL log — the restored session
         converges from the backup's committed epoch exactly like a
@@ -498,7 +543,8 @@ class Session:
                 "no live flows) over a fresh store")
         backup = LocalFsObjectStore(path)
         # verification + copy run off-loop (reads every backup object)
-        meta = await asyncio.to_thread(restore_objects, backup, objects)
+        meta = await asyncio.to_thread(restore_objects, backup, objects,
+                                       generation)
         # re-point the live handles at the restored world
         self.store.refresh_manifest()
         from ..common.types import load_dict_log
@@ -638,7 +684,8 @@ class Session:
                 epoch=meta.get("epoch"))
             return meta
         if isinstance(stmt, ast.RestoreStmt):
-            meta = await self.restore_from(stmt.path)
+            meta = await self.restore_from(stmt.path,
+                                           stmt.generation)
             self.event_log.emit(
                 "restore", path=stmt.path,
                 generation=(meta or {}).get("generation")
@@ -695,9 +742,15 @@ class Session:
                 self._apply_logstore_config()
             elif stmt.name in ("backup_path", "storage_scrub_interval",
                                "storage_scrub_batch",
-                               "object_store_retries"):
-                # runtime-mutable on the live store/scrubber: the next
-                # scrub pulse and the next object op see the new policy
+                               "object_store_retries",
+                               "compaction_interval",
+                               "compaction_l0_trigger",
+                               "compaction_budget_bytes",
+                               "compaction_max_runs",
+                               "broker_retention_interval"):
+                # runtime-mutable on the live store/scrubber/compactor:
+                # the next pulse and the next object op see the new
+                # policy
                 self._apply_storage_config()
             elif stmt.name == "partial_recovery":
                 # build-time knob: channels allocated after this carry
@@ -1039,6 +1092,12 @@ class Session:
                     gen = 0
             rows.append(("backup_generation", str(gen) if gen else "-"))
             return rows
+        if what == "compaction":
+            # the background compaction + retention plane as (key,
+            # value) rows: knobs, run/rewrite counters, L0 depth / read
+            # amp, per-source retention floors, last merge, broker
+            # floor pushes (state/compactor.py)
+            return [(k, v) for k, v in self.coord.compactor.report()]
         if what in ("tables", "materialized_views"):
             return [(n,) for n in sorted(self.catalog.mvs)]
         if what == "sinks":

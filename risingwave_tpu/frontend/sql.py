@@ -196,10 +196,13 @@ class BackupStmt:
 
 @dataclass
 class RestoreStmt:
-    """RESTORE FROM '<path>' — verify the backup, copy it into this
-    session's FRESH primary store, reload catalog+manifest, replay the
-    DDL log (cold-start disaster recovery)."""
+    """RESTORE FROM '<path>' [AT GENERATION <n>] — verify the backup,
+    copy it into this session's FRESH primary store, reload
+    catalog+manifest, replay the DDL log (cold-start disaster
+    recovery). AT GENERATION picks an older retained generation from
+    the ledger (point-in-time restore) instead of the newest."""
     path: str
+    generation: Optional[int] = None
 
 
 @dataclass
@@ -300,8 +303,12 @@ class Parser:
             self.next()
             self.expect("kw", "from")
             path = self.expect("str").val
+            generation = None
+            if self.accept("ident", "at"):
+                self.expect("ident", "generation")
+                generation = int(self.expect("num").val)
             self.accept("op", ";")
-            return RestoreStmt(path)
+            return RestoreStmt(path, generation)
         if self.accept("kw", "explain"):
             # EXPLAIN MATERIALIZED VIEW <name>: live deployed graph +
             # memory accounting (a bare EXPLAIN CREATE ... still plans

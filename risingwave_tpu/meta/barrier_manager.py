@@ -149,6 +149,20 @@ class BarrierCoordinator:
         # exactly like an upload failure.
         from ..logstore.log import LogStoreHub
         self.logstore = LogStoreHub(store)
+        # Background compaction & retention plane (state/compactor.py):
+        # barrier-paced merges off the commit path (attaching it flips
+        # HummockStateStore.inline_compaction off), pin-aware GC over
+        # serving pins + durable subscription cursors, and broker
+        # retention floors from committed source offsets. Pulsed in the
+        # same between-epochs window as the scrubber; Session plumbs
+        # compaction_* / broker_retention_interval here.
+        from ..state.compactor import (BackgroundCompactor,
+                                       BrokerRetentionManager)
+        self.compactor = BackgroundCompactor(
+            store, serving=self.serving, logstore=self.logstore)
+        self.compactor.retention = BrokerRetentionManager(
+            store, lambda: self.source_execs)
+        self.compactor._sync_inline_flag()
         # ---- async epoch uploader (the checkpoint pipeline) ----
         self._upload_q: asyncio.Queue[_UploadJob] = asyncio.Queue()
         self._uploader_task: Optional[asyncio.Task] = None
@@ -674,6 +688,13 @@ class BarrierCoordinator:
         # in-flight upload is invisible to meta)
         self.scrubber.on_barrier(barrier.epoch.curr,
                                  cluster_mode=bool(self.workers))
+        # compaction & retention pulse (state/compactor.py): harvest a
+        # finished background merge (one manifest swap, deletes strictly
+        # after), maybe start the next one on a worker thread, and push
+        # broker retention floors — the commit path above never merges
+        self.compactor.event_log = self.event_log
+        self.compactor.retention.event_log = self.event_log
+        self.compactor.on_barrier(barrier.epoch.curr)
 
     async def run_rounds(self, n: int, interval_s: Optional[float] = None) -> None:
         """Inject n barriers, waiting for each to complete. The very first
@@ -852,6 +873,8 @@ class BarrierCoordinator:
         path."""
         if self._uploader_task is not None:
             await self._upload_q.join()
+        await self.compactor.drain()
+        await self.scrubber.drain()
         if self._upload_failure is not None:
             exc = self._upload_failure
             raise RuntimeError(
@@ -868,6 +891,10 @@ class BarrierCoordinator:
         topology's fresh tasks resume exactly-once."""
         self._stop_watchdog()
         self.logstore.abort()
+        # in-flight background merge: abandon it — its output (if the
+        # thread finishes the upload anyway) is an orphan the scrubber
+        # sweeps; no manifest ever references it
+        self.compactor.abort()
         t = self._uploader_task
         self._uploader_task = None
         if t is not None and not t.done():

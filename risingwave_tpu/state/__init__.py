@@ -2,6 +2,7 @@ from .store import StateStore, MemoryStateStore, WriteBatch, encode_table_key
 from .state_table import StateTable, StateTableError
 from .serde import RowSerde, encode_memcomparable, decode_memcomparable
 from .hummock import HummockStateStore
+from .compactor import BackgroundCompactor, BrokerRetentionManager, PinRegistry
 from .object_store import (ObjectStore, InMemObjectStore,
                            LocalFsObjectStore, ResilientObjectStore,
                            TransientObjectError, ObjectStoreUnavailable)

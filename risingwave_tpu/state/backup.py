@@ -21,6 +21,17 @@ world. Objects the source dropped since the previous generation
 (compaction victims) are pruned from the destination only AFTER the new
 backup manifest is durable, mirroring the manifest-swap-then-delete
 rule of the store itself.
+
+**Point-in-time restore** (format 3): the ledger additionally records
+the live object set of each RETAINED generation (`generations`), and
+bytes still referenced by a retained generation survive overwrite/prune
+under content-addressed `archive/<crc>/<name>` copies — written before
+the manifest that references them, garbage-collected strictly after.
+`restore_objects(..., generation=n)` then materializes any retained
+generation exactly (`RESTORE FROM <dir> AT GENERATION <n>`), and
+`verify_backup` checks every archived byte range too. Auxiliary
+sources (broker data directories) ride the same ledger under a name
+prefix via `aux=` and extract with `extract_backup_prefix`.
 """
 
 from __future__ import annotations
@@ -33,6 +44,14 @@ from .object_store import ObjectStore
 from .sstable import frame_meta, unframe_meta, MetaCorruption
 
 BACKUP_MANIFEST_PATH = "BACKUP_MANIFEST"
+ARCHIVE_PREFIX = "archive/"
+DEFAULT_KEEP_GENERATIONS = 8
+
+
+def _archive_name(name: str, crc: int) -> str:
+    """Content-addressed home of a superseded object's bytes: the crc in
+    the path keeps distinct historical versions of one name apart."""
+    return f"{ARCHIVE_PREFIX}{crc & 0xFFFFFFFF:08x}/{name}"
 
 
 class BackupCorruption(Exception):
@@ -57,26 +76,43 @@ def load_backup_manifest(dst: ObjectStore) -> Optional[dict]:
     body = unframe_meta(dst.read(BACKUP_MANIFEST_PATH),
                         BACKUP_MANIFEST_PATH)
     m = json.loads(body)
-    if m.get("format") != 2:
+    if m.get("format") not in (2, 3):
         raise BackupCorruption(
             f"unknown backup manifest format: {m.get('format')!r}")
     return m
 
 
 def backup_objects(src: ObjectStore, dst: ObjectStore,
-                   extra: Optional[dict] = None) -> dict:
+                   extra: Optional[dict] = None,
+                   aux: Optional[dict] = None,
+                   keep_generations: int = DEFAULT_KEEP_GENERATIONS) -> dict:
     """Incremental generation-stamped copy of every src object into dst
     (manifest/catalog last), each copy read back + checksum-verified
     before it is recorded. `extra` maps name -> bytes for caller-held
     snapshots written last (Session passes the CATALOG it read under the
-    rounds lock). Returns the summary: generation, per-run copied /
-    skipped counts and the total recorded object count."""
+    rounds lock); `aux` maps a name prefix -> ObjectStore for auxiliary
+    data directories (broker segment roots) backed up under
+    `<prefix>/...` in the same ledger. The last `keep_generations`
+    generations stay point-in-time restorable: bytes a retained
+    generation still references survive overwrite/prune as
+    content-addressed `archive/` copies (written BEFORE the manifest
+    that references them; unreferenced archives garbage-collect strictly
+    AFTER). Returns the summary: generation, per-run copied / skipped
+    counts and the total recorded object count."""
     from ..utils.metrics import (BACKUP_GENERATION, BACKUP_OBJECTS_COPIED,
                                  BACKUP_OBJECTS_SKIPPED)
     extra = dict(extra or {})
     prev = load_backup_manifest(dst)
     gen = (prev["generation"] + 1) if prev else 1
     entries: dict[str, dict] = dict(prev["objects"]) if prev else {}
+    generations: dict[str, dict] = dict(prev.get("generations") or {}) \
+        if prev else {}
+    if prev is not None and prev.get("format") == 2 and not generations:
+        # upgrading a format-2 ledger: its current object set IS its one
+        # restorable generation — record it so the upgrade loses nothing
+        generations[str(prev["generation"])] = {
+            n: {"crc": e["crc"], "size": e["size"]}
+            for n, e in entries.items()}
     last = [n for n in _manifest_last() if n not in extra]
     names = src.list("")
     # quarantined evidence is deliberately NOT backed up (it is the
@@ -84,8 +120,36 @@ def backup_objects(src: ObjectStore, dst: ObjectStore,
     names = [n for n in names
              if not n.startswith("quarantine/")
              and n != BACKUP_MANIFEST_PATH]
+    for prefix, store in sorted((aux or {}).items()):
+        p = prefix.strip("/")
+        names += [f"{p}/{n}" for n in store.list("")
+                  if not n.endswith(".tmp")]
+    aux_read = {prefix.strip("/"): store
+                for prefix, store in (aux or {}).items()}
+
+    def _src_read(name: str) -> bytes:
+        for p, store in aux_read.items():
+            if name.startswith(p + "/"):
+                return store.read(name[len(p) + 1:])
+        return src.read(name)
+
     ordinary = [n for n in names if n not in last and n not in extra]
-    copied = skipped = 0
+    copied = skipped = archived = 0
+
+    def _archive_put(name: str, want_crc: int) -> None:
+        """Preserve dst's CURRENT bytes of `name` (recorded at
+        `want_crc`) under the archive before they are overwritten or
+        pruned — only when they still verify; corrupt bytes are not
+        worth keeping and verify_backup flags the loss."""
+        nonlocal archived
+        arc = _archive_name(name, want_crc)
+        if dst.exists(arc) or not dst.exists(name):
+            return
+        old = dst.read(name)
+        if zlib.crc32(old) != want_crc:
+            return
+        dst.upload(arc, old)
+        archived += 1
 
     def _put_verified(name: str, data: bytes) -> None:
         nonlocal copied, skipped
@@ -94,6 +158,8 @@ def backup_objects(src: ObjectStore, dst: ObjectStore,
         if ent is not None and ent["crc"] == crc and dst.exists(name):
             skipped += 1
             return
+        if ent is not None and ent["crc"] != crc:
+            _archive_put(name, ent["crc"])
         dst.upload(name, data)
         back = dst.read(name)          # read-back verify AT BACKUP TIME
         if zlib.crc32(back) != crc:
@@ -103,30 +169,57 @@ def backup_objects(src: ObjectStore, dst: ObjectStore,
         copied += 1
 
     for n in ordinary:
-        _put_verified(n, src.read(n))
+        _put_verified(n, _src_read(n))
     for n in last:
         if src.exists(n):
             _put_verified(n, src.read(n))
     for n, data in extra.items():
         _put_verified(n, data)
-    # prune ledger entries whose source object is gone (compacted away):
-    # manifest first, deletes strictly after — a crash between them
-    # leaves harmless unreferenced extra objects, never a ledger entry
-    # pointing at nothing
     live = set(names) | set(extra) | {n for n in last if src.exists(n)}
+    # stamp this generation's object set, then retain only the newest
+    # `keep_generations` of them (the current one always survives)
+    generations[str(gen)] = {
+        n: {"crc": entries[n]["crc"], "size": entries[n]["size"]}
+        for n in sorted(live) if n in entries}
+    kept = sorted((int(g) for g in generations), reverse=True)
+    kept = set(kept[:max(1, int(keep_generations))])
+    generations = {g: objs for g, objs in generations.items()
+                   if int(g) in kept}
+    # prune ledger entries whose source object is gone (compacted away):
+    # archive the ones older generations still pin, write the manifest,
+    # THEN delete — a crash between the steps leaves harmless extra
+    # objects, never a ledger entry pointing at nothing
     pruned = sorted(n for n in entries if n not in live)
+    pruned_ent = {n: entries.pop(n) for n in pruned}
+    # bytes a retained generation references but the (post-prune)
+    # current object set no longer holds at that crc must live in the
+    # archive
+    needed_arc: set[str] = set()
+    for objs in generations.values():
+        for n, e in objs.items():
+            cur = entries.get(n)
+            if cur is None or cur["crc"] != e["crc"]:
+                needed_arc.add(_archive_name(n, e["crc"]))
     for n in pruned:
-        del entries[n]
-    manifest = {"format": 2, "generation": gen, "objects": entries}
+        if _archive_name(n, pruned_ent[n]["crc"]) in needed_arc:
+            _archive_put(n, pruned_ent[n]["crc"])
+    arc_garbage = sorted(n for n in dst.list(ARCHIVE_PREFIX)
+                         if n not in needed_arc)
+    manifest = {"format": 3, "generation": gen, "objects": entries,
+                "generations": generations}
     dst.upload(BACKUP_MANIFEST_PATH,
                frame_meta(json.dumps(manifest).encode()))
     for n in pruned:
+        dst.delete(n)
+    for n in arc_garbage:
         dst.delete(n)
     BACKUP_OBJECTS_COPIED.inc(copied)
     BACKUP_OBJECTS_SKIPPED.inc(skipped)
     BACKUP_GENERATION.set(float(gen))
     return {"objects": len(entries), "copied": copied,
-            "skipped": skipped, "pruned": len(pruned), "generation": gen}
+            "skipped": skipped, "pruned": len(pruned),
+            "archived": archived, "generations": sorted(kept),
+            "generation": gen}
 
 
 def verify_backup(backup: ObjectStore) -> Optional[dict]:
@@ -145,6 +238,27 @@ def verify_backup(backup: ObjectStore) -> Optional[dict]:
             raise BackupCorruption(
                 f"backup object {name!r} fails its checksum "
                 f"(generation {ent['generation']})")
+    # every retained generation must be materializable: names the
+    # current set no longer holds at the recorded crc must verify from
+    # their archive copies
+    checked: set[str] = set()
+    for g, objs in sorted((m.get("generations") or {}).items()):
+        for name, ent in sorted(objs.items()):
+            cur = m["objects"].get(name)
+            if cur is not None and cur["crc"] == ent["crc"]:
+                continue                       # verified above
+            arc = _archive_name(name, ent["crc"])
+            if arc in checked:
+                continue
+            if not backup.exists(arc):
+                raise BackupCorruption(
+                    f"archived object {arc!r} (generation {g}) is "
+                    f"missing")
+            if zlib.crc32(backup.read(arc)) != ent["crc"]:
+                raise BackupCorruption(
+                    f"archived object {arc!r} fails its checksum "
+                    f"(generation {g})")
+            checked.add(arc)
     return m
 
 
@@ -163,11 +277,35 @@ def read_backup_object(backup: ObjectStore, name: str) -> Optional[bytes]:
     return data
 
 
-def restore_objects(backup: ObjectStore, dest: ObjectStore) -> dict:
+def _generation_objects(m: dict, generation: Optional[int]) -> dict:
+    """name -> BACKUP-side source name for the chosen generation (the
+    top-level object when its crc still matches, the archive copy
+    otherwise). `generation=None` means the newest."""
+    if generation is None or generation == m["generation"]:
+        return {n: n for n in m["objects"]}
+    gens = m.get("generations") or {}
+    objs = gens.get(str(int(generation)))
+    if objs is None:
+        have = ", ".join(sorted(gens, key=int)) or "none"
+        raise BackupCorruption(
+            f"generation {generation} is not retained by this backup "
+            f"(retained: {have})")
+    out: dict[str, str] = {}
+    for name, ent in objs.items():
+        cur = m["objects"].get(name)
+        out[name] = (name if cur is not None and cur["crc"] == ent["crc"]
+                     else _archive_name(name, ent["crc"]))
+    return out
+
+
+def restore_objects(backup: ObjectStore, dest: ObjectStore,
+                    generation: Optional[int] = None) -> dict:
     """Cold-start restore: verify the whole backup, then copy every
-    recorded object into `dest` (a FRESH primary store root). Returns
-    {objects, generation}. A destination that already holds a manifest
-    refuses — restoring over a live store would interleave two worlds."""
+    object of the chosen generation (default: newest) into `dest` (a
+    FRESH primary store root), resolving superseded bytes from the
+    archive. Returns {objects, generation}. A destination that already
+    holds a manifest refuses — restoring over a live store would
+    interleave two worlds."""
     from .hummock import MANIFEST_PATH
     if dest.exists(MANIFEST_PATH):
         raise BackupCorruption(
@@ -178,12 +316,41 @@ def restore_objects(backup: ObjectStore, dest: ObjectStore) -> dict:
         raise BackupCorruption(
             "backup has no BACKUP_MANIFEST ledger — cannot verify; "
             "use restore_store() to adopt an unverified legacy copy")
+    sources = _generation_objects(m, generation)
     last = _manifest_last()
-    ordered = ([n for n in sorted(m["objects"]) if n not in last]
-               + [n for n in last if n in m["objects"]])
+    ordered = ([n for n in sorted(sources) if n not in last]
+               + [n for n in last if n in sources])
     for n in ordered:
-        dest.upload(n, backup.read(n))
-    return {"objects": len(ordered), "generation": m["generation"]}
+        dest.upload(n, backup.read(sources[n]))
+    return {"objects": len(ordered),
+            "generation": m["generation"] if generation is None
+            else int(generation)}
+
+
+def extract_backup_prefix(backup: ObjectStore, prefix: str,
+                          dest: ObjectStore,
+                          generation: Optional[int] = None) -> int:
+    """Materialize the backup's auxiliary namespace `prefix/` (a broker
+    data directory) into `dest`, stripping the prefix — each object is
+    checksum-verified before it lands. Returns the object count."""
+    m = load_backup_manifest(backup)
+    if m is None:
+        raise BackupCorruption("backup has no BACKUP_MANIFEST ledger")
+    sources = _generation_objects(m, generation)
+    p = prefix.strip("/") + "/"
+    count = 0
+    for name in sorted(sources):
+        if not name.startswith(p):
+            continue
+        data = backup.read(sources[name])
+        want = (m["objects"][name]["crc"] if sources[name] == name
+                else int(sources[name].split("/", 2)[1], 16))
+        if zlib.crc32(data) != want:
+            raise BackupCorruption(
+                f"backup object {name!r} fails its checksum")
+        dest.upload(name[len(p):], data)
+        count += 1
+    return count
 
 
 def restore_store(backup: ObjectStore):

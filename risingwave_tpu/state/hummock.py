@@ -76,6 +76,48 @@ class SealedBatch:
         return not any(self.epochs.values())
 
 
+class CompactionTask:
+    """One planned background merge: a contiguous OLDEST tail of L0
+    (optionally plus L1). Planned on the event loop (`plan_compaction`
+    allocates the output id and snapshots the immutable input SsTables),
+    merged + uploaded on a worker thread (`merge_compaction` — touches
+    only the snapshot and the object store), installed back on the loop
+    at a commit point (`install_compaction` — one manifest swap). A crash
+    between merge and install leaves at worst an orphan output object
+    that the scrubber sweeps."""
+
+    __slots__ = ("run_ids", "ssts", "l1_id", "l1_sst", "into_l1",
+                 "out_sst_id", "out_epoch", "input_bytes", "data",
+                 "keys_in", "keys_out")
+
+    def __init__(self, runs: list["SsTable"], l1: Optional["SsTable"],
+                 into_l1: bool, out_sst_id: int):
+        self.run_ids = [t.sst_id for t in runs]   # newest-first, as in _l0
+        self.ssts = runs
+        self.l1_sst = l1
+        self.l1_id = l1.sst_id if l1 is not None else None
+        self.into_l1 = into_l1                    # output becomes the bottom
+        self.out_sst_id = out_sst_id
+        self.out_epoch = max([t.epoch for t in runs]
+                             + ([l1.epoch] if l1 is not None else []))
+        self.input_bytes = sum(_sst_bytes(t) for t in runs) \
+            + (_sst_bytes(l1) if l1 is not None else 0)
+        self.data: Optional[bytes] = None
+        self.keys_in = sum(len(t) for t in runs) \
+            + (len(l1) if l1 is not None else 0)
+        self.keys_out = 0
+
+    @property
+    def input_ids(self) -> list[int]:
+        return self.run_ids + ([self.l1_id] if self.l1_id is not None
+                               else [])
+
+
+def _sst_bytes(sst: SsTable) -> int:
+    return sum(len(k) for k in sst.keys) \
+        + sum(len(v) for v in sst.vals if v is not None)
+
+
 class HummockStateStore(StateStore):
     L0_COMPACT_THRESHOLD = 8
 
@@ -122,6 +164,15 @@ class HummockStateStore(StateStore):
         # next crash. Per-worker partial recovery RESTAGES these into
         # the shared buffer so the next checkpoint re-seals them.
         self._unconfirmed: list[SealedBatch] = []
+        # Inline compaction is the STANDALONE fallback (stores driven by
+        # sync() with no coordinator). When a BackgroundCompactor attaches
+        # it flips this off: the commit path then does O(1) work and the
+        # compactor owns every merge (state/compactor.py).
+        self.inline_compaction = True
+        # Output sst ids of in-flight background merges: the scrubber's
+        # orphan keep-set must cover them (the object exists before any
+        # manifest references it).
+        self.compaction_inflight: set[int] = set()
         if self.objects.exists(MANIFEST_PATH):
             self._load_manifest()
 
@@ -437,7 +488,8 @@ class HummockStateStore(StateStore):
             self._unconfirmed.append(batch)
             return {"uncommitted_ssts": new_ids}
         obsolete: list[int] = []
-        if len(self._l0) > self.L0_COMPACT_THRESHOLD:
+        if self.inline_compaction \
+                and len(self._l0) > self.L0_COMPACT_THRESHOLD:
             obsolete = self._compact()
         # manifest swap = the commit point; object deletes strictly after
         self._write_manifest()
@@ -459,7 +511,8 @@ class HummockStateStore(StateStore):
             self._l0.insert(0, self._read_sst(sst_id))
         self._committed_epoch = epoch
         obsolete: list[int] = []
-        if len(self._l0) > self.L0_COMPACT_THRESHOLD:
+        if self.inline_compaction \
+                and len(self._l0) > self.L0_COMPACT_THRESHOLD:
             obsolete = self._compact()
         self._write_manifest()
         for sst_id in obsolete:
@@ -501,6 +554,105 @@ class HummockStateStore(StateStore):
         self._l1 = SsTable.parse(sst_id, data)
         self._l0 = []
         return obsolete
+
+    # ------------------------------------- background compaction protocol
+    def l0_run_count(self) -> int:
+        return len(self._l0)
+
+    def read_amp(self) -> int:
+        """Sorted runs a point read may have to consult (L0 runs + L1)."""
+        return len(self._l0) + (1 if self._l1 is not None else 0)
+
+    def plan_compaction(self, floor_epoch: int, max_runs: int,
+                        max_bytes: int) -> Optional[CompactionTask]:
+        """Pick a bounded merge: the OLDEST contiguous tail of L0,
+        size-tiered (stop once the byte budget is spent), restricted to
+        runs at or below the pin floor — a run newer than the floor is
+        never rewritten, so no version or tombstone a pinned reader
+        could need is ever collapsed. When the selection covers all of
+        L0 the existing L1 joins (budget permitting) and the output
+        becomes the new bottom level, where tombstones drop; otherwise
+        the output is an L0 run at the tail position and tombstones are
+        carried (older runs below may still hold the key). Returns None
+        when nothing is eligible. Event-loop only (allocates the output
+        sst id and registers it with the scrubber keep-set)."""
+        assert self.manifest_owner, "only the manifest owner compacts"
+        eligible: list[SsTable] = []           # oldest-first
+        spent = 0
+        for sst in reversed(self._l0):
+            if sst.epoch > floor_epoch:
+                break
+            size = _sst_bytes(sst)
+            if eligible and (len(eligible) >= max_runs
+                             or spent + size > max_bytes):
+                break
+            eligible.append(sst)
+            spent += size
+        if not eligible:
+            return None
+        covers_l0 = len(eligible) == len(self._l0)
+        l1 = None
+        if covers_l0 and self._l1 is not None \
+                and spent + _sst_bytes(self._l1) <= max_bytes:
+            l1 = self._l1
+        into_l1 = covers_l0 and (l1 is not None or self._l1 is None)
+        if len(eligible) < 2 and not into_l1:
+            return None                        # a 1-run rewrite buys nothing
+        runs = list(reversed(eligible))        # back to newest-first order
+        task = CompactionTask(runs, l1, into_l1, self._next_sst_id)
+        self._next_sst_id += 1
+        self.compaction_inflight.add(task.out_sst_id)
+        return task
+
+    def merge_compaction(self, task: CompactionTask) -> None:
+        """Thread-safe merge + build + PUT of a planned task: touches only
+        the immutable input SsTables and the object store (the uploader
+        discipline of `upload_sealed`). A crash here leaves an orphan
+        output object no manifest references."""
+        merged: dict[bytes, Optional[bytes]] = {}
+        if task.l1_sst is not None:
+            merged.update(zip(task.l1_sst.keys, task.l1_sst.vals))
+        for sst in reversed(task.ssts):        # oldest -> newest overlay
+            merged.update(zip(sst.keys, sst.vals))
+        items = sorted((k, v) for k, v in merged.items()
+                       if v is not None or not task.into_l1)
+        task.keys_out = len(items)
+        data = build_sstable(task.out_epoch, items)
+        self.objects.upload(_sst_path(task.out_sst_id), data)
+        task.data = data
+
+    def install_compaction(self, task: CompactionTask) -> Optional[list[int]]:
+        """Commit point of a background merge (event loop only): swap the
+        merged output in for its inputs and write ONE manifest. Returns
+        the obsolete sst ids (already deleted — strictly after the
+        manifest landed), or None when the task no longer applies (the
+        manifest was reloaded underneath it: restore, quarantine reopen).
+        An abandoned output is an orphan the scrubber sweeps."""
+        assert self.manifest_owner and task.data is not None
+        k = len(task.run_ids)
+        tail = [t.sst_id for t in self._l0[-k:]]
+        l1_now = self._l1.sst_id if self._l1 is not None else None
+        if tail != task.run_ids \
+                or (task.l1_id is not None and l1_now != task.l1_id):
+            self.abandon_compaction(task)
+            return None
+        out = SsTable.parse(task.out_sst_id, task.data)
+        if task.into_l1:
+            self._l1 = out
+            self._l0 = self._l0[:-k]
+        else:
+            self._l0 = self._l0[:-k] + [out]
+        self._write_manifest()
+        self.compaction_inflight.discard(task.out_sst_id)
+        obsolete = task.input_ids
+        for sst_id in obsolete:
+            self.objects.delete(_sst_path(sst_id))
+        return obsolete
+
+    def abandon_compaction(self, task: CompactionTask) -> None:
+        """Drop a planned/merged task without installing it. The output
+        object (if uploaded) is left as an orphan for the scrubber."""
+        self.compaction_inflight.discard(task.out_sst_id)
 
     # ------------------------------------------------------------- helpers
     @classmethod

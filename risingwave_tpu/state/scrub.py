@@ -10,24 +10,30 @@ needs the bytes. Same shape here, collapsed to a coordinator-owned pulse:
   MANIFEST, CATALOG), a bounded `batch` per pulse, each read +
   crc-checked through `HummockStateStore.scrub_verify` — a transient
   mismatch re-reads once, a durable one quarantines + restores from the
-  attached backup (state/hummock.py read-path rules);
+  attached backup (state/hummock.py read-path rules). The reads run on
+  a WORKER THREAD (the uploader discipline — the barrier path never
+  pays an object fetch); each pulse harvests the previous job's
+  findings (counters, event-log records) and schedules the next one.
+  Without a running loop (unit tests driving pulses synchronously) the
+  job runs inline.
 * **orphan sweep**: SSTs visible under `ssts/` that no manifest
-  references and no sealed/unconfirmed batch is about to commit are
-  orphans (a crashed upload's leftovers — `upload_sealed` can always
-  leave one; they used to leak forever). An orphan is DELETED only after
-  being sighted in two consecutive pulses (grace: an object that appears
-  mid-pulse could be a racing upload's fresh PUT), and never in cluster
-  mode (meta cannot see worker uploads still in flight — it only counts
-  them there).
+  references and no sealed/unconfirmed batch or in-flight background
+  compaction is about to commit are orphans (a crashed upload's or an
+  abandoned merge's leftovers — they used to leak forever). An orphan
+  is DELETED only after being sighted in two consecutive pulses (grace:
+  an object that appears mid-pulse could be a racing upload's fresh
+  PUT), and never in cluster mode (meta cannot see worker uploads still
+  in flight — it only counts them there).
 
 Barrier-paced like the MemoryManager: `on_barrier` runs synchronously at
 every collected barrier, throttled to every `interval` barriers, so
-scrub work can never race an in-flight apply and a disabled scrubber
+scrub state can never race an in-flight apply and a disabled scrubber
 (interval=0) costs one integer compare per barrier.
 """
 
 from __future__ import annotations
 
+import asyncio
 from typing import Optional
 
 
@@ -46,6 +52,8 @@ class StorageScrubber:
         self.event_log = None
         # orphans sighted last pulse — the two-sighting sweep grace
         self._orphan_seen: set[str] = set()
+        # in-flight verification job (asyncio.to_thread)
+        self._job: Optional[asyncio.Task] = None
         # report surface (SHOW storage)
         self.passes = 0
         self.verified = 0
@@ -89,31 +97,39 @@ class StorageScrubber:
     def _pulse(self, cluster_mode: bool) -> None:
         from ..utils.metrics import (STORAGE_ORPHAN_OBJECTS,
                                      STORAGE_ORPHANS_SWEPT,
-                                     STORAGE_SCRUB_CORRUPTIONS,
-                                     STORAGE_SCRUB_OBJECTS,
                                      STORAGE_SCRUB_PASSES)
         store = self.store
         objects = store.objects
         self.passes += 1
         STORAGE_SCRUB_PASSES.inc()
-        # ---- verify a bounded slice of the referenced set ----
-        refs = self._referenced()
-        if refs:
-            for k in range(min(self.batch, len(refs))):
-                path = refs[(self._cursor + k) % len(refs)]
+        # ---- verify a bounded slice of the referenced set, OFF-LOOP ----
+        # harvest the previous job's findings first (reported here, at
+        # the barrier); a job still running skips one verification beat
+        schedule = True
+        if self._job is not None:
+            if self._job.done():
+                job, self._job = self._job, None
+                if not job.cancelled() and job.exception() is None:
+                    self._harvest(job.result())
+            else:
+                schedule = False
+        if schedule:
+            refs = self._referenced()
+            paths: list[str] = []
+            if refs:
+                paths = [refs[(self._cursor + k) % len(refs)]
+                         for k in range(min(self.batch, len(refs)))]
+                self._cursor = (self._cursor + self.batch) % len(refs)
+            if paths:
                 try:
-                    ok = store.scrub_verify(path)
-                except Exception:  # noqa: BLE001 — scrub never kills a barrier
-                    ok = False
-                self.verified += 1
-                STORAGE_SCRUB_OBJECTS.inc()
-                if not ok:
-                    self.corruptions += 1
-                    STORAGE_SCRUB_CORRUPTIONS.inc()
-                    if self.event_log is not None:
-                        self.event_log.emit("scrub_corruption",
-                                            path=path)
-            self._cursor = (self._cursor + self.batch) % len(refs)
+                    loop = asyncio.get_running_loop()
+                except RuntimeError:
+                    loop = None
+                if loop is None:         # synchronous harness
+                    self._harvest(self._verify_job(paths))
+                else:
+                    self._job = loop.create_task(asyncio.to_thread(
+                        self._verify_job, paths))
         # ---- orphan accounting + grace-period sweep ----
         from .hummock import _sst_path
         try:
@@ -124,11 +140,14 @@ class StorageScrubber:
         if store._l1 is not None:
             keep.add(_sst_path(store._l1.sst_id))
         # sealed-but-uncommitted and sealed-but-unconfirmed batches are
-        # IN FLIGHT, not orphaned — their commit installs them shortly
+        # IN FLIGHT, not orphaned — their commit installs them shortly;
+        # so is the output of a background merge awaiting its install
         for b in list(getattr(store, "_sealed", ())) \
                 + list(getattr(store, "_unconfirmed", ())):
             if b.sst_id is not None:
                 keep.add(_sst_path(b.sst_id))
+        for sst_id in getattr(store, "compaction_inflight", ()):
+            keep.add(_sst_path(sst_id))
         orphans = listed - keep
         self.orphans_live = len(orphans)
         STORAGE_ORPHAN_OBJECTS.set(float(len(orphans)))
@@ -152,6 +171,42 @@ class StorageScrubber:
             STORAGE_ORPHAN_OBJECTS.set(float(self.orphans_live))
         self._orphan_seen = orphans - {p for p in self._orphan_seen
                                        if p in orphans}
+
+    def _verify_job(self, paths: list[str]) -> list[tuple[str, bool]]:
+        """Worker-thread half: the object fetches + crc checks.
+        `scrub_verify` touches the object store only (quarantine/restore
+        included), so a thread can run it while the stream computes."""
+        out = []
+        for path in paths:
+            try:
+                ok = self.store.scrub_verify(path)
+            except Exception:  # noqa: BLE001 — scrub never kills a barrier
+                ok = False
+            out.append((path, ok))
+        return out
+
+    def _harvest(self, results: list[tuple[str, bool]]) -> None:
+        """Loop-side half: report the findings at the barrier."""
+        from ..utils.metrics import (STORAGE_SCRUB_CORRUPTIONS,
+                                     STORAGE_SCRUB_OBJECTS)
+        for path, ok in results:
+            self.verified += 1
+            STORAGE_SCRUB_OBJECTS.inc()
+            if not ok:
+                self.corruptions += 1
+                STORAGE_SCRUB_CORRUPTIONS.inc()
+                if self.event_log is not None:
+                    self.event_log.emit("scrub_corruption", path=path)
+
+    async def drain(self) -> None:
+        """Quiesce: wait out an in-flight verification job and report
+        its findings (recovery/shutdown/tests)."""
+        if self._job is not None:
+            job, self._job = self._job, None
+            try:
+                self._harvest(await job)
+            except Exception:  # noqa: BLE001
+                pass
 
     # ----------------------------------------------------------- report
     def report(self) -> dict:

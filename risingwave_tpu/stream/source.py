@@ -49,6 +49,9 @@ class SourceExecutor(Executor):
             splits = [(0, connector)]
         assert splits and all(c is not None for _, c in splits)
         self.splits = list(splits)
+        # (epoch, {split_id: offset}) snapshots taken at each offset
+        # commit — the broker retention plane's durable-floor source
+        self.offset_history: list[tuple[int, dict]] = []
         self.connector = self.splits[0][1]
         self.schema = self.connector.schema
         self.barrier_queue = barrier_queue
@@ -135,6 +138,16 @@ class SourceExecutor(Executor):
         self.state_table.write_chunk_rows(
             [(0, (sid, conn.offset)) for sid, conn in self.splits])
         self.state_table.commit(barrier.epoch.curr)
+        # Committed-offset history for the broker retention plane: the
+        # rows above are STAGED at barrier.epoch.prev (StateTable.commit
+        # writes at the pre-advance epoch), so they are durable once the
+        # store's committed epoch reaches it. The retention manager takes
+        # the newest snapshot at-or-below the committed epoch — never the
+        # live connector offset, which runs ahead of the checkpoint.
+        self.offset_history.append(
+            (barrier.epoch.prev,
+             {sid: int(conn.offset) for sid, conn in self.splits}))
+        del self.offset_history[:-16]
 
     # ------------------------------------------------- split observability
     def _update_split_metrics(self) -> None:

@@ -36,6 +36,10 @@ Fault points (site → effect when the rule fires):
                   fail-stops the next injection exactly like an upload
                   failure; the re-delivered batch dedupes on the seq
                   persisted in the topic; filter `topic=`/`seq=`)
+  compaction_merge    state/compactor.py _merge — the background
+                  merge thread raises before rewriting (exercises the
+                  orphan-at-worst invariant: the planned task abandons,
+                  the trigger refires; filter `sst_id=`)
   object_put_fail state/object_store.py ResilientObjectStore — an
                   object PUT raises a TRANSIENT error below the retry
                   layer: with occurrence counts under the retry budget

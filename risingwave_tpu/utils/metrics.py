@@ -369,6 +369,28 @@ BACKUP_OBJECTS_SKIPPED = GLOBAL_METRICS.counter(
     "backup_objects_skipped_total")
 BACKUP_GENERATION = GLOBAL_METRICS.gauge("backup_last_generation")
 
+# Compaction & retention plane (state/compactor.py): background merges
+# off the commit path. Bytes rewritten + run count are the write-
+# amplification record; the L0/read-amp gauges are the health line the
+# soak gate asserts bounded; the per-source retention floor gauges show
+# WHAT is holding GC back (-1 = source pins nothing).
+COMPACTION_RUNS = GLOBAL_METRICS.counter("compaction_runs_total")
+COMPACTION_BYTES_REWRITTEN = GLOBAL_METRICS.counter(
+    "compaction_bytes_rewritten_total")
+COMPACTION_SECONDS = GLOBAL_METRICS.histogram("compaction_seconds")
+LSM_L0_RUNS = GLOBAL_METRICS.gauge("lsm_l0_runs")
+LSM_READ_AMP = GLOBAL_METRICS.gauge("lsm_read_amp")
+RETENTION_SEGMENTS_DROPPED = GLOBAL_METRICS.counter(
+    "broker_retention_segments_dropped_total")
+
+
+def retention_floor_gauge(source: str):
+    """Per-pin-source floor gauge `retention_floor_epoch{source=...}` —
+    labelled series ride the registry on demand (registry dedups by
+    (name, labels), so this is idempotent)."""
+    return GLOBAL_METRICS.gauge("retention_floor_epoch", source=source)
+
+
 # Source split observability (stream/source.py): per-split labelled
 # gauges `source_split_offset{source,split}` (rows consumed by the
 # split, refreshed at barrier cadence) and `source_lag_rows{source,

@@ -1,0 +1,238 @@
+"""Compaction & retention soak gate — a compressed 7-day churn run.
+
+Two identical durable churn runs (nexmark bid -> filtered MV, with the
+scrubber on and two mid-run backup generations), one with the
+background compactor ENABLED (the default) and one with it DISABLED
+(SET compaction_interval = 0, the inline commit-path fallback). The
+enabled run must show a compacted LSM with no loop-side cost:
+
+  * the commit path never runs a full-state merge — the store's
+    inline_compaction flag stays off for the whole enabled run, every
+    merge lands through the background install path
+    (compactor.runs_total > 0);
+  * L0 depth and read amplification stay BOUNDED at every soak
+    checkpoint (depth <= trigger + in-flight slack, read amp <=
+    depth + 1) while the disabled run's L0 saws up to the inline
+    threshold;
+  * barrier p50 with the compactor is no worse than with compaction
+    disabled (tolerance 1.5x for CPU timing noise) — merging off the
+    loop must not slow the loop;
+  * the scrubber is CLEAN at every checkpoint: zero corruptions, and
+    no object referenced by the manifest, a pinned snapshot, or a
+    backup generation was deleted (verify_backup passes over BOTH
+    retained generations at the end, point-in-time restore intact);
+  * a NEW MV created mid-churn (after merges have rewritten history)
+    backfills to exactly the same rows as the original — compaction
+    never changes what a backfill reads;
+  * the final MV is BIT-IDENTICAL to a numpy recount of the generator
+    prefix at the committed source offset (exactly-once under churn).
+
+Prints one JSON report; exits non-zero if any bound fails.
+
+CI usage (CPU backend):
+
+    JAX_PLATFORMS=cpu python scripts/compaction_profile.py
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from risingwave_tpu.utils.compile_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+BARRIERS = 48
+CHECK_EVERY = 8
+PRICE_FLOOR = 5_000_000
+P50_TOLERANCE = 1.5
+L0_TRIGGER = 4
+
+
+def _ddl() -> list:
+    return [
+        "SET streaming_watchdog = 0",
+        "SET storage_scrub_interval = 4",
+        "SET storage_scrub_batch = 8",
+        f"SET compaction_l0_trigger = {L0_TRIGGER}",
+        ("CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+         "chunk_size=128, rate_limit=512)"),
+        ("CREATE MATERIALIZED VIEW mv AS SELECT auction, price FROM bid "
+         f"WHERE price > {PRICE_FLOOR}"),
+    ]
+
+
+def _oracle(offset: int) -> Counter:
+    """Numpy recount of the bid generator prefix at the committed
+    offset — the exactly-once convergence target."""
+    import numpy as np
+    from risingwave_tpu.connectors import NexmarkGenerator
+    gen = NexmarkGenerator("bid", chunk_size=max(256, offset))
+    c = gen.next_chunk()
+    auction = np.asarray(c.columns[0].data)[:offset]
+    price = np.asarray(c.columns[2].data)[:offset]
+    keep = price > PRICE_FLOOR
+    return Counter(zip(auction[keep].tolist(), price[keep].tolist()))
+
+
+def _committed_offset(session, mv: str = "mv") -> int:
+    from risingwave_tpu.state.storage_table import StorageTable
+    from risingwave_tpu.stream.source import SourceExecutor
+    dep = session.catalog.mvs[mv].deployment
+    for roots in dep.roots.values():
+        for root in roots:
+            node = root
+            while node is not None:
+                if isinstance(node, SourceExecutor):
+                    rows = list(StorageTable.for_state_table(
+                        node.state_table).batch_iter())
+                    return int(rows[0][1]) if rows else 0
+                node = getattr(node, "input", None)
+    raise AssertionError("no source executor")
+
+
+async def _churn(tmp: str, enabled: bool) -> dict:
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.state import HummockStateStore, LocalFsObjectStore
+    from risingwave_tpu.state.backup import verify_backup
+
+    root = os.path.join(tmp, "enabled" if enabled else "disabled")
+    bak_dir = os.path.join(root, "bak")
+    s = Session(store=HummockStateStore(
+        LocalFsObjectStore(os.path.join(root, "live"))))
+    for sql in _ddl():
+        await s.execute(sql)
+    if not enabled:
+        await s.execute("SET compaction_interval = 0")
+
+    barrier_s: list = []
+    checkpoints: list = []
+    failures: list = []
+    backfill_ok = None
+    for i in range(1, BARRIERS + 1):
+        t0 = time.monotonic()
+        await s.tick(1)
+        barrier_s.append(time.monotonic() - t0)
+        if enabled and s.store.inline_compaction:
+            failures.append(f"inline merge re-enabled at barrier {i}")
+        if i == BARRIERS // 3:
+            await s.execute(f"BACKUP TO '{bak_dir}'")      # generation 1
+        if i == 2 * BARRIERS // 3:
+            await s.execute(f"BACKUP TO '{bak_dir}'")      # generation 2
+            # stable backfill: a NEW MV over history the compactor has
+            # already rewritten must read the same world
+            await s.execute(
+                "CREATE MATERIALIZED VIEW mv2 AS SELECT auction, price "
+                f"FROM bid WHERE price > {PRICE_FLOOR}")
+        if i % CHECK_EVERY == 0:
+            scrub = s.coord.scrubber.report()
+            cp = {
+                "barrier": i,
+                "l0_runs": s.store.l0_run_count(),
+                "read_amp": s.store.read_amp(),
+                "scrub_corruptions": scrub["corruptions"],
+            }
+            checkpoints.append(cp)
+            if scrub["corruptions"]:
+                failures.append(f"scrub corruption at barrier {i}: {scrub}")
+            if enabled:
+                # bounded depth: the trigger plus one landing run and
+                # one in-flight merge output of slack
+                if cp["l0_runs"] > L0_TRIGGER + 3:
+                    failures.append(
+                        f"L0 depth {cp['l0_runs']} exceeds bound "
+                        f"at barrier {i}")
+                if cp["read_amp"] > L0_TRIGGER + 4:
+                    failures.append(
+                        f"read amp {cp['read_amp']} exceeds bound "
+                        f"at barrier {i}")
+
+    # the new MV's backfill reads the same world: bit-identical to the
+    # generator oracle at ITS committed offset (it may still be
+    # catching up to mv under the rate limit — correctness, not lag)
+    got_mv = Counter(s.query("SELECT auction, price FROM mv"))
+    got_mv2 = Counter(s.query("SELECT auction, price FROM mv2"))
+    offset2 = _committed_offset(s, "mv2")
+    backfill_ok = got_mv2 == _oracle(offset2)
+    if not backfill_ok:
+        failures.append(
+            f"backfilled mv2 diverged from the oracle at offset "
+            f"{offset2} ({sum(got_mv2.values())} rows)")
+
+    # bit-identical to the generator-prefix oracle
+    offset = _committed_offset(s)
+    expected = _oracle(offset)
+    converged = got_mv == expected
+    if not converged:
+        failures.append(
+            f"final MV diverged from the oracle at offset {offset}")
+
+    # no object any backup generation references was deleted: both
+    # retained generations still verify end to end
+    from risingwave_tpu.state import LocalFsObjectStore as _Fs
+    ledger = verify_backup(_Fs(bak_dir))
+    generations = sorted(int(g) for g in (ledger.get("generations") or {}))
+
+    comp = s.coord.compactor
+    srt = sorted(barrier_s)
+    out = {
+        "enabled": enabled,
+        "barriers": BARRIERS,
+        "barrier_p50_ms": round(srt[len(srt) // 2] * 1e3, 2),
+        "barrier_p90_ms": round(srt[int(len(srt) * 0.9)] * 1e3, 2),
+        "final_l0_runs": s.store.l0_run_count(),
+        "final_read_amp": s.store.read_amp(),
+        "compaction_runs": comp.runs_total,
+        "bytes_rewritten": comp.bytes_rewritten_total,
+        "merge_failures": comp.merge_failures,
+        "installs_abandoned": comp.installs_abandoned,
+        "mv_rows": sum(got_mv.values()),
+        "offset": offset,
+        "converged": converged,
+        "backfill_ok": backfill_ok,
+        "backup_generations": generations,
+        "checkpoints": checkpoints,
+        "failures": failures,
+    }
+    if enabled and comp.runs_total == 0:
+        failures.append("compactor never ran a background merge")
+    if enabled and len(generations) < 2:
+        failures.append(f"expected 2 retained generations, got "
+                        f"{generations}")
+    await s.drop_all()
+    return out
+
+
+async def main() -> int:
+    import tempfile
+    with tempfile.TemporaryDirectory(prefix="compaction_gate_") as tmp:
+        enabled = await _churn(tmp, enabled=True)
+        disabled = await _churn(tmp, enabled=False)
+    failures = list(enabled["failures"]) + [
+        f"[disabled] {f}" for f in disabled["failures"]]
+    # the loop-cost acceptance bound: background merging must not slow
+    # the barrier path relative to no compaction at all
+    if enabled["barrier_p50_ms"] > disabled["barrier_p50_ms"] * P50_TOLERANCE:
+        failures.append(
+            f"barrier p50 regressed: {enabled['barrier_p50_ms']}ms with "
+            f"compactor vs {disabled['barrier_p50_ms']}ms without")
+    report = {
+        "enabled": enabled,
+        "disabled": disabled,
+        "p50_ratio": round(enabled["barrier_p50_ms"]
+                           / max(disabled["barrier_p50_ms"], 1e-6), 3),
+        "failures": failures,
+        "ok": not failures,
+    }
+    print(json.dumps(report, indent=2))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))
