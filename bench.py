@@ -1250,6 +1250,20 @@ def _one_query_main(query: str) -> None:
                 print(json.dumps(r), file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             print(f"(event log dump failed: {e!r})", file=sys.stderr)
+        try:
+            # barrier-paced history of the stall-relevant series: the
+            # last K samples show WHICH resource was moving (or pinned)
+            # when the deadline hit — queue depths, inflight ckpts,
+            # source lag, HBM state bytes
+            hist = getattr(s, "metrics_history", None) \
+                or getattr(s.coord, "metrics_history", None)
+            if hist is not None and len(hist):
+                print("-- metrics history tail (stall series) --",
+                      file=sys.stderr)
+                print(hist.dump_tail(), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"(metrics history dump failed: {e!r})",
+                  file=sys.stderr)
         sys.stderr.flush()
 
     def _bail(reason: str = ""):

@@ -202,6 +202,39 @@ class PartitionLog:
                 p += ln
         return out
 
+    def fetch_metas(self, offset: int, max_records: int) -> list:
+        """`[[base_offset, meta], ...]` for every meta-carrying batch
+        overlapping [offset, offset + max_records) — the side channel a
+        consumer reads producer-stamped batch metadata (sink sequence
+        numbers, cross-engine trace context) from without touching the
+        record bytes. Separate from `fetch` so the record path keeps
+        its exact shape."""
+        if offset >= self.next_offset or max_records <= 0:
+            return []
+        end = offset + max_records
+        out = []
+        for base, n, seg_path, pos in self._index:
+            if base + n <= offset:
+                continue
+            if base >= end:
+                break
+            try:
+                with open(seg_path, "rb") as f:
+                    f.seek(pos)
+                    body_len, _crc = _FRAME.unpack(f.read(_FRAME.size))
+                    body = f.read(body_len)
+            except FileNotFoundError:
+                break                       # racing retention drop
+            _base, _n, meta_len = _HDR.unpack_from(body)
+            if meta_len:
+                try:
+                    meta = json.loads(
+                        body[_HDR.size:_HDR.size + meta_len])
+                except ValueError:
+                    continue
+                out.append([base, meta])
+        return out
+
     @property
     def high_watermark(self) -> int:
         return self.next_offset

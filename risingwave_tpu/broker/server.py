@@ -143,7 +143,11 @@ class Broker:
         return {"records": recs,
                 "next_offset": offset + len(recs),
                 "high_watermark": log.high_watermark,
-                "log_start_offset": log.start_offset}
+                "log_start_offset": log.start_offset,
+                # producer-stamped batch metadata overlapping the range
+                # (sink seq + cross-engine trace context): consumers
+                # use it for ingest-span links, everyone else ignores it
+                "metas": log.fetch_metas(offset, len(recs))}
 
     def high_watermark(self, topic: str, partition: int) -> int:
         return self._part(topic, partition).high_watermark

@@ -42,11 +42,14 @@ class _MonitorShim:
     def __init__(self, node: "ComputeNode"):
         self._node = node
         self.recoveries = 0
-        # worker processes keep an in-memory event ring (no durable
-        # root: meta owns the durable log next to the object store)
-        from ..meta.event_log import EventLog
-        self.event_log = EventLog(None)
         self.recovery_ring = None
+
+    @property
+    def event_log(self):
+        # the node's OWN log (durable once hello opened the store):
+        # /debug/events on a worker's monitor port reads the same
+        # records meta stitches into the cluster-wide view
+        return self._node.event_log
 
     @property
     def coord(self):
@@ -87,6 +90,13 @@ class ComputeNode:
         # (piggybacked on the sealed report — the distributed-trace
         # bundle of utils/trace.py)
         self._shipped_spans: set[int] = set()
+        # worker-local event log: in-memory ring until hello opens the
+        # store, then crc-framed segments under the shared root
+        # (subdir events_w<id>) — incident records survive THIS
+        # worker's own crash and meta stitches them into SHOW events
+        from ..meta.event_log import EventLog
+        self.event_log = EventLog(None)
+        self._store_root: Optional[str] = None
 
     # --------------------------------------------------------- RPC surface
     async def handle(self, method: str, args: dict):
@@ -114,6 +124,8 @@ class ComputeNode:
         # the CLI's --monitor-port wins over meta's (operator-pinned)
         monitor_port = self.config.pop("__monitor_port", 0) or monitor_port
         self._fresh_coordinator(config)
+        self.event_log.emit("worker_boot", worker_id=worker_id,
+                            pid=os.getpid())
         if monitor_port:
             from ..meta.monitor_service import MonitorService
             self.monitor = await MonitorService(
@@ -130,6 +142,19 @@ class ComputeNode:
         store.manifest_owner = False
         store.set_sst_id_block(sst_id_base)
         self.store = store
+        self._store_root = spec["root"]
+        self._reopen_event_log()
+
+    def _reopen_event_log(self) -> None:
+        """Durable worker-local log once both identity and store root
+        are known; reopening replays the previous incarnation's tail
+        (torn-tail framing), so the crash IS in the record."""
+        from ..meta.event_log import EventLog
+        if self.worker_id is None or not self._store_root:
+            return
+        self.event_log.close()
+        self.event_log = EventLog(
+            self._store_root, subdir=f"events_w{self.worker_id}")
 
     def _fresh_coordinator(self, config: dict) -> None:
         from ..meta.barrier_manager import BarrierCoordinator
@@ -261,6 +286,9 @@ class ComputeNode:
                       actors=p["actors"], tables=p["tables"],
                       schemas=p["schemas"], scope=p["scope"],
                       ddl_config=p["ddl_config"]))
+        self.event_log.emit(
+            "deploy", deploy_id=deploy_id, scope=p["scope"],
+            actors=sorted(a.actor_id for a in dep.actors))
         return {"actors": sorted(a.actor_id for a in dep.actors)}
 
     # ------------------------------------------------------------ barriers
@@ -300,6 +328,10 @@ class ComputeNode:
         except ConnectionResetError:
             pass                      # meta gone; process will be reset
         except Exception as e:  # noqa: BLE001 — local actor death
+            self.event_log.emit(
+                "actor_failed", error=f"{type(e).__name__}: {e}",
+                actors=sorted(a for a in self.coord.failed_actors
+                              if a > 0))
             try:
                 # the failed actor ids let meta scope the radius to
                 # their downstream closure (worker-partial recovery)
@@ -645,6 +677,8 @@ class ComputeNode:
         d = self.deployments.pop(deploy_id, None)
         if d is not None:
             await self._teardown(d)
+            self.event_log.emit("stop_deployment", deploy_id=deploy_id,
+                                scope=d["info"].get("scope"))
         return {}
 
     async def _teardown(self, d: dict) -> None:
@@ -695,9 +729,19 @@ class ComputeNode:
         if store is not None:
             self._open_store(store, sst_id_base or 1)
         self._fresh_coordinator({})
+        self.event_log.emit(
+            "worker_reset",
+            committed_epoch=self.store.committed_epoch())
         return {"committed_epoch": self.store.committed_epoch()}
 
     # -------------------------------------------------------- observability
+    async def rpc_events(self, limit=None, since=None, kind=None):
+        """This node's local event records (worker-local crc-framed
+        log) — meta stitches them into SHOW events / /debug/events
+        tagged worker=wN."""
+        return self.event_log.records(limit=limit, since=since,
+                                      kind=kind)
+
     async def rpc_scrape(self):
         """This node's full metrics exposition — meta's monitor merges it
         into the cluster-wide /metrics with a worker label."""
@@ -747,6 +791,7 @@ class ComputeNode:
         if self.monitor is not None:
             await self.monitor.stop()
             self.monitor = None
+        self.event_log.close()
 
 
 async def serve_connection(reader, writer, first_msg: dict,

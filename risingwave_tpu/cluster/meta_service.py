@@ -763,6 +763,23 @@ class ClusterManager:
                 pass
         return rows
 
+    async def events_all(self, limit=None, kind=None,
+                         since=None) -> dict[int, list]:
+        """worker_id -> that node's worker-local event records — SHOW
+        events / /debug/events stitch them (tagged worker=wN) into one
+        cluster-wide incident timeline. Best-effort: an unreachable
+        worker contributes nothing (its durable log is read on the
+        next query once it re-registers)."""
+        out: dict[int, list] = {}
+        for h in self.live_workers():
+            try:
+                out[h.worker_id] = await h.call(
+                    "events", timeout=10, limit=limit, kind=kind,
+                    since=since)
+            except Exception:  # noqa: BLE001 — observability best-effort
+                pass
+        return out
+
     async def dump_tasks_all(self) -> dict[int, str]:
         """worker_id -> that node's own stuck-barrier report (in-flight
         epochs with remaining LOCAL actors + its await tree) — the

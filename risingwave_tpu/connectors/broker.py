@@ -129,6 +129,10 @@ class BrokerPartitionConnector:
         self.offset = 0
         self._hwm = 0                 # cached high watermark
         self._last_rows = 0
+        # upstream trace contexts read from fetched batch metas: staged
+        # here, drained by the barrier coordinator into the epoch trace
+        # as "in" links (utils/trace.py cross-engine stitching)
+        self._trace_links: list = []
 
     @property
     def last_chunk_rows(self) -> int:
@@ -174,7 +178,22 @@ class BrokerPartitionConnector:
         self._hwm = res["high_watermark"]
         self.offset = res["next_offset"]
         self._last_rows = len(records)
+        for base, meta in res.get("metas") or ():
+            ctx = meta.get("trace") if isinstance(meta, dict) else None
+            if ctx and len(self._trace_links) < 256:
+                self._trace_links.append({
+                    "dir": "in", "topic": self.topic,
+                    "partition": self.partition, "offset": int(base),
+                    "peer": ctx.get("span"),
+                    "peer_engine": ctx.get("engine"),
+                    "peer_epoch": ctx.get("epoch")})
         return _parse_records(self.schema, records, self.chunk_size)
+
+    def drain_trace_links(self) -> list:
+        """Ingest-span link records staged since the last drain (the
+        coordinator attaches them to the closing epoch's trace)."""
+        out, self._trace_links = self._trace_links, []
+        return out
 
 
 class BrokerSplitEnumerator:
@@ -248,6 +267,11 @@ class BrokerSink:
         self.brokers = brokers
         self.topic = topic
         self.schema = schema
+        # cross-engine trace stamping (plan/build.py attaches both):
+        # every delivered batch's meta carries (engine_id, epoch, span)
+        # so the consuming engine can link its ingest span back here
+        self.engine_id = None
+        self.tracer = None
         self.client = BrokerClient(brokers)
         self.n_partitions = self.client.create_topic(
             topic=topic, partitions=partitions)
@@ -268,10 +292,26 @@ class BrokerSink:
                    if self.schema is not None
                    else json.dumps({"__op": op, "vals": list(vals)}).encode()
                    for op, vals in rows]
-        self.client.append(self.topic, seq % self.n_partitions, records,
-                           meta={"seq": seq, "epoch": epoch})
+        meta = {"seq": seq, "epoch": epoch}
+        span = None
+        if self.engine_id is not None:
+            span = f"{self.engine_id}/e{int(epoch)}/s{int(seq)}"
+            meta["trace"] = {"engine": str(self.engine_id),
+                             "epoch": int(epoch), "span": span}
+        partition = seq % self.n_partitions
+        base = self.client.append(self.topic, partition, records,
+                                  meta=meta)
         self._committed = seq
         self.rows_appended += len(records)
+        if span is not None and self.tracer is not None:
+            try:
+                self.tracer.add_links(int(epoch), [{
+                    "dir": "out", "topic": self.topic,
+                    "partition": partition,
+                    "offset": int(base) if base is not None else None,
+                    "span": span, "engine": str(self.engine_id)}])
+            except Exception:
+                pass
 
     def committed_seq(self) -> int:
         return self._committed

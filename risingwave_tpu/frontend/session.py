@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -88,6 +89,10 @@ class Catalog:
 
 
 CATALOG_PATH = "CATALOG"
+
+# per-process engine counter: two Sessions in one process (the stitched
+# cross-engine gate does exactly that) must not share an engine id
+_ENGINE_SEQ = 0
 
 
 def _parse_metric_level(v) -> str:
@@ -214,6 +219,23 @@ class Session:
         # than this logs format_stuck_barrier_report once and bumps
         # barrier_stalls_total; 0 disables the watchdog
         "barrier_stall_threshold_ms": (60000, int),
+        # ---- metrics history (utils/metrics_history.py) ----
+        # sample the allowlisted series every N collected barriers into
+        # bounded per-series rings (the rw_metrics system table + the
+        # autoscaler's time-series substrate). 0 disables sampling.
+        "metrics_history_interval": (1, int),
+        # newest samples kept per series at full resolution; the same
+        # count again survives downsampled (every k-th evicted sample)
+        "metrics_history_retention": (512, int),
+        # coarse-tier keep ratio: 1 of every k evicted samples survives
+        "metrics_history_downsample": (8, int),
+        # comma-separated series allowlist; '' = the built-in default
+        # (barrier latency, exchange pressure, source lag, HBM, ...)
+        "metrics_history_series": ("", str),
+        # 1 = also append each pulse to a crc-framed log next to the
+        # event log (subdir "metrics", torn-tail framing) so rw_metrics
+        # history survives a restart; 0 (default) = ring only
+        "metrics_history_durable": (0, int),
         # 1 (default): exchange channels buffer the uncommitted message
         # suffix (trimmed at every checkpoint commit) and an actor
         # failure whose blast radius is contained to ONE terminal
@@ -332,6 +354,22 @@ class Session:
         # survives the coordinator swap a full recovery performs.
         from ..meta.event_log import EventLog
         self.event_log = EventLog(getattr(objects, "root", None))
+        # barrier-paced metrics history (utils/metrics_history.py),
+        # session-owned like the event log (a recovery's coordinator
+        # swap must not truncate telemetry history); _apply_obs_config
+        # points the live coordinator at it
+        from ..utils.metrics_history import MetricsHistory
+        self.metrics_history = MetricsHistory()
+        # engine identity stamped into broker sink batch metas so a
+        # downstream engine's ingest spans link back across the broker
+        # (utils/trace.py stitch_chrome_traces); unique per process
+        global _ENGINE_SEQ
+        _ENGINE_SEQ += 1
+        self.engine_id = f"engine-{os.getpid()}-{_ENGINE_SEQ}"
+        # worker-local event records last stitched by the cluster
+        # SHOW events / /debug/events fan-out (worker_id -> records);
+        # the rw_events system table reads this cache synchronously
+        self._worker_events_cache: dict = {}
         # recovery post-mortem spans, session-owned for the same reason
         # (/debug/traces must describe the recovery that replaced the
         # coordinator whose tracer used to hold them)
@@ -413,6 +451,17 @@ class Session:
         self.coord.event_log = self.event_log
         self.coord.scrubber.event_log = self.event_log
         self.coord.logstore.event_log = self.event_log
+        # metrics history: session-owned store, coordinator-paced pulse
+        objects = getattr(self.store, "objects", None)
+        durable = bool(self.config.get("metrics_history_durable", 0))
+        root = getattr(objects, "root", None) if durable else None
+        self.metrics_history.configure(
+            interval=self.config.get("metrics_history_interval", 1),
+            retention=self.config.get("metrics_history_retention", 512),
+            downsample=self.config.get("metrics_history_downsample", 8),
+            series=self.config.get("metrics_history_series", ""),
+            root=root)
+        self.coord.metrics_history = self.metrics_history
 
     def _apply_logstore_config(self) -> None:
         """Plumb the log-store session vars to the live hub (re-applied
@@ -697,10 +746,16 @@ class Session:
             return self.explain_mv(stmt.name)
         if isinstance(stmt, ast.Show):
             if self.cluster is not None and stmt.what in ("cluster",
-                                                          "memory"):
-                return await self._show_cluster(stmt.what)
+                                                          "memory",
+                                                          "events"):
+                return await self._show_cluster(
+                    stmt.what, limit=getattr(stmt, "limit", None),
+                    kind=getattr(stmt, "kind", None),
+                    since=getattr(stmt, "since", None))
             return self.show(stmt.what,
-                             limit=getattr(stmt, "limit", None))
+                             limit=getattr(stmt, "limit", None),
+                             kind=getattr(stmt, "kind", None),
+                             since=getattr(stmt, "since", None))
         if isinstance(stmt, ast.SetVar):
             if stmt.name not in self.CONFIG_VARS:
                 raise BindError(f"unknown session variable {stmt.name!r}")
@@ -729,7 +784,12 @@ class Session:
                 # runtime-mutable on the live ServingManager/pool
                 self._apply_serving_config()
             elif stmt.name in ("metric_level",
-                               "barrier_stall_threshold_ms"):
+                               "barrier_stall_threshold_ms",
+                               "metrics_history_interval",
+                               "metrics_history_retention",
+                               "metrics_history_downsample",
+                               "metrics_history_series",
+                               "metrics_history_durable"):
                 # runtime-mutable: re-instruments live actors / adjusts
                 # the stuck-barrier watchdog (cluster-wide when attached)
                 self._apply_obs_config()
@@ -992,9 +1052,33 @@ class Session:
         await mgr.connect()
         self.cluster = mgr
 
-    async def _show_cluster(self, what: str) -> list:
+    async def _show_cluster(self, what: str, limit=None, kind=None,
+                            since=None) -> list:
         if what == "cluster":
             return self.cluster.registry_rows()
+        if what == "events":
+            # meta's own records plus every worker's local log, stitched
+            # on the wall timestamp and tagged by origin — the incident
+            # record survives any single worker's crash
+            per_worker = await self.cluster.events_all(
+                limit=limit, kind=kind, since=since)
+            self._worker_events_cache = per_worker
+            merged = [("meta", r) for r in self.event_log.records(
+                limit=limit, kind=kind, since=since)]
+            for wid, recs in sorted(per_worker.items()):
+                merged.extend((f"w{wid}", r) for r in recs)
+            merged.sort(key=lambda e: e[1].get("ts", 0))
+            if limit is not None:
+                merged = merged[-int(limit):]
+            rows = []
+            for origin, r in merged:
+                extra = {k: v for k, v in r.items()
+                         if k not in ("seq", "ts", "kind")}
+                rows.append((origin, str(r.get("seq", "")),
+                             f"{r.get('ts', 0):.3f}", r.get("kind"),
+                             json.dumps(extra, sort_keys=True,
+                                        default=str)))
+            return rows
         # SHOW memory, cluster-wide: the meta rows (usually none — the
         # actors live in the workers) plus every worker's, labelled
         rows = [(r["executor"], str(r["state_bytes"]),
@@ -1007,14 +1091,16 @@ class Session:
                          str(r["spilled_rows"])))
         return rows
 
-    def show(self, what: str, limit=None) -> list:
+    def show(self, what: str, limit=None, kind=None, since=None) -> list:
         """SHOW <objects|variable> (reference: handler/show.rs +
         session_config reads)."""
         if what == "events":
             # the durable event log, newest last: (seq, ts, kind,
-            # details-json) — `SHOW events LIMIT n` bounds the tail
+            # details-json). Filter parity with /debug/events:
+            # `SHOW events KIND 'recovery' SINCE <ts> LIMIT n`
             rows = []
-            for r in self.event_log.records(limit=limit or 32):
+            for r in self.event_log.records(limit=limit or 32,
+                                            kind=kind, since=since):
                 extra = {k: v for k, v in r.items()
                          if k not in ("seq", "ts", "kind")}
                 rows.append((str(r["seq"]),
@@ -2192,6 +2278,7 @@ class Session:
         await self.stop_monitor()
         await self.stop_subscription_server()
         self.event_log.close()
+        self.metrics_history.close()
         if self.cluster is not None:
             for name in reversed(list(self.catalog.sinks)):
                 sink = self.catalog.sinks.pop(name)
@@ -2234,8 +2321,14 @@ class Session:
         falls back to the full-scan path."""
         from .batch import run_batch_select_full
         from ..serving.executor import rel_mv_names, run_pinned_select
+        from .system_tables import SYSTEM_TABLES, make_system_scan
         serving = self.coord.serving
         names = rel_mv_names(sel.rel)
+        if names and any(n in SYSTEM_TABLES for n in names):
+            # rw_* system tables: synthesized relations through the
+            # stock batch pipeline (they are not MVs — never pinned)
+            return run_batch_select_full(
+                self.catalog, sel, scan=make_system_scan(self))
         pins = serving.pin(names) if names else None
         if pins is None:
             return run_batch_select_full(self.catalog, sel)
@@ -2254,8 +2347,12 @@ class Session:
         committed-snapshot scan) and mark their MVs wanted."""
         from .batch import run_batch_select_full
         from ..serving.executor import rel_mv_names, run_pinned_select
+        from .system_tables import SYSTEM_TABLES, make_system_scan
         serving = self.coord.serving
         names = rel_mv_names(sel.rel)
+        if names and any(n in SYSTEM_TABLES for n in names):
+            return run_batch_select_full(
+                self.catalog, sel, scan=make_system_scan(self))
         pins = serving.pin(names) if names else None
         if pins is None:
             return run_batch_select_full(self.catalog, sel)

@@ -209,6 +209,10 @@ class RestoreStmt:
 class Show:
     what: str           # sources|tables|materialized_views|sinks|all|<var>
     limit: object = None   # SHOW events LIMIT n — tail bound
+    # SHOW events KIND 'recovery' / SINCE <unix-ts> — filter parity
+    # with /debug/events?kind=&since= (meta/monitor_service.py)
+    kind: object = None
+    since: object = None
 
 
 @dataclass
@@ -331,11 +335,20 @@ class Parser:
                     self.expect("ident", "views")
                 what = "materialized_views"
             # else: object class or a session variable name
-            limit = None
-            if self.accept("kw", "limit"):
-                limit = int(self.expect("num").val)
+            limit = kind = since = None
+            # KIND '<kind>' / SINCE <unix-ts> / LIMIT n in any order
+            # (SHOW events only; other targets simply never match)
+            while True:
+                if self.accept("kw", "limit"):
+                    limit = int(self.expect("num").val)
+                elif self.accept("ident", "kind"):
+                    kind = self.expect("str").val
+                elif self.accept("ident", "since"):
+                    since = float(self.expect("num").val)
+                else:
+                    break
             self.accept("op", ";")
-            return Show(what, limit=limit)
+            return Show(what, limit=limit, kind=kind, since=since)
         if self.accept("kw", "set"):
             # SET var = value — session config (reference: session_config/)
             name = self.next().val

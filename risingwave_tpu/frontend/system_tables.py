@@ -1,0 +1,195 @@
+"""System catalog tables — `rw_catalog` over live telemetry.
+
+Reference: rw_catalog system tables (`rw_actors`, `rw_fragments`,
+`rw_event_logs`, ...) make observability *queryable*: operators (and
+the controller itself) answer "what happened / who is slow" in SQL
+instead of scraping. Same shape here: each `rw_*` name binds to a
+relation SYNTHESIZED at query time from the live telemetry owners —
+StreamingStats (actors), catalog deployments (fragments), the
+metrics-history store (utils/metrics_history.py), the event log and
+the recovery ring — and then the NORMAL batch pipeline runs over it,
+so filters / aggregates / joins (including rw_* ⋈ MV) come free:
+
+    SELECT actor, max(value) FROM rw_metrics
+     WHERE name = 'stream_actor_busy_seconds_total' GROUP BY actor
+
+Wiring: `make_system_scan(session)` returns a `_bind_rel` scan that
+serves the `rw_*` names and defers everything else to the stock MV
+scan; frontend/session.py routes a SELECT through it whenever the
+FROM clause mentions a system table (they are not MVs — the serving
+pin path would reject them).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..common.types import DataType, Field, GLOBAL_DICT, Schema
+from .batch import _Rel, _scan_mv
+from .binder import Scope
+
+SCHEMAS = {
+    "rw_actors": Schema((
+        Field("actor_id", DataType.INT64),
+        Field("fragment_id", DataType.INT64),
+        Field("mv", DataType.VARCHAR),
+        Field("executor", DataType.VARCHAR),
+    )),
+    "rw_fragments": Schema((
+        Field("fragment_id", DataType.INT64),
+        Field("mv", DataType.VARCHAR),
+        Field("parallelism", DataType.INT64),
+        Field("actor_ids", DataType.VARCHAR),
+    )),
+    "rw_metrics": Schema((
+        Field("name", DataType.VARCHAR),
+        Field("actor", DataType.VARCHAR),
+        Field("labels", DataType.VARCHAR),
+        Field("epoch", DataType.INT64),
+        Field("ts", DataType.FLOAT64),
+        Field("value", DataType.FLOAT64),
+    )),
+    "rw_events": Schema((
+        Field("seq", DataType.INT64),
+        Field("ts", DataType.FLOAT64),
+        Field("worker", DataType.VARCHAR),
+        Field("kind", DataType.VARCHAR),
+        Field("details", DataType.VARCHAR),
+    )),
+    "rw_recoveries": Schema((
+        Field("ts", DataType.FLOAT64),
+        Field("scope", DataType.VARCHAR),
+        Field("cause", DataType.VARCHAR),
+        Field("duration_ms", DataType.FLOAT64),
+        Field("actors", DataType.VARCHAR),
+    )),
+}
+
+SYSTEM_TABLES = frozenset(SCHEMAS)
+
+
+def is_system_table(name: str) -> bool:
+    return name in SYSTEM_TABLES
+
+
+# ------------------------------------------------------------ row sources
+def _actor_rows(session) -> list:
+    # fragment ids live on the deployments; actors on StreamingStats
+    frag_of = {}
+    for defs in (session.catalog.mvs, session.catalog.sinks):
+        for d in defs.values():
+            dep = getattr(d, "deployment", None)
+            frag_of.update(getattr(dep, "actor_fragment", {}) or {})
+    rows = []
+    for actor_id, (actor, root, scope) in sorted(
+            getattr(session.coord.stats, "_regs", {}).items()):
+        rows.append((int(actor_id), frag_of.get(actor_id),
+                     str(scope) if scope else None,
+                     getattr(root, "identity", None)))
+    return rows
+
+
+def _fragment_rows(session) -> list:
+    rows = []
+    for defs in (session.catalog.mvs, session.catalog.sinks):
+        for name, d in sorted(defs.items()):
+            dep = getattr(d, "deployment", None)
+            for fid, ids in sorted(
+                    (getattr(dep, "frag_actor_ids", {}) or {}).items()):
+                rows.append((int(fid), name, len(ids),
+                             json.dumps(sorted(int(i) for i in ids))))
+    return rows
+
+
+def _metric_rows(session) -> list:
+    hist = getattr(session, "metrics_history", None) \
+        or getattr(session.coord, "metrics_history", None)
+    if hist is None:
+        return []
+    rows = []
+    for r in hist.rows():
+        labels = r["labels"]
+        rows.append((r["name"], labels.get("actor"),
+                     json.dumps(labels, sort_keys=True) if labels else None,
+                     int(r["epoch"]), float(r["ts"]), float(r["value"])))
+    return rows
+
+
+def _event_rows(session) -> list:
+    rows = []
+    for rec in session.event_log.records():
+        details = {k: v for k, v in rec.items()
+                   if k not in ("seq", "ts", "kind")}
+        rows.append((int(rec.get("seq", 0)), float(rec.get("ts", 0.0)),
+                     "meta", rec.get("kind"),
+                     json.dumps(details, default=str, sort_keys=True)))
+    # cluster mode: worker-local records the meta has stitched (the
+    # async SHOW events / /debug/events fan-out refreshes this cache —
+    # a sync batch scan cannot await worker RPCs)
+    for worker, recs in sorted(
+            (getattr(session, "_worker_events_cache", None) or {}).items()):
+        for rec in recs:
+            details = {k: v for k, v in rec.items()
+                       if k not in ("seq", "ts", "kind")}
+            rows.append((int(rec.get("seq", 0)),
+                         float(rec.get("ts", 0.0)), f"w{worker}",
+                         rec.get("kind"),
+                         json.dumps(details, default=str, sort_keys=True)))
+    rows.sort(key=lambda r: r[1])
+    return rows
+
+
+def _recovery_rows(session) -> list:
+    rows = []
+    for r in getattr(session, "recovery_ring").recoveries:
+        rows.append((float(r.get("at_ns", 0)) / 1e9, r.get("scope"),
+                     r.get("cause"),
+                     float(r.get("duration_ns", 0)) / 1e6,
+                     json.dumps(list(r.get("actors", ())))))
+    return rows
+
+
+_SOURCES = {
+    "rw_actors": _actor_rows,
+    "rw_fragments": _fragment_rows,
+    "rw_metrics": _metric_rows,
+    "rw_events": _event_rows,
+    "rw_recoveries": _recovery_rows,
+}
+
+
+# --------------------------------------------------------------- binding
+def _to_rel(schema: Schema, rows: list, qualifier) -> _Rel:
+    n = len(rows)
+    cols, valids = [], []
+    for i, f in enumerate(schema):
+        vals = np.zeros(n, dtype=f.data_type.np_dtype)
+        valid = np.zeros(n, dtype=bool)
+        for j, row in enumerate(rows):
+            v = row[i]
+            if v is None:
+                continue
+            if f.data_type is DataType.VARCHAR:
+                vals[j] = GLOBAL_DICT.get_or_insert(str(v))
+            elif f.data_type in (DataType.FLOAT32, DataType.FLOAT64):
+                vals[j] = float(v)
+            else:
+                vals[j] = int(v)
+            valid[j] = True
+        cols.append(vals)
+        valids.append(valid)
+    return _Rel(cols, valids, Scope.of(schema, qualifier))
+
+
+def make_system_scan(session):
+    """A `_bind_rel` scan serving the rw_* system tables and deferring
+    every other name to the stock MV scan — so `rw_actors ⋈ some_mv`
+    binds like any join."""
+    def scan(catalog, name: str, alias):
+        if name in SYSTEM_TABLES:
+            rows = _SOURCES[name](session)
+            return _to_rel(SCHEMAS[name], rows, alias or name)
+        return _scan_mv(catalog, name, alias)
+    return scan

@@ -109,6 +109,12 @@ class BarrierCoordinator:
         # incident this coordinator detects (barrier stalls, broker
         # split adoptions) goes through the one choke point.
         self.event_log = None
+        # barrier-paced metrics history (utils/metrics_history.py): the
+        # session swaps in its own long-lived instance (survives
+        # recovery's coordinator replacement) and configures retention;
+        # compute nodes keep this default so workers sample locally too.
+        from ..utils.metrics_history import MetricsHistory
+        self.metrics_history = MetricsHistory()
         # stuck-barrier watchdog (the MonitorService/risectl-trace
         # analogue): a background task fires once per stalled epoch when
         # an in-flight barrier exceeds this threshold — logs the full
@@ -695,6 +701,30 @@ class BarrierCoordinator:
         self.compactor.event_log = self.event_log
         self.compactor.retention.event_log = self.event_log
         self.compactor.on_barrier(barrier.epoch.curr)
+        # metrics-history pulse LAST: every gauge the pulses above
+        # refresh (HBM accounting, serving cache rows, retention
+        # floors) is already current when sampled; internally throttled
+        # by its interval and never raises into the barrier path
+        self.metrics_history.on_barrier(barrier.epoch.curr)
+        # cross-engine trace links staged by broker connectors/sinks
+        # during the epoch attach to the (just-closed) trace now
+        self._drain_trace_links(barrier.epoch.curr)
+
+    def _drain_trace_links(self, epoch: int) -> None:
+        """Collect (engine, epoch, span, topic/partition/offset) link
+        records staged by BrokerPartitionConnector ingests and
+        BrokerSink deliveries onto the epoch's trace."""
+        links = []
+        for exec_ in list(self.source_execs.values()):
+            for _sid, conn in getattr(exec_, "splits", ()):
+                drain = getattr(conn, "drain_trace_links", None)
+                if drain is not None:
+                    try:
+                        links.extend(drain())
+                    except Exception:
+                        pass
+        if links:
+            self.tracer.add_links(epoch, links)
 
     async def run_rounds(self, n: int, interval_s: Optional[float] = None) -> None:
         """Inject n barriers, waiting for each to complete. The very first

@@ -42,7 +42,8 @@ class EventLog:
     durable directory (None = ring only)."""
 
     def __init__(self, root=None, segment_bytes: int = 1 << 20,
-                 keep: int = 4096, max_segments: int = 8):
+                 keep: int = 4096, max_segments: int = 8,
+                 subdir: str = EVENTS_DIR):
         self.segment_bytes = int(segment_bytes)
         self.max_segments = max(1, int(max_segments))
         self._ring: deque[dict] = deque(maxlen=keep)
@@ -51,7 +52,7 @@ class EventLog:
         self._dir = None
         self._f = None
         if root:
-            self._dir = os.path.join(root, EVENTS_DIR)
+            self._dir = os.path.join(root, subdir)
             os.makedirs(self._dir, exist_ok=True)
             self._load()
 

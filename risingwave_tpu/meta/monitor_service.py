@@ -237,8 +237,23 @@ class MonitorService:
                          if "since" in params else None)
             except ValueError:
                 return 400, _TEXT, "bad since/limit\n"
+            kind = params.get("kind")
             recs = [] if log is None else log.records(
-                limit=limit, since=since, kind=params.get("kind"))
+                limit=limit, since=since, kind=kind)
+            if cluster is not None:
+                # one endpoint sees the whole cluster: each worker's
+                # durable log stitched in under worker="wN" (meta's
+                # own records carry worker="meta"), merged by ts
+                recs = [dict(r, worker="meta") for r in recs]
+                per_worker = await cluster.events_all(
+                    limit=limit, kind=kind, since=since)
+                session._worker_events_cache = per_worker
+                for wid, wrecs in sorted(per_worker.items()):
+                    recs.extend(dict(r, worker=f"w{wid}")
+                                for r in wrecs)
+                recs.sort(key=lambda r: r.get("ts", 0))
+                if limit is not None:
+                    recs = recs[-limit:]
             return 200, _JSON, json.dumps(recs) + "\n"
         if path.startswith("/debug/profile/"):
             kind = path.rsplit("/", 1)[-1]

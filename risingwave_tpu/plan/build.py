@@ -1312,6 +1312,13 @@ def _build_sink(args, inputs, ctx: ActorCtx, key):
                 "append-only changelog (WITH type='append-only')")
         target = BrokerSink(args["brokers"], args["topic"],
                             schema=inputs[0].schema, partitions=parts)
+        # cross-engine trace stamping: delivered batch metas carry this
+        # engine's identity + epoch span so a downstream engine's
+        # ingest links back (utils/trace.py stitch_chrome_traces)
+        session = getattr(ctx.env, "session", None)
+        target.engine_id = getattr(session, "engine_id", None) \
+            or f"engine-{id(ctx.env) & 0xFFFF:04x}"
+        target.tracer = ctx.env.coord.tracer
     else:
         raise ValueError(f"unknown sink connector {connector!r}")
     # Exactly-once via the changelog log store (logstore/): default for

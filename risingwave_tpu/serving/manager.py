@@ -80,7 +80,9 @@ class ServingManager:
 
     def unregister_mv(self, name: str) -> None:
         if self._mvs.pop(name, None) is not None:
-            GLOBAL_METRICS.gauge("serving_cache_rows", mv=name).set(0.0)
+            # drop the labelled series entirely — a zeroed gauge for a
+            # dropped MV would linger in /metrics (and rw_metrics) forever
+            GLOBAL_METRICS.remove("serving_cache_rows", mv=name)
 
     # ----------------------------------------------------------- barrier
     def on_barrier(self, barrier) -> None:

@@ -124,6 +124,7 @@ class BackgroundCompactor:
         # state
         self._barriers = 0
         self._credit = 0
+        self._floor_sources: set = set()
         self._job: Optional[asyncio.Task] = None
         self._task: Optional[CompactionTask] = None
         # counters for SHOW compaction / the soak gate
@@ -183,6 +184,12 @@ class BackgroundCompactor:
         for source, ep in floors.items():
             retention_floor_gauge(source).set(
                 float(ep if ep is not None else -1))
+        # a pin source that vanished (DROP SINK, subscription gone) must
+        # take its labelled gauge with it, or /metrics grows forever
+        from ..utils.metrics import GLOBAL_METRICS
+        for source in self._floor_sources - set(floors):
+            GLOBAL_METRICS.remove("retention_floor_epoch", source=source)
+        self._floor_sources = set(floors)
         self._harvest()
         self._credit = min(self._credit + self.budget_bytes * self.interval,
                            self.credit_cap_bytes)

@@ -158,6 +158,25 @@ class MetricsRegistry:
             self.histograms[key] = Histogram(buckets)
         return self.histograms[key]
 
+    def labelled_series(self, prefix: str = "",
+                        kinds=("counter", "gauge", "histogram")) -> set:
+        """Every (name, labels) key with a NON-empty label set, as
+        `(name, (("k","v"), ...))` tuples. The teardown-audit surface:
+        tests snapshot this before a create/…/drop cycle and diff after
+        — anything new is a series some teardown path forgot to
+        `remove()` and /metrics would grow by forever. `kinds` narrows
+        the audit: cumulative counters conventionally outlive their
+        emitter (totals stay meaningful after a drop), so leak checks
+        usually pass kinds=("gauge", "histogram")."""
+        by_kind = {"counter": self.counters, "gauge": self.gauges,
+                   "histogram": self.histograms}
+        out = set()
+        for k in kinds:
+            for name, labels in by_kind[k]:
+                if labels and name.startswith(prefix):
+                    out.add((name, labels))
+        return out
+
     def remove(self, name: str, **labels) -> None:
         """Drop one series (all kinds) — dead actors must not linger in
         scrapes forever (stream/monitor.py unregisters through here)."""

@@ -59,6 +59,12 @@ class EpochTrace:
     # Worker offsets are relative to the worker's inject RECEIPT, which
     # stitching anchors at meta's inject push — no cross-host clocks.
     worker_spans: dict = field(default_factory=dict)
+    # cross-engine broker links: `dir="out"` = a BrokerSink delivery
+    # this epoch (carries OUR span id, stamped into the batch meta);
+    # `dir="in"` = a BrokerPartitionConnector ingest (carries the
+    # UPSTREAM engine's span id read back from that meta). The pair
+    # meets again in `stitch_chrome_traces` via matching span ids.
+    links: list = field(default_factory=list)
 
     def to_dict(self) -> dict:
         """Wire form of the span (sealed-push piggyback + format=json):
@@ -74,6 +80,7 @@ class EpochTrace:
             "upload_ns": int(self.upload_ns),
             "commit_ns": int(self.commit_ns),
             "total_ns": int(self.total_ns),
+            "links": [dict(ln) for ln in self.links],
         }
 
     @classmethod
@@ -88,6 +95,7 @@ class EpochTrace:
         t.upload_ns = int(d.get("upload_ns", 0))
         t.commit_ns = int(d.get("commit_ns", 0))
         t.total_ns = int(d.get("total_ns", 0))
+        t.links = [dict(ln) for ln in d.get("links", ())]
         return t
 
     @staticmethod
@@ -198,6 +206,19 @@ class EpochTracer:
         if t is not None:
             t.seal_ns, t.upload_ns, t.commit_ns = seal_ns, upload_ns, commit_ns
 
+    def add_links(self, epoch: int, links) -> None:
+        """Attach cross-engine broker link records to an epoch span —
+        open first, then the ring (sink deliveries on the exactly-once
+        path land after the epoch's span closed, like annotate)."""
+        t = self._open.get(epoch)
+        if t is None:
+            for cand in reversed(self._ring):
+                if cand.epoch == epoch:
+                    t = cand
+                    break
+        if t is not None:
+            t.links.extend(dict(ln) for ln in links)
+
     def ingest_worker(self, worker_id: int, spans) -> None:
         """Meta-side stitch point: attach a worker's shipped span
         bundle (list of EpochTrace.to_dict()) to the matching meta
@@ -306,12 +327,29 @@ def traces_to_json(traces, recoveries=()) -> dict:
     }
 
 
+# tid of the per-engine "broker i/o" track holding cross-engine link
+# slices (far above any real actor id)
+BROKER_TID = 9_999_999
+
+
+def _flow_id(span: str) -> int:
+    """Stable chrome flow-event id for a span id string: the SAME id on
+    the producer's "s" and the consumer's "f" is what ties a sink
+    delivery to the downstream ingest across two engines' exports."""
+    import zlib
+    return zlib.crc32(str(span).encode()) & 0x7FFFFFFF
+
+
 def traces_to_chrome(traces) -> list:
     """format=chrome: Chrome trace-event array (Perfetto-loadable).
     One pid per worker (pid 0 = meta), one tid per actor (tid 0 = the
     epoch-level span). All timestamps are µs offsets from the OLDEST
     exported epoch's inject, each epoch anchored at its inject time;
-    worker events anchor at the inject push, i.e. the same origin."""
+    worker events anchor at the inject push, i.e. the same origin.
+    Cross-engine broker links add a "broker i/o" track per epoch plus
+    chrome flow events ("s"/"f" with matching ids) so Perfetto draws an
+    arrow from a sink delivery to the downstream engine's ingest once
+    two exports are stitched (`stitch_chrome_traces`)."""
     events = []
     base = 0
     for i, t in enumerate(sorted(traces, key=lambda t: t.epoch)):
@@ -346,6 +384,25 @@ def traces_to_chrome(traces) -> list:
                 ev(f"w{wid} collect actor {actor_id}", wid,
                    actor_id, 0, dt,
                    **{k: v / 1e6 for k, v in ph.items()})
+        # cross-engine links: one slice per delivery/ingest on the
+        # broker i/o track + a flow event INSIDE it (flow events bind
+        # to their enclosing slice by pid/tid/ts)
+        span_ns = max(t.total_ns, 1_000_000)
+        for ln in t.links:
+            where = (f"{ln.get('topic')}[{ln.get('partition')}]"
+                     f"@{ln.get('offset')}")
+            out = ln.get("dir") == "out"
+            name = ("sink deliver " if out else "source ingest ") + where
+            span = ln.get("span") if out else ln.get("peer")
+            ev(name, 0, BROKER_TID, 0, span_ns, **{
+                k: v for k, v in ln.items() if v is not None})
+            if span:
+                events.append({
+                    "name": "xengine", "cat": "broker",
+                    "ph": "s" if out else "f", **({} if out
+                                                  else {"bp": "e"}),
+                    "id": _flow_id(span), "pid": 0, "tid": BROKER_TID,
+                    "ts": round((base + span_ns / 2) / 1e3, 3)})
         # epochs laid end to end: each epoch's window begins where the
         # previous one's longest span ended (monotonic offsets without
         # trusting any wall clock)
@@ -354,6 +411,56 @@ def traces_to_chrome(traces) -> list:
                          for w in t.worker_spans.values()), default=0),
                     1_000_000)
     return events
+
+
+def stitch_chrome_traces(a_events, b_events, a_name: str = "engine-a",
+                         b_name: str = "engine-b"):
+    """Merge two engines' chrome exports into ONE Perfetto timeline.
+
+    Engine B's pids are re-based (pid + 100 per worker) so the two
+    engines render as separate process groups, process_name metadata
+    labels them, and engine B's clock is shifted so every matched
+    delivery→ingest flow pair is causal (ingest at-or-after delivery —
+    the only cross-engine ordering the broker offsets guarantee).
+    Returns `(merged_events, n_links)` where n_links counts flow ids
+    present as BOTH an "s" (delivery) and an "f" (ingest)."""
+    PID_STRIDE = 100
+    b_events = [dict(e) for e in b_events]
+    for e in b_events:
+        e["pid"] = int(e.get("pid", 0)) + PID_STRIDE
+    out_ids = {e["id"]: e["ts"] for e in a_events
+               if e.get("ph") == "s" and "id" in e}
+    in_ids = {e["id"]: e["ts"] for e in b_events
+              if e.get("ph") == "f" and "id" in e}
+    # reverse direction too (B sinks into A)
+    out_ids.update({e["id"]: e["ts"] for e in b_events
+                    if e.get("ph") == "s" and "id" in e})
+    in_ids.update({e["id"]: e["ts"] for e in a_events
+                   if e.get("ph") == "f" and "id" in e})
+    matched = sorted(set(out_ids) & set(in_ids))
+    # causality shift: push B late enough that no matched ingest
+    # precedes its delivery (both exports start at their own t=0)
+    delta = 0.0
+    for fid in matched:
+        a_ts = out_ids[fid]
+        b_ts = in_ids[fid]
+        delta = max(delta, a_ts - b_ts + 1.0)
+    if delta:
+        for e in b_events:
+            e["ts"] = round(e.get("ts", 0) + delta, 3)
+    merged = []
+    for pid_base, name, evs in ((0, a_name, a_events),
+                                (PID_STRIDE, b_name, b_events)):
+        pids = sorted({int(e.get("pid", 0)) for e in evs})
+        for pid in pids:
+            wid = pid - pid_base
+            label = name if wid == 0 else f"{name}/w{wid}"
+            merged.append({"name": "process_name", "ph": "M",
+                           "pid": pid, "tid": 0,
+                           "args": {"name": label}})
+    merged.extend(a_events)
+    merged.extend(b_events)
+    return merged, len(matched)
 
 
 def format_stuck_barrier_report(coord, worker_reports=None) -> str:

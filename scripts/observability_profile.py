@@ -1,6 +1,6 @@
 """Observability-plane gate — canned q7 shape, no TPU needed.
 
-Six checks, rc=0 iff all pass:
+Eight checks, rc=0 iff all pass:
 
   1. OVERHEAD — the q7-shaped pipeline (broadcast source -> window-max
      agg -> join back) runs under real actors + a real coordinator at
@@ -22,12 +22,21 @@ Six checks, rc=0 iff all pass:
   4. PROFILE PERTURBATION — a 2s on-demand cpu profile sampled while
      the q7 shape keeps pacing barriers must keep the barrier p50
      within 15% of the unprofiled run (and yield parseable stacks).
-  5. CLUSTER TRACE OVERHEAD — a real 2-worker deployment runs the q7
+  5. METRICS HISTORY — the barrier-paced sampler on (interval=1, full
+     default allowlist) must keep the barrier p50 within the calibrated
+     limit of sampling-off, leave >= 2 samples per tracked series, and
+     answer through SQL: GROUP BY / filtered aggregates over
+     `rw_metrics` via the normal batch pipeline.
+  6. CROSS-ENGINE STITCH — two in-process engines chained through one
+     broker topic export their chrome traces; the stitcher must merge
+     them into one Perfetto-loadable timeline with >= 1 sink-delivery
+     -> source-ingest flow link.
+  7. CLUSTER TRACE OVERHEAD — a real 2-worker deployment runs the q7
      DDL with distributed span recording at `debug`; barrier p50 must
      stay within the same-machine calibrated limit of `off` (off runs
      twice, bracketing debug, to supply the null spread; span bundles
      ride every sealed report).
-  6. CLUSTER STALL REPORT — a worker-side `channel_stall` fault wedges
+  8. CLUSTER STALL REPORT — a worker-side `channel_stall` fault wedges
      an epoch past the watchdog threshold; the merged report must name
      the stalled WORKER (one `== worker wN ==` section per live worker)
      and the remaining ACTORS.
@@ -132,14 +141,19 @@ def _canned_chunks(seed: int):
     return intervals
 
 
-async def _run_q7(metric_level: str, profile_seconds: float = 0.0) -> dict:
+async def _run_q7(metric_level: str, profile_seconds: float = 0.0,
+                  history_interval=None) -> dict:
     """q7 shape under real actors: one source actor broadcasting to a
     join actor whose right side is project -> window-max agg.
 
     With `profile_seconds` > 0, a cpu profile samples from a helper
     thread WHILE barriers keep pacing (the perturbation check): the
     interval loop keeps injecting until the profile window closes, and
-    only the latencies that overlap it are measured."""
+    only the latencies that overlap it are measured.
+
+    `history_interval` (0 = sampling off, N = every N barriers)
+    configures the coordinator's metrics-history sampler for the
+    HISTORY overhead check; None leaves the default."""
     from risingwave_tpu.expr import call, col, lit
     from risingwave_tpu.expr.agg import AggCall, AggKind
     from risingwave_tpu.meta.barrier_manager import BarrierCoordinator
@@ -154,6 +168,8 @@ async def _run_q7(metric_level: str, profile_seconds: float = 0.0) -> dict:
     coord = BarrierCoordinator(MemoryStateStore(),
                                checkpoint_max_inflight=0)
     coord.stats.configure(metric_level)
+    if history_interval is not None:
+        coord.metrics_history.configure(interval=history_interval)
     barrier_q: asyncio.Queue = asyncio.Queue()
     coord.register_source(barrier_q)
 
@@ -217,6 +233,13 @@ async def _run_q7(metric_level: str, profile_seconds: float = 0.0) -> dict:
         from risingwave_tpu.utils.profiler import parse_collapsed
         stacks = parse_collapsed(prof_text)
         out["profile_samples"] = sum(c for _, c in stacks)
+    if history_interval:
+        per_series: dict = {}
+        for r in coord.metrics_history.rows():
+            key = (r["name"], tuple(sorted(r["labels"].items())))
+            per_series[key] = per_series.get(key, 0) + 1
+        out["history_series"] = len(per_series)
+        out["history_min_samples"] = min(per_series.values(), default=0)
     return out
 
 
@@ -477,6 +500,129 @@ async def _check_profile_perturbation(baseline_p50: float) -> dict:
                                    for r in runs)}
 
 
+# ------------------------------------------------------ metrics history check
+
+async def _check_history() -> dict:
+    """METRICS HISTORY — two halves:
+
+    OVERHEAD — the q7 shape runs with the barrier-paced sampler off
+    (interval=0) and on (interval=1, full default allowlist at
+    metric_level=debug); the sampling-on barrier p50 must stay within
+    the same-machine calibrated limit of off, and every sampled series
+    must hold >= 2 samples after the run.
+
+    SQL SURFACE — a live Session ticks a real pipeline, then the
+    history must answer through the batch pipeline: a GROUP BY over
+    rw_metrics returns >= 2 samples per name, and a filtered aggregate
+    (max of one series) returns a finite value."""
+    import math
+
+    p50 = {"off": [], "on": []}
+    on_runs = []
+    for _ in range(PASSES):
+        for mode, interval in (("off", 0), ("on", 1)):
+            r = await _run_q7("debug", history_interval=interval)
+            p50[mode].append(r["p50_ms"])
+            if mode == "on":
+                on_runs.append(r)
+    off_best, on_best = min(p50["off"]), min(p50["on"])
+    out = {"off_p50_ms": off_best, "on_p50_ms": on_best,
+           "ratio": round(on_best / max(off_best, 1e-9), 3),
+           "limit": _calibrated_limit(p50["off"]),
+           "series": max(r["history_series"] for r in on_runs),
+           "min_samples": max(r["history_min_samples"] for r in on_runs)}
+
+    from risingwave_tpu.frontend import Session
+    s = Session()
+    await s.execute("SET metric_level = debug")
+    await s.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=128, rate_limit=256)")
+    await s.execute(
+        "CREATE MATERIALIZED VIEW hist_gate AS SELECT auction, price "
+        "FROM bid")
+    await s.tick(8)
+    counts = dict(s.query(
+        "SELECT name, count(*) FROM rw_metrics GROUP BY name"))
+    agg = s.query(
+        "SELECT max(value) FROM rw_metrics "
+        "WHERE name = 'meta_barrier_latency_seconds_p50'")
+    await s.drop_all()
+    out["sql_names"] = len(counts)
+    out["sql_min_samples"] = int(min(counts.values(), default=0))
+    out["sql_max_latency_p50"] = (float(agg[0][0])
+                                  if agg and agg[0][0] is not None
+                                  else None)
+    out["sql_agg_finite"] = bool(
+        agg and agg[0][0] is not None and math.isfinite(float(agg[0][0])))
+    return out
+
+
+# --------------------------------------------------- cross-engine trace check
+
+async def _check_xengine_stitch() -> dict:
+    """CROSS-ENGINE STITCH — two in-process engines chained through one
+    broker topic (A: nexmark -> windowed-agg broker sink; B: broker
+    source -> MV). Each engine's tracer exports its own chrome trace;
+    `stitch_chrome_traces` must merge them into ONE Perfetto-loadable
+    timeline with >= 1 sink-delivery -> source-ingest flow link."""
+    import tempfile
+
+    from risingwave_tpu.broker import (Broker, register_inproc,
+                                       unregister_inproc)
+    from risingwave_tpu.frontend import Session
+    from risingwave_tpu.utils.trace import (stitch_chrome_traces,
+                                            traces_to_chrome)
+
+    root = tempfile.mkdtemp(prefix="obsgate-xengine-")
+    b = Broker(os.path.join(root, "broker"), fsync=False)
+    register_inproc("obs_gate_x", b)
+    try:
+        a = Session()
+        await a.execute("SET streaming_watchdog = 0")
+        await a.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+            "chunk_size=128, inter_event_us=2000, rate_limit=512)")
+        await a.execute(
+            "CREATE SINK q7x AS SELECT window_end, max(price) AS mp "
+            "FROM TUMBLE(bid, date_time, 1000000) GROUP BY window_end "
+            "WITH (connector='broker', topic='q7x', "
+            "brokers='inproc://obs_gate_x')")
+        await a.tick(5)
+        bs = Session()
+        await bs.execute("SET streaming_watchdog = 0")
+        await bs.execute(
+            "CREATE SOURCE q7 WITH (connector='broker', topic='q7x', "
+            "brokers='inproc://obs_gate_x', "
+            "columns='window_end timestamp, mp int64', "
+            "primary_key='window_end', chunk_size=64, "
+            "discovery_interval_ms=0)")
+        await bs.execute(
+            "CREATE MATERIALIZED VIEW xout AS "
+            "SELECT window_end, mp FROM q7")
+        await bs.tick(5)
+        ev_a = traces_to_chrome(a.coord.tracer.open_traces()
+                                + a.coord.tracer.recent())
+        ev_b = traces_to_chrome(bs.coord.tracer.open_traces()
+                                + bs.coord.tracer.recent())
+        merged, n_links = stitch_chrome_traces(
+            ev_a, ev_b, a.engine_id, bs.engine_id)
+        # Perfetto loads a flat chrome-format event array: every event
+        # needs numeric ts and a ph; the stitched ids must still pair
+        json.dumps(merged)
+        bad = [e for e in merged
+               if "ph" not in e
+               or not isinstance(e.get("ts", 0), (int, float))]
+        rows = bs.query("SELECT window_end, mp FROM xout")
+        await a.drop_all()
+        await bs.drop_all()
+        return {"events_a": len(ev_a), "events_b": len(ev_b),
+                "merged_events": len(merged), "links": n_links,
+                "malformed_events": len(bad), "rows_through": len(rows)}
+    finally:
+        unregister_inproc("obs_gate_x")
+
+
 async def main() -> int:
     # overhead: alternate modes, best median per mode
     p50 = {"off": [], "debug": []}
@@ -493,7 +639,11 @@ async def main() -> int:
     expo = await _check_exposition()
     wd = await _check_watchdog()
     perturb = await _check_profile_perturbation(dbg_p50)
+    # cluster keeps its original slot (same process state as ever for
+    # its timing comparison); the new checks run after it
     cluster = await _check_cluster()
+    hist = await _check_history()
+    xeng = await _check_xengine_stitch()
     verdict = {
         "overhead_within_calibrated_limit": dbg_p50 <= off_p50 * limit,
         "exposition_valid": expo["row_series"] > 0,
@@ -509,11 +659,21 @@ async def main() -> int:
         "cpu_profile_perturbation_within_15pct": (
             perturb["ratio"] <= PROFILE_PERTURB_LIMIT
             and perturb["profile_samples"] > 10),
+        "history_overhead_within_calibrated_limit":
+            hist["ratio"] <= hist["limit"],
+        "history_queryable_via_sql": (
+            hist["min_samples"] >= 2 and hist["sql_names"] > 0
+            and hist["sql_min_samples"] >= 2 and hist["sql_agg_finite"]),
+        "xengine_stitched_with_links": (
+            xeng["links"] >= 1 and xeng["malformed_events"] == 0
+            and xeng["rows_through"] > 0),
     }
     print(json.dumps({"overhead": overhead}))
     print(json.dumps({"exposition": expo}))
     print(json.dumps({"watchdog": wd}))
     print(json.dumps({"profile_perturbation": perturb}))
+    print(json.dumps({"history": hist}))
+    print(json.dumps({"xengine": xeng}))
     print(json.dumps({"cluster": cluster}))
     print(json.dumps({"verdict": verdict}))
     return 0 if all(verdict.values()) else 1

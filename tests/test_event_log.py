@@ -87,6 +87,41 @@ async def test_survives_sigkill_and_drops_torn_tail(tmp_path):
     log3.close()
 
 
+async def test_worker_event_log_survives_sigkill_torn_tail(tmp_path):
+    """Worker-local event logs (cluster/compute_node.py) live in their
+    own `events_wN` subdir of the shared store root. SIGKILLing the
+    worker mid-append must leave every completed record readable on
+    reopen, with a torn trailing frame dropped whole — the incident
+    record survives the worker's own crash."""
+    root = str(tmp_path)
+    child = (
+        "import os, signal;"
+        "from risingwave_tpu.meta.event_log import EventLog;"
+        f"log = EventLog({root!r}, subdir='events_w3');"
+        "[log.emit('actor_failed', error='boom', n=i) for i in range(4)];"
+        "os.kill(os.getpid(), signal.SIGKILL)"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run([sys.executable, "-c", child], env=env,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    assert p.returncode == -signal.SIGKILL
+    d = os.path.join(root, "events_w3")
+    segs = [os.path.join(d, n) for n in sorted(os.listdir(d))
+            if n.endswith(".seg")]
+    body = json.dumps({"seq": 9, "ts": 0, "kind": "torn"}).encode()
+    with open(segs[-1], "ab") as f:
+        f.write(struct.pack("!II", len(body), 0) + body[: len(body) // 2])
+    log = EventLog(root, subdir="events_w3")
+    recs = log.records(kind="actor_failed")
+    assert [r["n"] for r in recs] == list(range(4))
+    assert all(r["error"] == "boom" for r in recs)
+    assert "torn" not in [r["kind"] for r in log.records()]
+    # the meta-side "events" subdir is untouched by the worker's log
+    assert not os.path.isdir(os.path.join(root, EVENTS_DIR))
+    log.close()
+
+
 async def test_segment_roll_and_prune(tmp_path):
     root = str(tmp_path)
     log = EventLog(root, segment_bytes=256, max_segments=3)

@@ -1,0 +1,154 @@
+"""Cross-engine trace propagation + Perfetto stitching (utils/trace.py,
+connectors/broker.py, broker/log.py).
+
+BrokerSink stamps every delivered batch's meta with (engine, epoch,
+span); BrokerPartitionConnector records the upstream context on ingest
+and the coordinator drains those links into the epoch trace at the next
+barrier. `traces_to_chrome` renders the link endpoints as broker-track
+slices joined by chrome flow events (`ph:"s"` / `ph:"f"`), and
+`stitch_chrome_traces` merges TWO engines' exports into one
+Perfetto-loadable timeline, pairing the flow ids across files."""
+
+import json
+
+from risingwave_tpu.broker import (Broker, register_inproc,
+                                   unregister_inproc)
+from risingwave_tpu.frontend import Session
+from risingwave_tpu.utils.trace import (BROKER_TID, stitch_chrome_traces,
+                                        traces_to_chrome)
+
+
+def _chrome_is_perfetto_loadable(events):
+    """Perfetto's chrome-JSON importer needs: serializable, every event
+    carries a `ph`, numeric `ts` (and `dur` where present), int
+    pid/tid."""
+    json.dumps(events)
+    for e in events:
+        assert "ph" in e, e
+        assert isinstance(e.get("ts", 0), (int, float)), e
+        if "dur" in e:
+            assert isinstance(e["dur"], (int, float)), e
+        assert isinstance(e.get("pid", 0), int), e
+        assert isinstance(e.get("tid", 0), int), e
+
+
+async def _pipeline(broker_name: str, topic: str):
+    """Engine A (nexmark -> windowed-agg broker sink) feeding engine B
+    (broker source -> MV) through one in-process topic."""
+    a = Session()
+    await a.execute("SET streaming_watchdog = 0")
+    await a.execute(
+        "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+        "chunk_size=128, inter_event_us=2000, rate_limit=512)")
+    await a.execute(
+        f"CREATE SINK snk AS SELECT window_end, max(price) AS mp "
+        f"FROM TUMBLE(bid, date_time, 1000000) GROUP BY window_end "
+        f"WITH (connector='broker', topic='{topic}', "
+        f"brokers='inproc://{broker_name}')")
+    await a.tick(5)
+    b = Session()
+    await b.execute("SET streaming_watchdog = 0")
+    await b.execute(
+        f"CREATE SOURCE up WITH (connector='broker', topic='{topic}', "
+        f"brokers='inproc://{broker_name}', "
+        "columns='window_end timestamp, mp int64', "
+        "primary_key='window_end', chunk_size=64, "
+        "discovery_interval_ms=0)")
+    await b.execute(
+        "CREATE MATERIALIZED VIEW xout AS SELECT window_end, mp FROM up")
+    await b.tick(5)
+    return a, b
+
+
+async def test_cross_engine_links_recorded_and_stitched(tmp_path):
+    br = Broker(str(tmp_path / "b"), fsync=False)
+    register_inproc("t_stitch", br)
+    try:
+        a, b = await _pipeline("t_stitch", "q7s")
+        ta = a.coord.tracer.open_traces() + a.coord.tracer.recent()
+        tb = b.coord.tracer.open_traces() + b.coord.tracer.recent()
+        # the link records themselves: A carries out-links stamped with
+        # its engine id; B carries in-links naming A's spans as peer
+        out = [ln for t in ta for ln in t.links if ln["dir"] == "out"]
+        ins = [ln for t in tb for ln in t.links if ln["dir"] == "in"]
+        assert out and ins
+        assert all(ln["engine"] == a.engine_id for ln in out)
+        assert all(ln["peer_engine"] == a.engine_id for ln in ins)
+        assert {ln["peer"] for ln in ins} <= {ln["span"] for ln in out}
+
+        ev_a, ev_b = traces_to_chrome(ta), traces_to_chrome(tb)
+        _chrome_is_perfetto_loadable(ev_a)
+        _chrome_is_perfetto_loadable(ev_b)
+        # flow endpoints ride the broker track in each export
+        assert any(e.get("ph") == "s" and e["tid"] == BROKER_TID
+                   for e in ev_a)
+        assert any(e.get("ph") == "f" and e["tid"] == BROKER_TID
+                   for e in ev_b)
+
+        merged, n_links = stitch_chrome_traces(ev_a, ev_b,
+                                               a.engine_id, b.engine_id)
+        assert n_links >= 1
+        _chrome_is_perfetto_loadable(merged)
+        # the paired flow ids survive the merge, on disjoint pid ranges
+        sids = {e["id"] for e in merged if e.get("ph") == "s"}
+        fids = {e["id"] for e in merged if e.get("ph") == "f"}
+        assert len(sids & fids) >= n_links
+        names = {e.get("args", {}).get("name")
+                 for e in merged if e.get("ph") == "M"}
+        assert any(a.engine_id in (n or "") for n in names)
+        assert any(b.engine_id in (n or "") for n in names)
+        rows = b.query("SELECT window_end, mp FROM xout")
+        assert rows                      # data actually flowed A -> B
+        await a.drop_all()
+        await b.drop_all()
+        await a.shutdown()
+        await b.shutdown()
+    finally:
+        unregister_inproc("t_stitch")
+
+
+async def test_single_engine_chrome_export_stays_valid(tmp_path):
+    """A sink-only engine (out-links, no ingest peer) must still export
+    a loadable trace — half-open links render as slices with an
+    unmatched flow start, which Perfetto tolerates."""
+    br = Broker(str(tmp_path / "b"), fsync=False)
+    register_inproc("t_half", br)
+    try:
+        a = Session()
+        await a.execute("SET streaming_watchdog = 0")
+        await a.execute(
+            "CREATE SOURCE bid WITH (connector='nexmark', table='bid', "
+            "chunk_size=128, inter_event_us=2000, rate_limit=512)")
+        await a.execute(
+            "CREATE SINK snk AS SELECT window_end, max(price) AS mp "
+            "FROM TUMBLE(bid, date_time, 1000000) GROUP BY window_end "
+            "WITH (connector='broker', topic='h', "
+            "brokers='inproc://t_half')")
+        await a.tick(4)
+        ev = traces_to_chrome(a.coord.tracer.open_traces()
+                              + a.coord.tracer.recent())
+        _chrome_is_perfetto_loadable(ev)
+        slices = [e for e in ev if e.get("tid") == BROKER_TID
+                  and e.get("ph") == "X"]
+        assert any("sink deliver" in e.get("name", "") for e in slices)
+        await a.drop_all()
+        await a.shutdown()
+    finally:
+        unregister_inproc("t_half")
+
+
+async def test_fetch_metas_surfaces_batch_meta(tmp_path):
+    """The broker fetch path returns per-batch meta alongside records —
+    the carrier the ingest side reads trace context from."""
+    br = Broker(str(tmp_path / "b"), fsync=False)
+    br.create_topic("t", partitions=1)
+    br.append("t", 0, [b"r0", b"r1"], meta={"trace": {"span": "e/1/0"}})
+    br.append("t", 0, [b"r2"], meta={"trace": {"span": "e/2/0"}})
+    res = br.fetch("t", 0, 0, 100)
+    assert len(res["records"]) == 3
+    metas = res["metas"]
+    assert [base for base, _ in metas] == [0, 2]
+    assert metas[0][1]["trace"]["span"] == "e/1/0"
+    # offset-addressed: fetching from mid-batch skips earlier bases
+    res2 = br.fetch("t", 0, 2, 100)
+    assert [base for base, _ in res2["metas"]] == [2]
